@@ -1,0 +1,22 @@
+"""oryx_tpu — a TPU-native lambda-architecture ML framework.
+
+A from-scratch realization of streaming lambda-architecture machine learning
+(batch model builds + incremental speed-layer updates + low-latency serving)
+with the compute tier on JAX/XLA/pjit over TPU device meshes instead of
+Spark/MLlib on YARN, and a native message-log bus in place of Kafka.
+
+Layer map (mirrors the reference framework's capabilities, re-designed TPU-first;
+see SURVEY.md for the reference inventory):
+
+  oryx_tpu.common    config / rng / text / io / exec / artifact utilities
+  oryx_tpu.bus       message-log backend (topics, offsets, replay) + native broker
+  oryx_tpu.ops       JAX math tier: vector ops, solvers, ALS/k-means/RDF kernels
+  oryx_tpu.parallel  device mesh + sharding helpers (pjit/shard_map collectives)
+  oryx_tpu.ml        batch ML harness: hyperparam search, eval, generation loop
+  oryx_tpu.layers    batch + speed layer runtimes
+  oryx_tpu.serving   REST serving layer with in-device models
+  oryx_tpu.apps      packaged applications: ALS, k-means, random decision forest
+  oryx_tpu.api       user-facing SPI (batch update / speed + serving model managers)
+"""
+
+__version__ = "0.1.0"

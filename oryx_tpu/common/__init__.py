@@ -1,0 +1,9 @@
+"""Zero-dependency utility base: config, RNG, text, IO, concurrency, artifacts.
+
+TPU-native equivalent of the reference's framework/oryx-common
+(ConfigUtils.java, RandomManager.java, TextUtils.java, ExecUtils.java,
+IOUtils.java, ClassUtils.java, PMMLUtils.java).
+"""
+
+from oryx_tpu.common.config import Config, ConfigError, load_config, default_config
+from oryx_tpu.common.rng import RandomManager

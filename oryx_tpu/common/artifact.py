@@ -1,0 +1,155 @@
+"""Self-describing model artifact — the PMML equivalent.
+
+The reference interchanges models as PMML documents whose *extensions* act as
+a generic key/value channel (PMMLUtils.java:55-135, AppPMMLUtils.java:67-280):
+ALS publishes a skeleton PMML holding only hyperparams + factor-file paths,
+k-means a real ClusteringModel, RDF a MiningModel of TreeModels. Here the
+artifact is JSON metadata (+ optional npz tensor payloads) — a format XLA-side
+code can load straight into device arrays — with a PMML XML export shim for
+ecosystem parity.
+
+Layout on disk (a directory):
+    <dir>/model.json      {"app":..., "extensions":{...}, "content":{...}}
+    <dir>/tensors.npz     optional named ndarray payloads
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+from pathlib import Path
+from typing import Any, Mapping
+
+import numpy as np
+
+from oryx_tpu.common.ioutil import mkdirs, strip_scheme
+
+MODEL_FILENAME = "model.json"
+TENSORS_FILENAME = "tensors.npz"
+
+
+class ModelArtifact:
+    def __init__(
+        self,
+        app: str,
+        extensions: Mapping[str, str] | None = None,
+        content: Mapping[str, Any] | None = None,
+        tensors: Mapping[str, np.ndarray] | None = None,
+    ):
+        self.app = app
+        self.extensions: dict[str, str] = dict(extensions or {})
+        self.content: dict[str, Any] = dict(content or {})
+        self.tensors: dict[str, np.ndarray] = dict(tensors or {})
+
+    # -- extensions as generic KV channel (AppPMMLUtils.getExtensionValue) --
+
+    def get_extension(self, name: str, default: Any = None) -> Any:
+        return self.extensions.get(name, default)
+
+    def set_extension(self, name: str, value: Any) -> None:
+        self.extensions[name] = value if isinstance(value, str) else json.dumps(value)
+
+    def get_extension_list(self, name: str) -> list:
+        v = self.extensions.get(name)
+        if v is None:
+            return []
+        return json.loads(v) if isinstance(v, str) else list(v)
+
+    # -- disk I/O (PMMLUtils.write/read) ------------------------------------
+
+    def write(self, path: str | Path) -> Path:
+        d = mkdirs(strip_scheme(str(path)))
+        with open(d / MODEL_FILENAME, "w", encoding="utf-8") as f:
+            json.dump(
+                {"app": self.app, "extensions": self.extensions, "content": self.content},
+                f,
+            )
+        if self.tensors:
+            np.savez_compressed(d / TENSORS_FILENAME, **self.tensors)
+        return d
+
+    @staticmethod
+    def read(path: str | Path) -> "ModelArtifact":
+        d = Path(strip_scheme(str(path)))
+        if d.is_file():
+            d = d.parent
+        with open(d / MODEL_FILENAME, "r", encoding="utf-8") as f:
+            meta = json.load(f)
+        tensors: dict[str, np.ndarray] = {}
+        tp = d / TENSORS_FILENAME
+        if tp.exists():
+            with np.load(tp) as z:
+                tensors = {k: z[k] for k in z.files}
+        return ModelArtifact(meta["app"], meta.get("extensions"), meta.get("content"), tensors)
+
+    # -- inline string form (PMMLUtils.toString/fromString) -----------------
+
+    def to_string(self) -> str:
+        doc: dict[str, Any] = {
+            "app": self.app,
+            "extensions": self.extensions,
+            "content": self.content,
+        }
+        if self.tensors:
+            buf = io.BytesIO()
+            np.savez_compressed(buf, **self.tensors)
+            doc["tensors_b64"] = base64.b64encode(buf.getvalue()).decode("ascii")
+        return json.dumps(doc, separators=(",", ":"))
+
+    @staticmethod
+    def from_string(s: str) -> "ModelArtifact":
+        doc = json.loads(s)
+        tensors: dict[str, np.ndarray] = {}
+        if "tensors_b64" in doc:
+            with np.load(io.BytesIO(base64.b64decode(doc["tensors_b64"]))) as z:
+                tensors = {k: z[k] for k in z.files}
+        return ModelArtifact(doc["app"], doc.get("extensions"), doc.get("content"), tensors)
+
+    # -- PMML export shim ---------------------------------------------------
+
+    def to_pmml_xml(self) -> str:
+        """Minimal PMML 4.3 document: header + extensions (+ ClusteringModel
+        for k-means content), enough for external PMML consumers to read what
+        the reference would have published."""
+        from xml.sax.saxutils import escape, quoteattr
+
+        lines = [
+            '<?xml version="1.0" encoding="UTF-8"?>',
+            '<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">',
+            '  <Header><Application name="oryx_tpu"/></Header>',
+        ]
+        for k, v in self.extensions.items():
+            lines.append(f"  <Extension name={quoteattr(k)} value={quoteattr(str(v))}/>")
+        if self.app == "kmeans" and "clusters" in self.content:
+            clusters = self.content["clusters"]
+            n_feat = len(clusters[0]["center"]) if clusters else 0
+            lines.append(
+                f'  <ClusteringModel functionName="clustering" modelClass="centerBased" '
+                f'numberOfClusters="{len(clusters)}">'
+            )
+            lines.append(
+                '    <ComparisonMeasure kind="distance"><squaredEuclidean/></ComparisonMeasure>'
+            )
+            lines.append(f"    <MiningSchema/>")
+            for c in clusters:
+                center = " ".join(str(x) for x in c["center"])
+                lines.append(
+                    f'    <Cluster id={quoteattr(str(c["id"]))} '
+                    f'size={quoteattr(str(c.get("count", 0)))}>'
+                    f"<Array n=\"{n_feat}\" type=\"real\">{escape(center)}</Array></Cluster>"
+                )
+            lines.append("  </ClusteringModel>")
+        lines.append("</PMML>")
+        return "\n".join(lines)
+
+
+def read_artifact_from_update(key: str, message: str) -> ModelArtifact:
+    """Decode a MODEL (inline artifact) or MODEL-REF (path) update message —
+    the consumer-side counterpart of the size cutover at the reference's
+    MLUpdate.java:212-231 / AppPMMLUtils.readPMMLFromUpdateKeyMessage."""
+    if key == "MODEL":
+        return ModelArtifact.from_string(message)
+    if key == "MODEL-REF":
+        return ModelArtifact.read(message)
+    raise ValueError(f"not a model update key: {key}")

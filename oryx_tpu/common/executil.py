@@ -1,0 +1,63 @@
+"""Parallel task fan-out helpers.
+
+Mirrors the reference's ExecUtils (framework/oryx-common
+.../lang/ExecUtils.java:32-75): run N tasks at parallelism P, optionally on a
+private pool, collecting results. Used by the ML harness to build and
+evaluate hyperparameter candidates concurrently (MLUpdate.java:253-258).
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, Sequence, TypeVar
+
+log = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+
+def do_in_parallel(
+    num_tasks: int,
+    task: Callable[[int], None],
+    parallelism: int | None = None,
+) -> None:
+    collect_in_parallel(num_tasks, task, parallelism)
+
+
+def collect_in_parallel(
+    num_tasks: int,
+    task: Callable[[int], T],
+    parallelism: int | None = None,
+) -> list[T]:
+    """Run task(0..num_tasks-1), at most `parallelism` at a time, returning
+    results in index order. parallelism<=1 runs inline (no pool), which
+    matters on TPU where concurrent jitted builds would contend for the
+    device — the harness defaults to sequential candidate builds."""
+    if num_tasks <= 0:
+        return []
+    parallelism = min(parallelism or 1, num_tasks)
+    if parallelism <= 1:
+        return [task(i) for i in range(num_tasks)]
+    with ThreadPoolExecutor(max_workers=parallelism) as pool:
+        return list(pool.map(task, range(num_tasks)))
+
+
+def map_in_parallel(items: Sequence[T], fn: Callable[[T], "T"], parallelism: int = 4) -> list:
+    return collect_in_parallel(len(items), lambda i: fn(items[i]), parallelism)
+
+
+class LoggingRunnable:
+    """Wrap a callable so exceptions are logged, not swallowed by executor
+    futures (reference LoggingCallable)."""
+
+    def __init__(self, fn: Callable[[], None], name: str = "task"):
+        self.fn = fn
+        self.name = name
+
+    def __call__(self) -> None:
+        try:
+            self.fn()
+        except Exception:  # noqa: BLE001 - must log whatever escapes a thread
+            log.exception("unexpected error in %s", self.name)
+            raise

@@ -1,0 +1,30 @@
+"""Test harness bootstrap.
+
+Mirrors the reference's test strategy (SURVEY.md §4): deterministic global
+seed (OryxTest calls RandomManager.useTestSeed) and local stand-ins for the
+distributed substrate — here a virtual 8-device CPU mesh via
+xla_force_host_platform_device_count, the analogue of Spark master=local[3]
+in AbstractLambdaIT.
+"""
+
+import os
+import sys
+
+# Must be set before jax initializes its backends.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+from oryx_tpu.common.rng import RandomManager  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _deterministic_seed():
+    RandomManager.use_test_seed(1234)
+    yield
+    RandomManager.clear_test_seed()

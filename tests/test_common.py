@@ -1,0 +1,228 @@
+"""Unit tests for the common tier (config/rng/text/io/exec/locks/artifact)."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from oryx_tpu.common.artifact import ModelArtifact, read_artifact_from_update
+from oryx_tpu.common.classutil import load_class, load_instance_of
+from oryx_tpu.common.config import Config, ConfigError, default_config, parse_config
+from oryx_tpu.common.executil import collect_in_parallel
+from oryx_tpu.common.ioutil import (
+    choose_free_port,
+    delete_older_than,
+    list_generation_dirs,
+    mkdirs,
+    strip_scheme,
+    timestamp_from_dirname,
+)
+from oryx_tpu.common.locks import AutoReadWriteLock, RateLimitCheck
+from oryx_tpu.common.rng import RandomManager
+from oryx_tpu.common.text import (
+    join_csv,
+    parse_csv,
+    parse_input_line,
+)
+
+
+# ---- config ---------------------------------------------------------------
+
+HOCON = """
+# comment
+oryx {
+  id = "myapp"
+  input-topic {
+    broker = "mem://test"
+    message = { topic = Input, partitions = 4 }
+  }
+  als.hyperparams.features = [5, 10]
+  ref = ${oryx.id}
+  interp = "id-${oryx.id}"
+  flag = true
+}
+"""
+
+
+def test_parse_hocon_subset():
+    cfg = parse_config(HOCON)
+    assert cfg.get_string("oryx.id") == "myapp"
+    assert cfg.get_string("oryx.input-topic.broker") == "mem://test"
+    assert cfg.get_int("oryx.input-topic.message.partitions") == 4
+    assert cfg.get_list("oryx.als.hyperparams.features") == [5, 10]
+    assert cfg.get_string("oryx.ref") == "myapp"
+    assert cfg.get_string("oryx.interp") == "id-myapp"
+    assert cfg.get_bool("oryx.flag") is True
+
+
+def test_config_overlay_and_missing():
+    cfg = parse_config(HOCON).overlay({"oryx.id": "other", "oryx.new.key": 7})
+    assert cfg.get_string("oryx.id") == "other"
+    assert cfg.get_int("oryx.new.key") == 7
+    # untouched siblings survive the overlay
+    assert cfg.get_int("oryx.input-topic.message.partitions") == 4
+    with pytest.raises(ConfigError):
+        cfg.get("oryx.nope")
+    assert cfg.get("oryx.nope", None) is None
+
+
+def test_config_serialize_roundtrip_and_redaction():
+    cfg = parse_config(HOCON).overlay({"oryx.serving.api.password": "hunter2"})
+    rt = Config.deserialize(cfg.serialize())
+    assert rt.get_string("oryx.id") == "myapp"
+    assert "hunter2" not in cfg.pretty()
+    assert "*****" in cfg.pretty()
+
+
+def test_default_config_has_all_layer_keys():
+    cfg = default_config()
+    for key in [
+        "oryx.input-topic.message.topic",
+        "oryx.update-topic.message.max-size",
+        "oryx.batch.streaming.generation-interval-sec",
+        "oryx.speed.min-model-load-fraction",
+        "oryx.serving.api.port",
+        "oryx.ml.eval.candidates",
+        "oryx.als.hyperparams.features",
+        "oryx.kmeans.hyperparams.k",
+        "oryx.rdf.num-trees",
+    ]:
+        assert cfg.has(key), key
+
+
+def test_config_flatten():
+    flat = parse_config(HOCON).flatten()
+    assert flat["oryx.input-topic.message.topic"] == "Input"
+
+
+# ---- rng ------------------------------------------------------------------
+
+def test_random_manager_deterministic_under_test_seed():
+    RandomManager.use_test_seed(42)
+    a = RandomManager.get_random().standard_normal(5)
+    RandomManager.use_test_seed(42)
+    b = RandomManager.get_random().standard_normal(5)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_random_manager_jax_keys_deterministic():
+    import jax
+
+    RandomManager.use_test_seed(7)
+    k1 = RandomManager.get_key()
+    RandomManager.use_test_seed(7)
+    k2 = RandomManager.get_key()
+    assert jax.random.uniform(k1) == jax.random.uniform(k2)
+
+
+# ---- text -----------------------------------------------------------------
+
+def test_csv_roundtrip_with_quoting():
+    vals = ["a", 'b,"x"', "", "3.5"]
+    line = join_csv(vals)
+    assert parse_csv(line) == ["a", 'b,"x"', "", "3.5"]
+
+
+def test_parse_input_line_json_and_csv():
+    assert parse_input_line('["u1","i1","2.5"]') == ["u1", "i1", "2.5"]
+    assert parse_input_line("u1,i1,2.5") == ["u1", "i1", "2.5"]
+
+
+# ---- ioutil ---------------------------------------------------------------
+
+def test_generation_dirs_and_ttl(tmp_path):
+    now = int(time.time() * 1000)
+    old = now - 10 * 3600 * 1000
+    mkdirs(tmp_path / f"oryx-{old}")
+    mkdirs(tmp_path / f"oryx-{now}")
+    mkdirs(tmp_path / "not-a-generation")
+    dirs = list_generation_dirs(tmp_path)
+    assert [timestamp_from_dirname(d.name) for d in dirs] == [old, now]
+    assert delete_older_than(tmp_path, 5, now_ms=now) == 1
+    assert [timestamp_from_dirname(d.name) for d in list_generation_dirs(tmp_path)] == [now]
+
+
+def test_strip_scheme_and_free_port():
+    assert strip_scheme("file:/tmp/x") == "/tmp/x"
+    assert strip_scheme("file:///tmp/x") == "/tmp/x"
+    assert strip_scheme("/tmp/x") == "/tmp/x"
+    assert 0 < choose_free_port() < 65536
+
+
+# ---- executil / locks -----------------------------------------------------
+
+def test_collect_in_parallel_ordering():
+    out = collect_in_parallel(8, lambda i: i * i, parallelism=4)
+    assert out == [i * i for i in range(8)]
+    assert collect_in_parallel(3, lambda i: i, parallelism=1) == [0, 1, 2]
+
+
+def test_rw_lock_excludes_writer():
+    lock = AutoReadWriteLock()
+    events = []
+
+    def writer():
+        with lock.write():
+            events.append("w")
+
+    with lock.read():
+        t = threading.Thread(target=writer)
+        t.start()
+        time.sleep(0.05)
+        assert events == []  # writer blocked while read held
+    t.join(2)
+    assert events == ["w"]
+
+
+def test_rate_limit_check():
+    rl = RateLimitCheck(period_sec=60)
+    assert rl.test() is True
+    assert rl.test() is False
+
+
+# ---- classutil ------------------------------------------------------------
+
+def test_load_class_and_instance():
+    assert load_class("oryx_tpu.common.locks.RateLimitCheck") is RateLimitCheck
+    inst = load_instance_of("oryx_tpu.common.locks.RateLimitCheck", RateLimitCheck, 5.0)
+    assert inst.period == 5.0
+    with pytest.raises(ImportError):
+        load_class("oryx_tpu.common.locks.Nope")
+
+
+# ---- artifact -------------------------------------------------------------
+
+def test_artifact_disk_roundtrip(tmp_path):
+    art = ModelArtifact(
+        "als",
+        extensions={"features": "10", "implicit": "true"},
+        content={"note": "x"},
+        tensors={"X": np.arange(6, dtype=np.float32).reshape(2, 3)},
+    )
+    art.set_extension("XIDs", ["u1", "u2"])
+    d = art.write(tmp_path / "m")
+    back = ModelArtifact.read(d)
+    assert back.app == "als"
+    assert back.get_extension("features") == "10"
+    assert back.get_extension_list("XIDs") == ["u1", "u2"]
+    np.testing.assert_array_equal(back.tensors["X"], art.tensors["X"])
+
+
+def test_artifact_string_roundtrip_and_update_decode(tmp_path):
+    art = ModelArtifact("kmeans", content={"clusters": [{"id": 0, "center": [1.0, 2.0], "count": 3}]})
+    s = art.to_string()
+    back = read_artifact_from_update("MODEL", s)
+    assert back.content["clusters"][0]["center"] == [1.0, 2.0]
+    p = art.write(tmp_path / "m2")
+    back2 = read_artifact_from_update("MODEL-REF", str(p))
+    assert back2.app == "kmeans"
+    xml = art.to_pmml_xml()
+    assert "ClusteringModel" in xml and "PMML" in xml
+
+
+def test_artifact_inline_tensors():
+    art = ModelArtifact("als", tensors={"Y": np.ones((3, 2), np.float32)})
+    back = ModelArtifact.from_string(art.to_string())
+    np.testing.assert_array_equal(back.tensors["Y"], np.ones((3, 2), np.float32))
