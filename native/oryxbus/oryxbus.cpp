@@ -1,0 +1,127 @@
+// oryxbus — native record-log appender/scanner for the oryx_tpu bus.
+//
+// The bus data plane (oryx_tpu/bus/filelog.py) stores each topic partition as
+// an append-only record log:
+//     [i32 key_len | -1 if null][key utf-8][u32 msg_len][msg utf-8]
+// little-endian. This library provides the hot paths natively:
+//   - oryxbus_append / oryxbus_append_batch: O_APPEND + flock single-writev
+//     record appends, safe across processes
+//   - oryxbus_scan: record-boundary scan for index building, stopping
+//     cleanly at a torn (in-progress) trailing write
+//
+// Exposed to Python via ctypes (oryx_tpu/bus/native.py). Build: `make` here.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/file.h>
+#include <sys/stat.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+extern "C" {
+
+// Append one record. key may be null (key_len ignored then). Returns 0 on
+// success, negative errno on failure.
+int oryxbus_append(const char* path, const char* key, int32_t key_len,
+                   const char* msg, uint32_t msg_len) {
+  int fd = open(path, O_WRONLY | O_APPEND | O_CREAT, 0644);
+  if (fd < 0) return -errno;
+  if (flock(fd, LOCK_EX) != 0) {
+    int e = errno;
+    close(fd);
+    return -e;
+  }
+  int32_t klen = key ? key_len : -1;
+  struct iovec iov[4];
+  int n = 0;
+  iov[n].iov_base = &klen;
+  iov[n++].iov_len = sizeof(klen);
+  if (key && key_len > 0) {
+    iov[n].iov_base = const_cast<char*>(key);
+    iov[n++].iov_len = static_cast<size_t>(key_len);
+  }
+  iov[n].iov_base = &msg_len;
+  iov[n++].iov_len = sizeof(msg_len);
+  if (msg_len > 0) {
+    iov[n].iov_base = const_cast<char*>(msg);
+    iov[n++].iov_len = msg_len;
+  }
+  ssize_t want = 0;
+  for (int i = 0; i < n; i++) want += static_cast<ssize_t>(iov[i].iov_len);
+  struct stat st;
+  off_t pre = (fstat(fd, &st) == 0) ? st.st_size : -1;
+  ssize_t wrote = writev(fd, iov, n);
+  int rc = 0;
+  if (wrote != want) {
+    // Roll back a partial append while we still hold the lock — a torn
+    // record mid-log would stall every scanner at that point forever.
+    if (pre >= 0) (void)ftruncate(fd, pre);
+    rc = -EIO;
+  }
+  flock(fd, LOCK_UN);
+  close(fd);
+  return rc;
+}
+
+// Append a pre-encoded run of records as one locked write (producer batching).
+int oryxbus_append_batch(const char* path, const uint8_t* buf, size_t len) {
+  int fd = open(path, O_WRONLY | O_APPEND | O_CREAT, 0644);
+  if (fd < 0) return -errno;
+  if (flock(fd, LOCK_EX) != 0) {
+    int e = errno;
+    close(fd);
+    return -e;
+  }
+  struct stat st;
+  off_t pre = (fstat(fd, &st) == 0) ? st.st_size : -1;
+  ssize_t wrote = write(fd, buf, len);
+  int rc = 0;
+  if (wrote != static_cast<ssize_t>(len)) {
+    if (pre >= 0) (void)ftruncate(fd, pre);
+    rc = -EIO;
+  }
+  flock(fd, LOCK_UN);
+  close(fd);
+  return rc;
+}
+
+// Scan record boundaries from byte offset start_pos. Fills positions with the
+// byte offset of each complete record found (up to max_positions); writes the
+// byte offset after the last complete record to *scanned_to. Returns the
+// number of records found, or negative errno.
+int64_t oryxbus_scan(const char* path, int64_t start_pos, int64_t* positions,
+                     int64_t max_positions, int64_t* scanned_to) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    int e = errno;
+    close(fd);
+    return -e;
+  }
+  int64_t size = st.st_size;
+  int64_t pos = start_pos;
+  int64_t count = 0;
+  while (pos < size && count < max_positions) {
+    int32_t klen;
+    if (pos + 4 > size ||
+        pread(fd, &klen, 4, pos) != 4)
+      break;
+    int64_t skip = klen > 0 ? klen : 0;
+    uint32_t mlen;
+    if (pos + 4 + skip + 4 > size ||
+        pread(fd, &mlen, 4, pos + 4 + skip) != 4)
+      break;
+    int64_t end = pos + 4 + skip + 4 + static_cast<int64_t>(mlen);
+    if (end > size) break;  // torn trailing write: stop at last full record
+    positions[count++] = pos;
+    pos = end;
+  }
+  *scanned_to = pos;
+  close(fd);
+  return count;
+}
+
+}  // extern "C"

@@ -1,0 +1,16 @@
+"""Message-log bus: the framework's Kafka-equivalent data plane.
+
+The reference wires its three layers together exclusively through two Kafka
+topics plus ZooKeeper offset storage (framework/kafka-util: KafkaUtils.java,
+ConsumeDataIterator.java). Here the same contract — partitioned append-only
+topics, consumer-group offsets, replay from earliest, blocking iteration —
+is provided by pluggable brokers behind one URI scheme:
+
+    mem://<name>    in-process broker (tests; the LocalKafkaBroker analogue)
+    file://<dir>    durable log segments on a shared filesystem, safe for
+                    multi-process producers/consumers (native C++ appender
+                    when built, pure-Python fallback otherwise)
+"""
+
+from oryx_tpu.bus.api import KeyMessage, TopicProducer, ConsumeDataIterator
+from oryx_tpu.bus.broker import Broker, get_broker, topics as topic_admin

@@ -1,0 +1,149 @@
+"""User-visible messaging types: KeyMessage, TopicProducer, blocking consumer.
+
+Mirrors the reference SPI (framework/oryx-api .../api/KeyMessage.java,
+TopicProducer.java) and kafka-util's ConsumeDataIterator
+(.../kafka/util/ConsumeDataIterator.java:36-70): a blocking iterator over a
+topic with exponential poll backoff and wakeup-on-close.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, NamedTuple, TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from oryx_tpu.bus.broker import Broker
+
+
+class KeyMessage(NamedTuple):
+    key: str | None
+    message: str
+
+
+class TopicProducer:
+    """Producer bound to one topic; partitions by key hash like the
+    reference's TopicProducerImpl (framework/oryx-lambda
+    .../lambda/TopicProducerImpl.java)."""
+
+    def __init__(self, broker: "Broker", topic: str):
+        self._broker = broker
+        self._topic = topic
+
+    @property
+    def topic(self) -> str:
+        return self._topic
+
+    def send(self, key: str | None, message: str) -> None:
+        self._broker.send(self._topic, key, message)
+
+    def close(self) -> None:
+        pass
+
+
+_POLL_BACKOFF_START_S = 0.001
+_POLL_BACKOFF_MAX_S = 1.0
+
+
+class ConsumeDataIterator(Iterator[KeyMessage]):
+    """Blocking iterator over a topic for one consumer group.
+
+    start: 'earliest' replays the whole log (how serving/speed rebuild
+    models, ModelManagerListener.java:118-132), 'latest' tails new data,
+    'committed' resumes from stored group offsets falling back to latest
+    (the ZK-offset resume semantics of UpdateOffsetsFn.java:44-58).
+    """
+
+    def __init__(
+        self,
+        broker: "Broker",
+        topic: str,
+        group: str = "default",
+        start: str = "latest",
+        max_poll: int = 500,
+    ):
+        self._broker = broker
+        self._topic = topic
+        self._group = group
+        self._max_poll = max_poll
+        self._closed = threading.Event()
+        # buffer of fetched-but-undelivered records: (partition, offset, km)
+        self._buffer: list[tuple[int, int, KeyMessage]] = []
+        self._buf_i = 0
+        n_parts = broker.num_partitions(topic)
+        if start == "earliest":
+            self._fetch_pos = {p: 0 for p in range(n_parts)}
+        elif start == "latest":
+            self._fetch_pos = dict(enumerate(broker.end_offsets(topic)))
+        elif start == "committed":
+            committed = broker.get_offsets(group, topic)
+            ends = broker.end_offsets(topic)
+            self._fetch_pos = {p: committed.get(p, ends[p]) for p in range(n_parts)}
+        else:
+            raise ValueError(f"bad start: {start!r}")
+        # delivered position trails the fetch position: commit() must record
+        # only what the application has actually consumed, not what sits
+        # prefetched in the buffer (Kafka position semantics)
+        self._delivered_pos = dict(self._fetch_pos)
+
+    def positions(self) -> dict[int, int]:
+        """Next-to-deliver offset per partition (what commit() records)."""
+        return dict(self._delivered_pos)
+
+    def commit(self) -> None:
+        self._broker.commit_offsets(self._group, self._topic, self._delivered_pos)
+
+    def __next__(self) -> KeyMessage:
+        while True:
+            if self._buf_i < len(self._buffer):
+                p, off, km = self._buffer[self._buf_i]
+                self._buf_i += 1
+                self._delivered_pos[p] = off + 1
+                return km
+            if self._closed.is_set():
+                raise StopIteration
+            self._buffer = []
+            self._buf_i = 0
+            backoff = _POLL_BACKOFF_START_S
+            while not self._buffer:
+                if self._closed.is_set():
+                    raise StopIteration
+                for p, pos in list(self._fetch_pos.items()):
+                    recs = self._broker.read(self._topic, p, pos, self._max_poll)
+                    if recs:
+                        self._fetch_pos[p] = recs[-1][0] + 1
+                        self._buffer.extend((p, o, KeyMessage(k, m)) for o, k, m in recs)
+                if not self._buffer:
+                    # exponential backoff 1ms -> 1s, the reference's poll loop
+                    # (ConsumeDataIterator.java:52-62); wait() doubles as wakeup
+                    if self._closed.wait(backoff):
+                        raise StopIteration
+                    backoff = min(backoff * 2, _POLL_BACKOFF_MAX_S)
+
+    def poll_available(self) -> list[KeyMessage]:
+        """Non-blocking drain of everything currently in the log — the
+        micro-batch read used by layer generation loops. Drained records
+        count as delivered."""
+        out: list[KeyMessage] = []
+        for p, off, km in self._buffer[self._buf_i :]:
+            self._delivered_pos[p] = off + 1
+            out.append(km)
+        self._buffer = []
+        self._buf_i = 0
+        for p in list(self._fetch_pos.keys()):
+            while True:
+                recs = self._broker.read(self._topic, p, self._fetch_pos[p], self._max_poll)
+                if not recs:
+                    break
+                self._fetch_pos[p] = recs[-1][0] + 1
+                self._delivered_pos[p] = recs[-1][0] + 1
+                out.extend(KeyMessage(k, m) for _, k, m in recs)
+        return out
+
+    def close(self) -> None:
+        self._closed.set()
+
+    def __enter__(self) -> "ConsumeDataIterator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

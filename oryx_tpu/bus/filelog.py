@@ -1,0 +1,257 @@
+"""Durable file-backed log broker: multi-process pub/sub over a shared
+filesystem.
+
+This is the production data plane standing in for a Kafka cluster on a
+single host / shared filesystem: each topic partition is an append-only
+record log; producers append under an exclusive flock; consumers poll by
+watching the file grow, so separate batch/speed/serving *processes* meet at
+`file://<dir>` exactly like the reference's layers meet at a broker.
+
+Record wire format (shared with the native C++ appender in native/oryxbus):
+
+    [i32 key_len | -1 if null][key utf-8][u32 msg_len][msg utf-8]
+
+little-endian, concatenated; the record offset index is rebuilt by scanning
+on open and extended incrementally as the file grows.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import struct
+import threading
+from pathlib import Path
+from typing import Mapping
+
+from oryx_tpu.bus.broker import Broker, partition_for
+from oryx_tpu.common.ioutil import delete_recursively, mkdirs
+
+_META = "meta.json"
+_I32 = struct.Struct("<i")
+_U32 = struct.Struct("<I")
+
+
+def encode_record(key: str | None, message: str) -> bytes:
+    mb = message.encode("utf-8")
+    if key is None:
+        return _I32.pack(-1) + _U32.pack(len(mb)) + mb
+    kb = key.encode("utf-8")
+    return _I32.pack(len(kb)) + kb + _U32.pack(len(mb)) + mb
+
+
+class _PartitionIndex:
+    """Byte positions of each record in one partition log, extended lazily."""
+
+    def __init__(self, path: Path, native=None):
+        self.path = path
+        self.positions: list[int] = []
+        self.scanned_to = 0
+        self.native = native
+
+    def refresh(self) -> None:
+        try:
+            size = self.path.stat().st_size
+        except FileNotFoundError:
+            return
+        if size <= self.scanned_to:
+            return
+        if self.native is not None:
+            pos_arr, scanned = self.native.scan(str(self.path), self.scanned_to)
+            self.positions.extend(int(p) for p in pos_arr)
+            self.scanned_to = scanned
+            return
+        with open(self.path, "rb") as f:
+            f.seek(self.scanned_to)
+            pos = self.scanned_to
+            while pos < size:
+                head = f.read(4)
+                if len(head) < 4:
+                    break  # torn write in progress; stop at last full record
+                (klen,) = _I32.unpack(head)
+                skip = max(0, klen)
+                f.seek(skip, os.SEEK_CUR)
+                mhead = f.read(4)
+                if len(mhead) < 4:
+                    break
+                (mlen,) = _U32.unpack(mhead)
+                end = pos + 4 + skip + 4 + mlen
+                if end > size:
+                    break
+                f.seek(mlen, os.SEEK_CUR)
+                self.positions.append(pos)
+                pos = end
+            self.scanned_to = pos
+
+    def read(self, offset: int, max_records: int) -> list[tuple[int, str | None, str]]:
+        self.refresh()
+        if offset >= len(self.positions):
+            return []
+        out = []
+        with open(self.path, "rb") as f:
+            for i in range(offset, min(offset + max_records, len(self.positions))):
+                f.seek(self.positions[i])
+                (klen,) = _I32.unpack(f.read(4))
+                key = f.read(klen).decode("utf-8") if klen >= 0 else None
+                (mlen,) = _U32.unpack(f.read(4))
+                msg = f.read(mlen).decode("utf-8")
+                out.append((i, key, msg))
+        return out
+
+
+class FileLogBroker(Broker):
+    def __init__(self, root: str):
+        self.root = mkdirs(root)
+        self._lock = threading.Lock()
+        self._indexes: dict[tuple[str, int], _PartitionIndex] = {}
+        # topic metadata is immutable after create: cache it off the per-send
+        # hot path (invalidated by delete_topic)
+        self._meta_cache: dict[str, dict] = {}
+        self._native = _maybe_native()
+
+    # -- admin -------------------------------------------------------------
+
+    def _topic_dir(self, topic: str) -> Path:
+        if "/" in topic or topic.startswith("_"):
+            raise ValueError(f"bad topic name: {topic!r}")
+        return self.root / topic
+
+    def create_topic(self, topic: str, partitions: int = 1, max_message_bytes: int = 1 << 24) -> None:
+        d = self._topic_dir(topic)
+        if (d / _META).exists():
+            raise ValueError(f"topic exists: {topic}")
+        mkdirs(d)
+        for p in range(max(1, partitions)):
+            (d / f"p{p}.log").touch()
+        # pid-unique tmp + atomic replace: concurrent creators race benignly
+        # (same content wins either way); the exists-check above is advisory
+        tmp = d / f"{_META}.tmp{os.getpid()}"
+        tmp.write_text(json.dumps({"partitions": max(1, partitions), "max_bytes": max_message_bytes}))
+        os.replace(tmp, d / _META)
+
+    def topic_exists(self, topic: str) -> bool:
+        return (self._topic_dir(topic) / _META).exists()
+
+    def delete_topic(self, topic: str) -> None:
+        delete_recursively(self._topic_dir(topic))
+        with self._lock:
+            self._meta_cache.pop(topic, None)
+            for k in [k for k in self._indexes if k[0] == topic]:
+                del self._indexes[k]
+
+    def _meta(self, topic: str) -> dict:
+        cached = self._meta_cache.get(topic)
+        if cached is not None:
+            return cached
+        try:
+            meta = json.loads((self._topic_dir(topic) / _META).read_text())
+        except FileNotFoundError:
+            raise KeyError(f"no such topic: {topic}") from None
+        with self._lock:
+            self._meta_cache[topic] = meta
+        return meta
+
+    def num_partitions(self, topic: str) -> int:
+        return int(self._meta(topic)["partitions"])
+
+    # -- data --------------------------------------------------------------
+
+    def send(self, topic: str, key: str | None, message: str, partition: int | None = None) -> None:
+        meta = self._meta(topic)
+        if len(message.encode("utf-8")) > meta["max_bytes"]:
+            raise ValueError(f"message exceeds max size for {topic}")
+        p = partition if partition is not None else partition_for(key, meta["partitions"])
+        path = self._topic_dir(topic) / f"p{p}.log"
+        if self._native is not None:
+            self._native.append(str(path), key, message)
+            return
+        rec = encode_record(key, message)
+        # O_APPEND + flock: atomic-enough record appends across processes
+        with open(path, "ab") as f:
+            fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+            try:
+                pre = os.fstat(f.fileno()).st_size
+                try:
+                    f.write(rec)
+                    f.flush()
+                except OSError:
+                    # roll back a torn partial append under the lock —
+                    # otherwise every scanner stalls at it forever
+                    os.ftruncate(f.fileno(), pre)
+                    raise
+            finally:
+                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+
+    def _index(self, topic: str, partition: int) -> _PartitionIndex:
+        with self._lock:
+            k = (topic, partition)
+            if k not in self._indexes:
+                self._indexes[k] = _PartitionIndex(
+                    self._topic_dir(topic) / f"p{partition}.log", self._native
+                )
+            return self._indexes[k]
+
+    def read(self, topic: str, partition: int, offset: int, max_records: int) -> list[tuple[int, str | None, str]]:
+        self._meta(topic)
+        idx = self._index(topic, partition)
+        with self._lock:
+            return idx.read(offset, max_records)
+
+    def end_offsets(self, topic: str) -> list[int]:
+        n = self.num_partitions(topic)
+        out = []
+        for p in range(n):
+            idx = self._index(topic, p)
+            with self._lock:
+                idx.refresh()
+                out.append(len(idx.positions))
+        return out
+
+    # -- offsets -----------------------------------------------------------
+
+    def _offsets_path(self, group: str, topic: str) -> Path:
+        d = mkdirs(self.root / "_offsets")
+        safe = f"{group}__{topic}".replace("/", "_")
+        return d / f"{safe}.json"
+
+    def commit_offsets(self, group: str, topic: str, offsets: Mapping[int, int]) -> None:
+        path = self._offsets_path(group, topic)
+        # flock a sidecar so concurrent committers in one group merge rather
+        # than overwrite each other's partition offsets
+        lock_path = path.with_suffix(".lock")
+        with open(lock_path, "w") as lf:
+            fcntl.flock(lf.fileno(), fcntl.LOCK_EX)
+            try:
+                cur = self.get_offsets(group, topic)
+                cur.update({int(k): int(v) for k, v in offsets.items()})
+                tmp = path.with_suffix(f".tmp{os.getpid()}")
+                tmp.write_text(json.dumps({str(k): v for k, v in cur.items()}))
+                os.replace(tmp, path)
+            finally:
+                fcntl.flock(lf.fileno(), fcntl.LOCK_UN)
+
+    def get_offsets(self, group: str, topic: str) -> dict[int, int]:
+        try:
+            raw = json.loads(self._offsets_path(group, topic).read_text())
+        except FileNotFoundError:
+            return {}
+        return {int(k): int(v) for k, v in raw.items()}
+
+
+_NATIVE_CACHE: object | None = None
+_NATIVE_TRIED = False
+
+
+def _maybe_native():
+    """Load the C++ appender (native/oryxbus) if built; else pure Python."""
+    global _NATIVE_CACHE, _NATIVE_TRIED
+    if not _NATIVE_TRIED:
+        _NATIVE_TRIED = True
+        try:
+            from oryx_tpu.bus.native import NativeAppender
+
+            _NATIVE_CACHE = NativeAppender.load()
+        except Exception:
+            _NATIVE_CACHE = None
+    return _NATIVE_CACHE

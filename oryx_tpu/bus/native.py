@@ -1,0 +1,108 @@
+"""ctypes bindings for the native oryxbus appender/scanner (liboryxbus.so).
+
+Built from native/oryxbus/oryxbus.cpp (`make` there). When present, the
+file-log broker routes appends and index scans through it; the pure-Python
+paths in filelog.py remain the fallback so the framework runs unbuilt.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+
+import numpy as np
+
+_LIB_NAMES = ("liboryxbus.so",)
+
+
+def _find_lib() -> str | None:
+    here = Path(__file__).resolve()
+    candidates = [
+        here.parent,
+        here.parent.parent.parent / "native" / "oryxbus",
+    ]
+    env = os.environ.get("ORYXBUS_LIB")
+    if env:
+        candidates.insert(0, Path(env).parent)
+    for d in candidates:
+        for n in _LIB_NAMES:
+            p = d / n
+            if p.exists():
+                return str(p)
+    return None
+
+
+class NativeAppender:
+    _instance: "NativeAppender | None" = None
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        lib.oryxbus_append.restype = ctypes.c_int
+        lib.oryxbus_append.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_int32,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+        ]
+        lib.oryxbus_append_batch.restype = ctypes.c_int
+        lib.oryxbus_append_batch.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t]
+        lib.oryxbus_scan.restype = ctypes.c_int64
+        lib.oryxbus_scan.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+
+    @classmethod
+    def load(cls) -> "NativeAppender":
+        if cls._instance is None:
+            path = _find_lib()
+            if path is None:
+                raise FileNotFoundError("liboryxbus.so not built")
+            cls._instance = cls(ctypes.CDLL(path))
+        return cls._instance
+
+    def append(self, path: str, key: str | None, message: str) -> None:
+        kb = key.encode("utf-8") if key is not None else None
+        mb = message.encode("utf-8")
+        rc = self._lib.oryxbus_append(
+            path.encode(), kb, len(kb) if kb else 0, mb, len(mb)
+        )
+        if rc != 0:
+            raise OSError(-rc, f"oryxbus_append failed for {path}")
+
+    def append_batch(self, path: str, encoded: bytes) -> None:
+        rc = self._lib.oryxbus_append_batch(path.encode(), encoded, len(encoded))
+        if rc != 0:
+            raise OSError(-rc, f"oryxbus_append_batch failed for {path}")
+
+    def scan(self, path: str, start_pos: int, max_records: int | None = None) -> tuple[np.ndarray, int]:
+        """Record byte positions from start_pos + final scanned-to position.
+        The buffer is sized from the unscanned byte span (a record is >= 8
+        bytes) so tail-polling a busy log doesn't allocate megabytes per
+        refresh; loops if the file grew beyond the estimate mid-scan."""
+        positions: list[int] = []
+        pos = start_pos
+        while True:
+            if max_records is None:
+                try:
+                    span = max(0, os.stat(path).st_size - pos)
+                except OSError:
+                    span = 0
+                cap = max(16, span // 8 + 1)
+            else:
+                cap = max_records
+            buf = (ctypes.c_int64 * cap)()
+            scanned = ctypes.c_int64(pos)
+            n = self._lib.oryxbus_scan(path.encode(), pos, buf, cap, ctypes.byref(scanned))
+            if n < 0:
+                raise OSError(-n, f"oryxbus_scan failed for {path}")
+            positions.extend(buf[:n])
+            pos = scanned.value
+            if max_records is not None or n < cap:
+                break
+        return np.asarray(positions, dtype=np.int64), pos

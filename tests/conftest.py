@@ -5,18 +5,27 @@ seed (OryxTest calls RandomManager.useTestSeed) and local stand-ins for the
 distributed substrate — here a virtual 8-device CPU mesh via
 xla_force_host_platform_device_count, the analogue of Spark master=local[3]
 in AbstractLambdaIT.
+
+Note: the environment may import jax at interpreter startup (sitecustomize
+registering a real-TPU PJRT tunnel) — at that point jax has already read
+JAX_PLATFORMS from the original environment, so plain env writes here are
+too late. jax.config.update is the reliable override; XLA_FLAGS still works
+via env because the CPU client is created lazily on first backends() call.
 """
 
 import os
 import sys
 
-# Must be set before jax initializes its backends.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
