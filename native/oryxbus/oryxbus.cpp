@@ -95,9 +95,17 @@ int64_t oryxbus_scan(const char* path, int64_t start_pos, int64_t* positions,
                      int64_t max_positions, int64_t* scanned_to) {
   int fd = open(path, O_RDONLY);
   if (fd < 0) return -errno;
+  // Shared lock: never scan through a writer's in-flight append or its
+  // partial-write rollback window.
+  if (flock(fd, LOCK_SH) != 0) {
+    int e = errno;
+    close(fd);
+    return -e;
+  }
   struct stat st;
   if (fstat(fd, &st) != 0) {
     int e = errno;
+    flock(fd, LOCK_UN);
     close(fd);
     return -e;
   }
@@ -120,6 +128,7 @@ int64_t oryxbus_scan(const char* path, int64_t start_pos, int64_t* positions,
     pos = end;
   }
   *scanned_to = pos;
+  flock(fd, LOCK_UN);
   close(fd);
   return count;
 }

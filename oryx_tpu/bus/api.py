@@ -36,6 +36,11 @@ class TopicProducer:
     def send(self, key: str | None, message: str) -> None:
         self._broker.send(self._topic, key, message)
 
+    def send_batch(self, records) -> None:
+        """Batch append of (key, message) pairs — one lock round-trip per
+        partition on file brokers; used for factor-row floods."""
+        self._broker.send_batch(self._topic, records)
+
     def close(self) -> None:
         pass
 

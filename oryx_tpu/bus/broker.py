@@ -46,6 +46,12 @@ class Broker(ABC):
     @abstractmethod
     def send(self, topic: str, key: str | None, message: str, partition: int | None = None) -> None: ...
 
+    def send_batch(self, topic: str, records, partition: int | None = None) -> None:
+        """Append many (key, message) records; brokers override to batch
+        under one lock. Default just loops send()."""
+        for key, message in records:
+            self.send(topic, key, message, partition)
+
     @abstractmethod
     def read(self, topic: str, partition: int, offset: int, max_records: int) -> list[tuple[int, str | None, str]]:
         """Records at [offset, offset+max_records) as (offset, key, message);

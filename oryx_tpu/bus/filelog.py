@@ -42,19 +42,27 @@ def encode_record(key: str | None, message: str) -> bytes:
 
 
 class _PartitionIndex:
-    """Byte positions of each record in one partition log, extended lazily."""
+    """Byte positions of each record in one partition log, extended lazily.
+    Guarded by its own lock so independent partitions scan concurrently."""
 
     def __init__(self, path: Path, native=None):
         self.path = path
         self.positions: list[int] = []
         self.scanned_to = 0
         self.native = native
+        self.lock = threading.Lock()
 
-    def refresh(self) -> None:
+    def _refresh_locked(self) -> None:
         try:
             size = self.path.stat().st_size
         except FileNotFoundError:
             return
+        if size < self.scanned_to:
+            # the file shrank (a writer rolled back a torn append we may
+            # have indexed mid-flight): rebuild the index from scratch
+            self.positions = []
+            self.scanned_to = 0
+            size = self.path.stat().st_size
         if size <= self.scanned_to:
             return
         if self.native is not None:
@@ -63,41 +71,54 @@ class _PartitionIndex:
             self.scanned_to = scanned
             return
         with open(self.path, "rb") as f:
-            f.seek(self.scanned_to)
-            pos = self.scanned_to
-            while pos < size:
-                head = f.read(4)
-                if len(head) < 4:
-                    break  # torn write in progress; stop at last full record
-                (klen,) = _I32.unpack(head)
-                skip = max(0, klen)
-                f.seek(skip, os.SEEK_CUR)
-                mhead = f.read(4)
-                if len(mhead) < 4:
-                    break
-                (mlen,) = _U32.unpack(mhead)
-                end = pos + 4 + skip + 4 + mlen
-                if end > size:
-                    break
-                f.seek(mlen, os.SEEK_CUR)
-                self.positions.append(pos)
-                pos = end
-            self.scanned_to = pos
+            # shared lock: don't scan through a writer's in-flight append or
+            # its rollback window
+            fcntl.flock(f.fileno(), fcntl.LOCK_SH)
+            try:
+                f.seek(self.scanned_to)
+                pos = self.scanned_to
+                while pos < size:
+                    head = f.read(4)
+                    if len(head) < 4:
+                        break  # torn write in progress; stop at last full record
+                    (klen,) = _I32.unpack(head)
+                    skip = max(0, klen)
+                    f.seek(skip, os.SEEK_CUR)
+                    mhead = f.read(4)
+                    if len(mhead) < 4:
+                        break
+                    (mlen,) = _U32.unpack(mhead)
+                    end = pos + 4 + skip + 4 + mlen
+                    if end > size:
+                        break
+                    f.seek(mlen, os.SEEK_CUR)
+                    self.positions.append(pos)
+                    pos = end
+                self.scanned_to = pos
+            finally:
+                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+
+    def end_offset(self) -> int:
+        with self.lock:
+            self._refresh_locked()
+            return len(self.positions)
 
     def read(self, offset: int, max_records: int) -> list[tuple[int, str | None, str]]:
-        self.refresh()
-        if offset >= len(self.positions):
-            return []
-        out = []
-        with open(self.path, "rb") as f:
-            for i in range(offset, min(offset + max_records, len(self.positions))):
-                f.seek(self.positions[i])
-                (klen,) = _I32.unpack(f.read(4))
-                key = f.read(klen).decode("utf-8") if klen >= 0 else None
-                (mlen,) = _U32.unpack(f.read(4))
-                msg = f.read(mlen).decode("utf-8")
-                out.append((i, key, msg))
-        return out
+        with self.lock:
+            self._refresh_locked()
+            if offset >= len(self.positions):
+                return []
+            span = self.positions[offset : offset + max_records]
+            out = []
+            with open(self.path, "rb") as f:
+                for i, pos in zip(range(offset, offset + len(span)), span):
+                    f.seek(pos)
+                    (klen,) = _I32.unpack(f.read(4))
+                    key = f.read(klen).decode("utf-8") if klen >= 0 else None
+                    (mlen,) = _U32.unpack(f.read(4))
+                    msg = f.read(mlen).decode("utf-8")
+                    out.append((i, key, msg))
+            return out
 
 
 class FileLogBroker(Broker):
@@ -105,9 +126,9 @@ class FileLogBroker(Broker):
         self.root = mkdirs(root)
         self._lock = threading.Lock()
         self._indexes: dict[tuple[str, int], _PartitionIndex] = {}
-        # topic metadata is immutable after create: cache it off the per-send
-        # hot path (invalidated by delete_topic)
-        self._meta_cache: dict[str, dict] = {}
+        # (mtime, meta) per topic: keeps read+parse off the per-send hot
+        # path while noticing cross-process recreation via mtime
+        self._meta_cache: dict[str, tuple[int, dict]] = {}
         self._native = _maybe_native()
 
     # -- admin -------------------------------------------------------------
@@ -141,15 +162,22 @@ class FileLogBroker(Broker):
                 del self._indexes[k]
 
     def _meta(self, topic: str) -> dict:
-        cached = self._meta_cache.get(topic)
-        if cached is not None:
-            return cached
+        path = self._topic_dir(topic) / _META
         try:
-            meta = json.loads((self._topic_dir(topic) / _META).read_text())
+            mtime = path.stat().st_mtime_ns
         except FileNotFoundError:
+            with self._lock:
+                self._meta_cache.pop(topic, None)
             raise KeyError(f"no such topic: {topic}") from None
+        cached = self._meta_cache.get(topic)
+        # revalidate on mtime so a delete+recreate by another process (e.g.
+        # with a different partition count) is noticed — a stat per send
+        # instead of a read+parse per send
+        if cached is not None and cached[0] == mtime:
+            return cached[1]
+        meta = json.loads(path.read_text())
         with self._lock:
-            self._meta_cache[topic] = meta
+            self._meta_cache[topic] = (mtime, meta)
         return meta
 
     def num_partitions(self, topic: str) -> int:
@@ -165,23 +193,52 @@ class FileLogBroker(Broker):
         path = self._topic_dir(topic) / f"p{p}.log"
         if self._native is not None:
             self._native.append(str(path), key, message)
-            return
-        rec = encode_record(key, message)
-        # O_APPEND + flock: atomic-enough record appends across processes
-        with open(path, "ab") as f:
-            fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+        else:
+            self._append_raw(path, encode_record(key, message))
+
+    @staticmethod
+    def _append_raw(path: Path, rec: bytes) -> None:
+        # Unbuffered os.write under O_APPEND + flock: a buffered file object
+        # would re-flush leftover bytes at close() after a failed write,
+        # appending garbage past our rollback. One raw write, and on a short
+        # write roll back to the pre-append size while still holding the
+        # lock — a torn record mid-log would stall every scanner forever.
+        fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
             try:
-                pre = os.fstat(f.fileno()).st_size
+                pre = os.fstat(fd).st_size
                 try:
-                    f.write(rec)
-                    f.flush()
+                    wrote = os.write(fd, rec)
                 except OSError:
-                    # roll back a torn partial append under the lock —
-                    # otherwise every scanner stalls at it forever
-                    os.ftruncate(f.fileno(), pre)
+                    os.ftruncate(fd, pre)
                     raise
+                if wrote != len(rec):
+                    os.ftruncate(fd, pre)
+                    raise OSError(f"short append to {path}")
             finally:
-                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+                fcntl.flock(fd, fcntl.LOCK_UN)
+        finally:
+            os.close(fd)
+
+    def send_batch(self, topic: str, records, partition: int | None = None) -> None:
+        """Append many (key, message) records with one lock acquisition per
+        partition — the path for high-volume publishes like streaming every
+        ALS factor row to the update topic."""
+        meta = self._meta(topic)
+        by_part: dict[int, list[bytes]] = {}
+        for key, message in records:
+            if len(message.encode("utf-8")) > meta["max_bytes"]:
+                raise ValueError(f"message exceeds max size for {topic}")
+            p = partition if partition is not None else partition_for(key, meta["partitions"])
+            by_part.setdefault(p, []).append(encode_record(key, message))
+        for p, recs in by_part.items():
+            path = self._topic_dir(topic) / f"p{p}.log"
+            blob = b"".join(recs)
+            if self._native is not None:
+                self._native.append_batch(str(path), blob)
+            else:
+                self._append_raw(path, blob)
 
     def _index(self, topic: str, partition: int) -> _PartitionIndex:
         with self._lock:
@@ -194,26 +251,21 @@ class FileLogBroker(Broker):
 
     def read(self, topic: str, partition: int, offset: int, max_records: int) -> list[tuple[int, str | None, str]]:
         self._meta(topic)
-        idx = self._index(topic, partition)
-        with self._lock:
-            return idx.read(offset, max_records)
+        return self._index(topic, partition).read(offset, max_records)
 
     def end_offsets(self, topic: str) -> list[int]:
         n = self.num_partitions(topic)
-        out = []
-        for p in range(n):
-            idx = self._index(topic, p)
-            with self._lock:
-                idx.refresh()
-                out.append(len(idx.positions))
-        return out
+        return [self._index(topic, p).end_offset() for p in range(n)]
 
     # -- offsets -----------------------------------------------------------
 
     def _offsets_path(self, group: str, topic: str) -> Path:
+        from urllib.parse import quote
+
         d = mkdirs(self.root / "_offsets")
-        safe = f"{group}__{topic}".replace("/", "_")
-        return d / f"{safe}.json"
+        # percent-encode each part: '@' can't appear in quoted output, so
+        # distinct (group, topic) pairs can't collide on one file
+        return d / f"{quote(group, safe='')}@{quote(topic, safe='')}.json"
 
     def commit_offsets(self, group: str, topic: str, offsets: Mapping[int, int]) -> None:
         path = self._offsets_path(group, topic)

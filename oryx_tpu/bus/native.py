@@ -17,14 +17,14 @@ _LIB_NAMES = ("liboryxbus.so",)
 
 
 def _find_lib() -> str | None:
+    env = os.environ.get("ORYXBUS_LIB")
+    if env and Path(env).exists():
+        return env
     here = Path(__file__).resolve()
     candidates = [
         here.parent,
         here.parent.parent.parent / "native" / "oryxbus",
     ]
-    env = os.environ.get("ORYXBUS_LIB")
-    if env:
-        candidates.insert(0, Path(env).parent)
     for d in candidates:
         for n in _LIB_NAMES:
             p = d / n

@@ -1,0 +1,11 @@
+"""JAX math tier — the framework's "native" compute (XLA-compiled kernels).
+
+Replaces the reference's JVM math stack: VectorMath/LinearSystemSolver
+(framework/oryx-common .../math/), the incremental-ALS fold-in
+(app/oryx-app-common .../als/ALSUtils.java), and the Spark-MLlib trainers
+(app/oryx-app-mllib: ALSUpdate/KMeansUpdate/RDFUpdate) — re-designed as
+pjit-sharded JAX programs rather than RDD pipelines.
+"""
+
+from oryx_tpu.ops.vector import cosine_similarity, dot, norm, gram, random_unit_vectors
+from oryx_tpu.ops.solver import SingularMatrixError, Solver, make_solver

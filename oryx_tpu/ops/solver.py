@@ -1,0 +1,92 @@
+"""Linear-system solves with singularity detection.
+
+The reference factors Y^T.Y once (RRQR with a rank check) and reuses the
+factorization for many right-hand sides (LinearSystemSolver.getSolver,
+framework/oryx-common .../math/LinearSystemSolver.java:38-80; Solver.java:
+31-48), raising on singular systems. TPU-native equivalent: Cholesky of the
+(symmetric PSD) Gram matrix, cached as its factor; solves are batched
+triangular solves that vmap cleanly. Singularity is flagged by NaNs in the
+factor or an extreme diagonal condition estimate — checked on host at
+factorization time, mirroring the reference's apparent-rank test.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SingularMatrixError(Exception):
+    """Raised when the system is singular/ill-conditioned
+    (reference SingularMatrixSolverException)."""
+
+
+_MAX_COND = 1e10
+
+
+@jax.jit
+def _cholesky(a):
+    return jnp.linalg.cholesky(a.astype(jnp.float32))
+
+
+@jax.jit
+def _chol_solve(chol, b):
+    b = b.astype(jnp.float32)
+    y = jax.scipy.linalg.solve_triangular(chol, b, lower=True)
+    return jax.scipy.linalg.solve_triangular(chol.T, y, lower=False)
+
+
+@dataclass(frozen=True)
+class Solver:
+    """A factored SPD system; solve() accepts one RHS vector or a batch."""
+
+    chol: jax.Array
+
+    def solve(self, b):
+        x = _chol_solve(self.chol, jnp.asarray(b).T).T
+        return x
+
+    def solve_f(self, b) -> np.ndarray:
+        return np.asarray(self.solve(b), dtype=np.float32)
+
+
+def make_solver(packed_or_full) -> Solver:
+    """Factor an SPD matrix (e.g. Y^T.Y). Accepts the full [K,K] matrix or
+    the packed lower-triangular row-major form the reference passes around
+    (LinearSystemSolver.java:38-56)."""
+    a = np.asarray(packed_or_full, dtype=np.float32)
+    if a.ndim == 1:
+        # packed lower triangle -> full symmetric
+        n = int((np.sqrt(8 * a.size + 1) - 1) / 2)
+        if n * (n + 1) // 2 != a.size:
+            raise ValueError(f"not a packed triangular size: {a.size}")
+        full = np.zeros((n, n), dtype=np.float32)
+        full[np.tril_indices(n)] = a
+        full = full + np.tril(full, -1).T
+        a = full
+    chol = _cholesky(jnp.asarray(a))
+    chol_np = np.asarray(chol)
+    if not np.all(np.isfinite(chol_np)):
+        raise SingularMatrixError("Cholesky failed: matrix not positive definite")
+    d = np.abs(np.diag(chol_np))
+    if d.min() <= 0 or (d.max() / max(d.min(), 1e-30)) ** 2 > _MAX_COND:
+        raise SingularMatrixError(
+            f"ill-conditioned system (cond~{(d.max() / max(d.min(), 1e-30)) ** 2:.2e})"
+        )
+    return Solver(chol)
+
+
+@jax.jit
+def batched_spd_solve(a, b):
+    """Solve a_i x_i = b_i for a batch of small SPD systems [N,K,K],[N,K].
+    The per-user normal-equation solve at the heart of ALS; vmapped
+    Cholesky keeps it on-device with static shapes."""
+    chol = jnp.linalg.cholesky(a.astype(jnp.float32))
+    y = jax.scipy.linalg.solve_triangular(chol, b.astype(jnp.float32)[..., None], lower=True)
+    x = jax.scipy.linalg.solve_triangular(
+        jnp.swapaxes(chol, -1, -2), y, lower=False
+    )
+    return x[..., 0]

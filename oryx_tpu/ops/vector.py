@@ -1,0 +1,48 @@
+"""Vector/matrix primitives.
+
+The reference's VectorMath (framework/oryx-common .../math/VectorMath.java:
+37-129: dot, norm, cosineSimilarity, transposeTimesSelf, randomVectorF) as
+jitted JAX ops. transposeTimesSelf — the Gram matrix X^T.X that ALS needs
+every half-iteration — is here a single einsum: under pjit with X sharded
+over the "data" axis XLA lowers it to per-shard matmuls + psum, which is
+exactly the partition-sum the reference hand-rolled in
+PartitionedFeatureVectors.getVTV (…/als/PartitionedFeatureVectors.java:209-213).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from oryx_tpu.common.rng import RandomManager
+
+
+@jax.jit
+def dot(x, y):
+    return jnp.vdot(x, y)
+
+
+@jax.jit
+def norm(x):
+    return jnp.linalg.norm(x)
+
+
+@jax.jit
+def cosine_similarity(x, y, norm_y=None):
+    ny = jnp.linalg.norm(y) if norm_y is None else norm_y
+    return jnp.vdot(x, y) / (jnp.linalg.norm(x) * ny)
+
+
+@jax.jit
+def gram(x):
+    """X^T.X in float32 accumulation (bf16-friendly inputs upcast)."""
+    x = x.astype(jnp.float32)
+    return jnp.einsum("uk,ul->kl", x, x, precision=jax.lax.Precision.HIGHEST)
+
+
+def random_unit_vectors(n: int, dim: int, key=None):
+    """n random unit-norm rows (VectorMath.randomVectorF + normalization),
+    used for LSH hyperplanes and factor init."""
+    key = key if key is not None else RandomManager.get_key()
+    v = jax.random.normal(key, (n, dim), dtype=jnp.float32)
+    return v / jnp.linalg.norm(v, axis=1, keepdims=True)

@@ -1,0 +1,16 @@
+"""Device mesh + sharding helpers (the distributed compute plane).
+
+Replaces the reference's intra-job Spark plane (shuffle/broadcast/collect,
+SURVEY.md §5 "Distributed communication backend") with XLA collectives over
+ICI/DCN: arrays are laid out on a jax.sharding.Mesh and jit inserts
+psum/all_gather where the sharded einsums demand them.
+"""
+
+from oryx_tpu.parallel.mesh import (
+    MeshSpec,
+    data_sharding,
+    host_mesh,
+    make_mesh,
+    replicated,
+    shard_array,
+)

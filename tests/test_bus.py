@@ -203,3 +203,14 @@ def test_native_appender_if_built(tmp_path):
     br.create_topic("T", partitions=1)
     br.send("T", "nk", "via broker")
     assert [(k, m) for _, k, m in br.read("T", 0, 0, 10)] == [("nk", "via broker")]
+
+
+def test_send_batch(broker):
+    broker.create_topic("B", partitions=2)
+    broker.send_batch("B", [(f"k{i}", f"m{i}") for i in range(20)])
+    total = sum(broker.end_offsets("B"))
+    assert total == 20
+    msgs = set()
+    for p in range(2):
+        msgs |= {m for _, _, m in broker.read("B", p, 0, 100)}
+    assert msgs == {f"m{i}" for i in range(20)}

@@ -1,0 +1,263 @@
+"""Tests for the math/ops tier: vector primitives, solver, ALS training,
+fold-in, scoring — incl. an SPMD run on the virtual 8-device mesh.
+
+Statistical/behavioral assertions in the style of the reference's math and
+ALS tests (LinearSystemSolverTest, ALSUtilsTest, ALSUpdateIT — SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from oryx_tpu.ops import (
+    SingularMatrixError,
+    cosine_similarity,
+    dot,
+    gram,
+    make_solver,
+    norm,
+    random_unit_vectors,
+)
+from oryx_tpu.ops.als import (
+    InteractionData,
+    aggregate_interactions,
+    build_padded_lists,
+    compute_target_qui,
+    compute_updated_xu,
+    fold_in_batch,
+    topk_dot,
+    train_als,
+)
+from oryx_tpu.parallel import make_mesh, MeshSpec
+
+
+# ---- vector ---------------------------------------------------------------
+
+def test_vector_primitives():
+    x = jnp.array([1.0, 2.0, 3.0])
+    y = jnp.array([4.0, 5.0, 6.0])
+    assert float(dot(x, y)) == pytest.approx(32.0)
+    assert float(norm(x)) == pytest.approx(np.sqrt(14.0))
+    assert float(cosine_similarity(x, x)) == pytest.approx(1.0, abs=1e-6)
+    assert float(cosine_similarity(x, -x)) == pytest.approx(-1.0, abs=1e-6)
+
+
+def test_gram_matches_numpy():
+    x = np.random.default_rng(0).normal(size=(50, 7)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(gram(jnp.asarray(x))), x.T @ x, rtol=1e-4)
+
+
+def test_random_unit_vectors():
+    v = np.asarray(random_unit_vectors(10, 5))
+    np.testing.assert_allclose(np.linalg.norm(v, axis=1), 1.0, rtol=1e-5)
+
+
+# ---- solver ---------------------------------------------------------------
+
+def test_solver_spd_roundtrip():
+    rng = np.random.default_rng(1)
+    m = rng.normal(size=(6, 6))
+    a = m.T @ m + 0.1 * np.eye(6)
+    s = make_solver(a)
+    b = rng.normal(size=6)
+    np.testing.assert_allclose(s.solve_f(b), np.linalg.solve(a, b), rtol=1e-3, atol=1e-4)
+
+
+def test_solver_packed_triangular_input():
+    a = np.array([[4.0, 1.0], [1.0, 3.0]])
+    packed = np.array([4.0, 1.0, 3.0])  # row-major lower triangle
+    s = make_solver(packed)
+    b = np.array([1.0, 2.0])
+    np.testing.assert_allclose(s.solve_f(b), np.linalg.solve(a, b), rtol=1e-4)
+
+
+def test_solver_rejects_singular():
+    with pytest.raises(SingularMatrixError):
+        make_solver(np.zeros((4, 4)))
+    with pytest.raises(SingularMatrixError):
+        make_solver(np.ones((3, 3)))  # rank-1
+
+
+# ---- input prep -----------------------------------------------------------
+
+def test_aggregate_implicit_sums_and_nan_delete():
+    users = np.array(["u1", "u1", "u2", "u1"])
+    items = np.array(["i1", "i1", "i2", "i2"])
+    vals = np.array([1.0, 2.0, 5.0, np.nan])
+    d = aggregate_interactions(users, items, vals, implicit=True)
+    got = {(d.user_ids[u], d.item_ids[i]): v for u, i, v in zip(d.users, d.items, d.values)}
+    assert got == {("u1", "i1"): pytest.approx(3.0), ("u2", "i2"): pytest.approx(5.0)}
+    # (u1,i2) killed by the NaN delete marker
+
+
+def test_aggregate_explicit_last_wins():
+    users = np.array(["u1", "u1", "u1"])
+    items = np.array(["i1", "i1", "i1"])
+    vals = np.array([5.0, 1.0, 3.0])
+    ts = np.array([100, 300, 200])
+    d = aggregate_interactions(users, items, vals, ts, implicit=False)
+    assert len(d.values) == 1 and d.values[0] == pytest.approx(1.0)  # ts=300 wins
+
+
+def test_aggregate_decay_and_zero_threshold():
+    day = 86_400_000
+    users = np.array(["u", "u"])
+    items = np.array(["a", "b"])
+    vals = np.array([1.0, 1.0])
+    ts = np.array([0, 10 * day])  # first is 10 days older
+    d = aggregate_interactions(
+        users, items, vals, ts, implicit=True,
+        decay_factor=0.5, zero_threshold=0.01, now_ms=10 * day,
+    )
+    got = {d.item_ids[i]: v for i, v in zip(d.items, d.values)}
+    assert got["b"] == pytest.approx(1.0)
+    assert "a" not in got or got["a"] < 0.01  # decayed below threshold -> dropped
+
+
+def test_padded_lists_shapes_and_cap():
+    entity = np.array([0, 0, 0, 2, 2], dtype=np.int32)
+    other = np.array([1, 2, 3, 4, 5], dtype=np.int32)
+    vals = np.array([0.5, 3.0, 1.0, 2.0, 1.0], dtype=np.float32)
+    idx, val, mask = build_padded_lists(entity, other, vals, n_entities=3, cap=2)
+    assert idx.shape == (3, 2)
+    # entity 0 keeps its 2 largest-|value| interactions (3.0 and 1.0)
+    kept = set(val[0][mask[0] > 0].tolist())
+    assert kept == {3.0, 1.0}
+    assert mask[1].sum() == 0  # entity 1 had nothing
+
+
+# ---- training -------------------------------------------------------------
+
+def _synthetic_implicit(n_u=24, n_i=16, k=4, seed=0):
+    """Block-structured interactions: users and items in 4 groups; a user
+    interacts mostly within their group."""
+    rng = np.random.default_rng(seed)
+    users, items, vals = [], [], []
+    for u in range(n_u):
+        g = u % 4
+        for i in range(n_i):
+            if i % 4 == g and rng.random() < 0.9:
+                users.append(f"u{u}"); items.append(f"i{i}"); vals.append(1.0 + rng.random())
+            elif rng.random() < 0.05:
+                users.append(f"u{u}"); items.append(f"i{i}"); vals.append(0.5)
+    return aggregate_interactions(
+        np.array(users), np.array(items), np.array(vals, dtype=np.float64), implicit=True
+    )
+
+
+def test_train_als_implicit_recovers_structure():
+    data = _synthetic_implicit()
+    m = train_als(data, features=4, lam=0.01, alpha=10.0, iterations=8, implicit=True)
+    assert m.x.shape == (data.n_users, 4) and m.y.shape == (data.n_items, 4)
+    scores = m.x @ m.y.T
+    # in-group items should outscore out-of-group items on average
+    in_group, out_group = [], []
+    for u in range(data.n_users):
+        ug = int(data.user_ids[u][1:]) % 4
+        for i in range(data.n_items):
+            ig = int(data.item_ids[i][1:]) % 4
+            (in_group if ig == ug else out_group).append(scores[u, i])
+    assert np.mean(in_group) > np.mean(out_group) + 0.2
+
+
+def test_train_als_explicit_fits_ratings():
+    rng = np.random.default_rng(3)
+    xs = rng.normal(size=(20, 3))
+    ys = rng.normal(size=(15, 3))
+    users, items, vals = [], [], []
+    for u in range(20):
+        for i in rng.choice(15, size=10, replace=False):
+            users.append(f"u{u:02d}"); items.append(f"i{i:02d}")
+            vals.append(float(xs[u] @ ys[i]))
+    data = aggregate_interactions(
+        np.array(users), np.array(items), np.array(vals), implicit=False
+    )
+    m = train_als(data, features=3, lam=0.005, alpha=1.0, iterations=12, implicit=False)
+    # reconstruct observed ratings
+    umap = {u: j for j, u in enumerate(data.user_ids)}
+    imap = {i: j for j, i in enumerate(data.item_ids)}
+    errs = [
+        (m.x[umap[u]] @ m.y[imap[i]] - v) ** 2
+        for u, i, v in zip(users, items, vals)
+    ]
+    rmse = np.sqrt(np.mean(errs))
+    assert rmse < 0.35, rmse
+
+
+def test_train_als_on_8_device_mesh():
+    """SPMD path: same data, sharded over the virtual 8-device mesh; result
+    must be close to the single-device run (same seed)."""
+    data = _synthetic_implicit()
+    mesh = make_mesh(MeshSpec(data=8, model=1))
+    from oryx_tpu.common.rng import RandomManager
+
+    RandomManager.use_test_seed(7)
+    k1 = RandomManager.get_key()
+    m1 = train_als(data, features=4, lam=0.01, alpha=10.0, iterations=4,
+                   implicit=True, seed_key=k1)
+    RandomManager.use_test_seed(7)
+    k2 = RandomManager.get_key()
+    m2 = train_als(data, features=4, lam=0.01, alpha=10.0, iterations=4,
+                   implicit=True, mesh=mesh, seed_key=k2)
+    s1 = m1.x @ m1.y.T
+    s2 = m2.x @ m2.y.T
+    np.testing.assert_allclose(s1, s2, rtol=0.3, atol=0.15)
+
+
+# ---- fold-in --------------------------------------------------------------
+
+def test_target_qui_semantics():
+    # positive value moves target from current toward 1
+    t = float(compute_target_qui(1.0, 0.0, implicit=True))
+    assert t == pytest.approx(0.5)  # 0 + (1/2)*1
+    # already >= 1: no change (NaN)
+    assert np.isnan(float(compute_target_qui(1.0, 1.5, implicit=True)))
+    # negative value moves toward 0
+    t = float(compute_target_qui(-1.0, 1.0, implicit=True))
+    assert t == pytest.approx(0.5)
+    # explicit passes through
+    assert float(compute_target_qui(3.5, 0.2, implicit=False)) == pytest.approx(3.5)
+
+
+def test_fold_in_moves_prediction_toward_target():
+    rng = np.random.default_rng(5)
+    y = rng.normal(size=(30, 6)).astype(np.float32)
+    yty = y.T @ y + 0.01 * np.eye(6, dtype=np.float32)
+    chol = np.linalg.cholesky(yty).astype(np.float32)
+    xu = rng.normal(size=6).astype(np.float32) * 0.1
+    yi = y[3]
+    before = float(xu @ yi)
+    new_xu = np.asarray(compute_updated_xu(
+        jnp.asarray(chol), jnp.float32(2.0), jnp.asarray(xu), jnp.asarray(yi),
+        implicit=True,
+    ))
+    after = float(new_xu @ yi)
+    assert after > before  # positive interaction raises predicted strength
+    assert after <= 1.05   # toward (not past) 1
+
+
+def test_fold_in_batch_shapes():
+    rng = np.random.default_rng(6)
+    y = rng.normal(size=(10, 4)).astype(np.float32)
+    chol = np.linalg.cholesky(y.T @ y + 0.1 * np.eye(4)).astype(np.float32)
+    xs = rng.normal(size=(5, 4)).astype(np.float32)
+    yis = y[:5]
+    vals = np.ones(5, dtype=np.float32)
+    out = np.asarray(fold_in_batch(jnp.asarray(chol), jnp.asarray(vals),
+                                   jnp.asarray(xs), jnp.asarray(yis)))
+    assert out.shape == (5, 4)
+    assert np.all(np.isfinite(out))
+
+
+# ---- scoring --------------------------------------------------------------
+
+def test_topk_dot_with_exclusion():
+    y = jnp.asarray(np.diag([5.0, 4.0, 3.0, 2.0, 1.0]).astype(np.float32))
+    xu = jnp.ones(5, dtype=jnp.float32)
+    vals, idx = topk_dot(xu, y, k=3)
+    assert idx.tolist() == [0, 1, 2]
+    excl = jnp.asarray([True, False, False, False, False])
+    vals, idx = topk_dot(xu, y, k=3, exclude_mask=excl)
+    assert idx.tolist() == [1, 2, 3]
