@@ -1,0 +1,102 @@
+"""User-facing SPI — the three interfaces an application implements.
+
+Mirrors the reference's framework/oryx-api contract (SURVEY.md §2.2):
+  - BatchLayerUpdate.run_update: invoked once per batch generation with the
+    new-data window, all past data, the model dir, and an update-topic
+    producer (reference .../api/batch/BatchLayerUpdate.java)
+  - SpeedModelManager: consume() runs forever on the update-topic listener
+    thread; build_updates() turns each micro-batch into update messages
+    (reference .../api/speed/SpeedModelManager.java)
+  - ServingModelManager / ServingModel: consume() likewise; get_model() is
+    read by REST resources; fraction_loaded gates readiness
+    (reference .../api/serving/ServingModelManager.java, ServingModel.java)
+
+Data items are KeyMessage(key, message) pairs; "RDDs" are plain sequences —
+the heavy lifting happens inside jitted ops, not in the carrier collection.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Iterable, Iterator, Sequence
+
+from oryx_tpu.bus.api import KeyMessage, TopicProducer
+from oryx_tpu.common.config import Config
+
+
+class BatchLayerUpdate(ABC):
+    """Implemented by the batch tier of an app; config-named via
+    oryx.batch.update-class."""
+
+    @abstractmethod
+    def run_update(
+        self,
+        timestamp_ms: int,
+        new_data: Sequence[KeyMessage],
+        past_data: Sequence[KeyMessage],
+        model_dir: str,
+        update_producer: TopicProducer,
+    ) -> None: ...
+
+
+class SpeedModelManager(ABC):
+    """Implemented by the speed tier; config-named via
+    oryx.speed.model-manager-class."""
+
+    @abstractmethod
+    def consume(self, updates: Iterator[KeyMessage]) -> None:
+        """Read models/updates from the update topic forever."""
+
+    @abstractmethod
+    def build_updates(self, new_data: Sequence[KeyMessage]) -> Iterable[tuple[str, str]]:
+        """Turn one micro-batch of input into (key, message) updates."""
+
+    def close(self) -> None:
+        pass
+
+
+class AbstractSpeedModelManager(SpeedModelManager):
+    """Dispatches consume() per message, the common pattern."""
+
+    def consume(self, updates: Iterator[KeyMessage]) -> None:
+        for km in updates:
+            self.consume_key_message(km.key, km.message)
+
+    @abstractmethod
+    def consume_key_message(self, key: str | None, message: str) -> None: ...
+
+
+class ServingModel(ABC):
+    @abstractmethod
+    def fraction_loaded(self) -> float:
+        """1.0 when fully loaded; serving returns 503 below the configured
+        min-model-load-fraction (reference ServingModel.getFractionLoaded)."""
+
+
+class ServingModelManager(ABC):
+    """Implemented by the serving tier; config-named via
+    oryx.serving.model-manager-class."""
+
+    def __init__(self, config: Config):
+        self.config = config
+
+    @abstractmethod
+    def consume(self, updates: Iterator[KeyMessage]) -> None: ...
+
+    @abstractmethod
+    def get_model(self) -> ServingModel | None: ...
+
+    def is_read_only(self) -> bool:
+        return self.config.get_bool("oryx.serving.api.read-only", False)
+
+    def close(self) -> None:
+        pass
+
+
+class AbstractServingModelManager(ServingModelManager):
+    def consume(self, updates: Iterator[KeyMessage]) -> None:
+        for km in updates:
+            self.consume_key_message(km.key, km.message)
+
+    @abstractmethod
+    def consume_key_message(self, key: str | None, message: str) -> None: ...

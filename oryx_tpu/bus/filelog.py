@@ -177,6 +177,11 @@ class FileLogBroker(Broker):
             return cached[1]
         meta = json.loads(path.read_text())
         with self._lock:
+            if topic in self._meta_cache:
+                # topic was recreated by another process: cached partition
+                # indexes point into the old logs — drop them
+                for k in [k for k in self._indexes if k[0] == topic]:
+                    del self._indexes[k]
             self._meta_cache[topic] = (mtime, meta)
         return meta
 

@@ -1,0 +1,172 @@
+"""The batch-training harness: hyperparam candidates -> build -> eval ->
+publish the winner.
+
+TPU-native MLUpdate (reference framework/oryx-ml .../ml/MLUpdate.java:60-378).
+Per generation it: splits train/test (random by default, overridable — ALS
+splits by time), chooses hyperparameter combos, builds + evaluates each
+candidate (sequential by default: on a TPU the device is the scarce
+resource, concurrent builds just contend — eval parallelism is for CPU-side
+eval), applies the acceptance threshold, atomically renames the winner into
+model_dir/<timestamp>, and publishes it to the update topic inline
+("MODEL") or as a path reference ("MODEL-REF") when it exceeds the topic's
+max message size (MLUpdate.java:212-231), then streams any oversized extras
+via publish_additional_model_data (e.g. ALS factor rows).
+"""
+
+from __future__ import annotations
+
+import logging
+from abc import abstractmethod
+from pathlib import Path
+from typing import Any, Sequence
+
+import numpy as np
+
+from oryx_tpu.api import BatchLayerUpdate
+from oryx_tpu.bus.api import KeyMessage, TopicProducer
+from oryx_tpu.common.artifact import ModelArtifact
+from oryx_tpu.common.config import Config
+from oryx_tpu.common.executil import collect_in_parallel
+from oryx_tpu.common.ioutil import atomic_rename, delete_recursively, mkdirs, strip_scheme
+from oryx_tpu.common.rng import RandomManager
+from oryx_tpu.ml.hyperparams import choose_combos
+
+log = logging.getLogger(__name__)
+
+
+class MLUpdate(BatchLayerUpdate):
+    def __init__(self, config: Config):
+        self.config = config
+        self.test_fraction = config.get_float("oryx.ml.eval.test-fraction", 0.1)
+        self.candidates = config.get_int("oryx.ml.eval.candidates", 1)
+        self.search = config.get_string("oryx.ml.eval.hyperparam-search", "random")
+        self.eval_parallelism = config.get_int("oryx.ml.eval.parallelism", 1)
+        self.threshold = config.get("oryx.ml.eval.threshold", None)
+        self.max_message_size = config.get_int("oryx.update-topic.message.max-size", 1 << 24)
+
+    # ---- hooks an app implements -----------------------------------------
+
+    @abstractmethod
+    def build_model(self, train: Sequence[KeyMessage], hyperparams: dict[str, Any]) -> ModelArtifact:
+        """Train one candidate on the train split."""
+
+    @abstractmethod
+    def evaluate(
+        self,
+        model: ModelArtifact,
+        train: Sequence[KeyMessage],
+        test: Sequence[KeyMessage],
+    ) -> float:
+        """Bigger-is-better eval of a candidate on held-out data; NaN = bad."""
+
+    def hyperparam_ranges(self) -> dict[str, Any]:
+        """Config-valued hyperparameter ranges (name -> scalar/list/dict)."""
+        return {}
+
+    def split_train_test(
+        self, data: Sequence[KeyMessage]
+    ) -> tuple[Sequence[KeyMessage], Sequence[KeyMessage]]:
+        """Random holdout by test-fraction (MLUpdate.java:370-376); apps
+        with temporal data override to split by time."""
+        if self.test_fraction <= 0 or len(data) == 0:
+            return data, []
+        rng = RandomManager.get_random()
+        mask = rng.random(len(data)) < self.test_fraction
+        train = [d for d, m in zip(data, mask) if not m]
+        test = [d for d, m in zip(data, mask) if m]
+        return train, test
+
+    def publish_additional_model_data(
+        self,
+        model: ModelArtifact,
+        model_path: str,
+        producer: TopicProducer,
+    ) -> None:
+        """Hook for streaming data too large for the artifact message (ALS
+        streams every factor row here, MLUpdate.java:233-236)."""
+
+    # ---- the harness -----------------------------------------------------
+
+    def run_update(
+        self,
+        timestamp_ms: int,
+        new_data: Sequence[KeyMessage],
+        past_data: Sequence[KeyMessage],
+        model_dir: str,
+        update_producer: TopicProducer,
+    ) -> None:
+        data = list(past_data) + list(new_data)
+        if not data:
+            log.info("no data at generation %d; skipping model build", timestamp_ms)
+            return
+        train, test = self.split_train_test(data)
+        if not train:
+            train, test = data, []
+        combos = choose_combos(self.hyperparam_ranges(), self.candidates, self.search)
+
+        root = Path(strip_scheme(model_dir))
+        cand_root = mkdirs(root / ".candidates" / str(timestamp_ms))
+
+        def build_and_eval(i: int) -> tuple[float, Path | None]:
+            try:
+                model = self.build_model(train, combos[i])
+                cand_dir = model.write(cand_root / str(i))
+                score = (
+                    self.evaluate(model, train, test) if test else float("nan")
+                )
+                log.info("candidate %d %s -> eval %s", i, combos[i], score)
+                return score, cand_dir
+            except Exception:
+                log.exception("candidate %d failed", i)
+                return float("nan"), None
+
+        results = collect_in_parallel(len(combos), build_and_eval, self.eval_parallelism)
+
+        best_i, best_score = -1, float("-inf")
+        for i, (score, path) in enumerate(results):
+            if path is None:
+                continue
+            if np.isnan(score):
+                # no test data / failed eval: candidate is acceptable only
+                # if nothing scored beats it (mirror of the reference's
+                # NaN-tolerant pickBest)
+                if best_i < 0:
+                    best_i = i
+            elif score > best_score:
+                best_i, best_score = i, score
+        if best_i < 0:
+            delete_recursively(cand_root)
+            raise RuntimeError("no model candidate built successfully")
+
+        if (
+            self.threshold is not None
+            and np.isfinite(best_score)  # only gate actually-evaluated models:
+            # a NaN-pick leaves best_score=-inf, which must not block publication
+            and best_score < float(self.threshold)
+        ):
+            log.warning(
+                "best eval %.6f below threshold %s; not publishing model",
+                best_score, self.threshold,
+            )
+            delete_recursively(cand_root)
+            return
+
+        final_dir = root / str(timestamp_ms)
+        delete_recursively(final_dir)
+        atomic_rename(results[best_i][1], final_dir)
+        delete_recursively(root / ".candidates")
+
+        model = ModelArtifact.read(final_dir)
+        self.publish_model(model, str(final_dir), update_producer)
+        self.publish_additional_model_data(model, str(final_dir), update_producer)
+
+    def publish_model(
+        self, model: ModelArtifact, model_path: str, producer: TopicProducer
+    ) -> None:
+        """Inline when small enough, else a path reference
+        (MLUpdate.java:212-231)."""
+        serialized = model.to_string()
+        if len(serialized.encode("utf-8")) <= self.max_message_size:
+            producer.send("MODEL", serialized)
+        else:
+            producer.send("MODEL-REF", model_path)

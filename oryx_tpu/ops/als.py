@@ -234,13 +234,17 @@ def als_train_jit(
     iterations). All shapes static; shard u_* over users and i_* over items
     on the mesh "data" axis and XLA threads the collectives through."""
 
-    def body(y, _):
+    def body(carry, _):
+        _, y = carry
         x = _half_step(y, gram(y), u_idx, u_val, u_mask, lam, alpha, implicit, block)
         y_new = _half_step(x, gram(x), i_idx, i_val, i_mask, lam, alpha, implicit, block)
-        return y_new, x
+        # x rides in the carry, NOT a per-step scan output: stacking it
+        # would multiply peak factor memory by the iteration count
+        return (x, y_new), None
 
-    y_fin, xs = jax.lax.scan(body, y0, None, length=iterations)
-    return xs[-1], y_fin
+    x0 = jnp.zeros((u_idx.shape[0], y0.shape[1]), dtype=jnp.float32)
+    (x_fin, y_fin), _ = jax.lax.scan(body, (x0, y0), None, length=iterations)
+    return x_fin, y_fin
 
 
 @dataclass
@@ -289,11 +293,14 @@ def train_als(
     i_idx, i_val, i_mask = (_row_pad(a, n_i_pad) for a in i_lists)
 
     key = seed_key if seed_key is not None else RandomManager.get_key()
-    # small random factors around 1/sqrt(K), the usual ALS init scale
+    # small random factors around 1/sqrt(K), the usual ALS init scale;
+    # padding rows must be ZERO or phantom items inflate gram(Y) in the
+    # first half-iteration
     y0 = (
         jax.random.normal(key, (n_i_pad, features), dtype=jnp.float32) * 0.1
         + 1.0 / math.sqrt(features)
     )
+    y0 = y0 * (jnp.arange(n_i_pad) < n_i)[:, None]
 
     args = [u_idx, u_val, u_mask, i_idx, i_val, i_mask, y0]
     if mesh is not None:

@@ -1,0 +1,240 @@
+"""Tests for the ML harness: hyperparam ranges/search, eval metrics, and the
+MLUpdate generation loop with a mock update (the MockMLUpdate pattern from
+the reference's SimpleMLUpdateIT — SURVEY.md §4)."""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from oryx_tpu.bus.api import KeyMessage, TopicProducer
+from oryx_tpu.bus.broker import get_broker
+from oryx_tpu.bus.inproc import InProcBroker
+from oryx_tpu.common.artifact import ModelArtifact
+from oryx_tpu.common.config import load_config
+from oryx_tpu.ml import (
+    ContinuousRange,
+    DiscreteRange,
+    Unordered,
+    choose_combos,
+    from_config_value,
+    grid_search,
+    random_search,
+)
+from oryx_tpu.ml.evaluate import accuracy, auc_mean_per_user, rmse
+from oryx_tpu.ml.update import MLUpdate
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    InProcBroker.reset_all()
+    yield
+    InProcBroker.reset_all()
+
+
+# ---- hyperparams ----------------------------------------------------------
+
+def test_from_config_value_forms():
+    assert isinstance(from_config_value(5), Unordered)
+    assert isinstance(from_config_value([1, 2]), Unordered)
+    assert isinstance(from_config_value({"min": 1, "max": 10}), DiscreteRange)
+    assert isinstance(from_config_value({"min": 0.1, "max": 1.0}), ContinuousRange)
+
+
+def test_discrete_range_trials():
+    r = DiscreteRange(1, 10)
+    vals = r.trial_values(4)
+    assert vals[0] == 1 and vals[-1] == 10 and len(vals) == 4
+    assert DiscreteRange(3, 3).trial_values(5) == [3]
+
+
+def test_continuous_log_detection():
+    assert ContinuousRange(0.001, 10.0).log is True
+    assert ContinuousRange(1.0, 2.0).log is False
+    vals = ContinuousRange(0.001, 10.0).trial_values(5)
+    # log-spaced: ratios roughly constant
+    ratios = [vals[i + 1] / vals[i] for i in range(4)]
+    assert max(ratios) / min(ratios) < 1.1
+
+
+def test_grid_search_budget():
+    combos = grid_search(
+        {"a": from_config_value([1, 2, 3]), "b": from_config_value({"min": 0.0, "max": 1.0})},
+        9,
+    )
+    assert len(combos) == 9  # 3 x 3
+    assert all(set(c) == {"a", "b"} for c in combos)
+
+
+def test_random_search_deterministic_under_seed():
+    ranges = {"lam": {"min": 0.001, "max": 1.0}}
+    from oryx_tpu.common.rng import RandomManager
+
+    RandomManager.use_test_seed(5)
+    a = random_search({k: from_config_value(v) for k, v in ranges.items()}, 4)
+    RandomManager.use_test_seed(5)
+    b = random_search({k: from_config_value(v) for k, v in ranges.items()}, 4)
+    assert a == b and len(a) == 4
+
+
+def test_choose_combos_single_candidate_is_default_point():
+    combos = choose_combos({"f": [8, 16], "lam": 0.1}, 1)
+    assert combos == [{"f": 8, "lam": 0.1}]
+
+
+# ---- evaluate -------------------------------------------------------------
+
+def test_rmse_zero_for_perfect():
+    x = np.eye(3)
+    y = np.eye(3)
+    u = np.array([0, 1])
+    i = np.array([0, 1])
+    v = np.array([1.0, 1.0])
+    assert rmse(x, y, u, i, v) == pytest.approx(0.0)
+
+
+def test_auc_separates_good_from_random():
+    rng = np.random.default_rng(0)
+    k = 8
+    x = rng.normal(size=(30, k))
+    y = rng.normal(size=(50, k))
+    # test positives = the items each user truly scores highest
+    scores = x @ y.T
+    test_u, test_i = [], []
+    for u in range(30):
+        top = np.argsort(scores[u])[-3:]
+        test_u += [u] * 3
+        test_i += list(top)
+    good = auc_mean_per_user(x, y, np.array(test_u), np.array(test_i))
+    bad = auc_mean_per_user(x, rng.normal(size=(50, k)), np.array(test_u), np.array(test_i))
+    assert good > 0.95
+    assert abs(bad - 0.5) < 0.15
+
+
+def test_accuracy():
+    assert accuracy(np.array([1, 2, 3]), np.array([1, 9, 3])) == pytest.approx(2 / 3)
+
+
+# ---- MLUpdate harness -----------------------------------------------------
+
+class _MockUpdate(MLUpdate):
+    """Builds a trivial 'model' whose quality is its hyperparam value."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.built = []
+
+    def hyperparam_ranges(self):
+        return {"q": [0.1, 0.9, 0.5]}
+
+    def build_model(self, train, hyperparams):
+        self.built.append(hyperparams["q"])
+        return ModelArtifact("mock", extensions={"q": str(hyperparams["q"])},
+                             content={"n_train": len(train)})
+
+    def evaluate(self, model, train, test):
+        return float(model.get_extension("q"))
+
+
+def _run_harness(tmp_path, overlay):
+    cfg = load_config(overlay=overlay)
+    broker = get_broker("mem://ml")
+    broker.create_topic("U", partitions=1)
+    producer = TopicProducer(broker, "U")
+    upd = _MockUpdate(cfg)
+    data = [KeyMessage(None, f"line{i}") for i in range(100)]
+    upd.run_update(1234567890123, data, [], str(tmp_path / "models"), producer)
+    return upd, broker
+
+
+def test_harness_picks_best_candidate_and_publishes(tmp_path):
+    upd, broker = _run_harness(
+        tmp_path,
+        {"oryx.ml.eval.candidates": 3, "oryx.ml.eval.hyperparam-search": "grid"},
+    )
+    assert sorted(upd.built) == [0.1, 0.5, 0.9]
+    # winner (q=0.9) atomically in model_dir/<ts>
+    final = tmp_path / "models" / "1234567890123"
+    assert final.is_dir()
+    model = ModelArtifact.read(final)
+    assert model.get_extension("q") == "0.9"
+    # no candidate litter left behind
+    assert not (tmp_path / "models" / ".candidates").exists()
+    # published inline as MODEL
+    recs = broker.read("U", 0, 0, 10)
+    assert len(recs) == 1 and recs[0][1] == "MODEL"
+    assert ModelArtifact.from_string(recs[0][2]).get_extension("q") == "0.9"
+
+
+def test_harness_threshold_rejects_bad_model(tmp_path):
+    upd, broker = _run_harness(
+        tmp_path,
+        {"oryx.ml.eval.candidates": 3, "oryx.ml.eval.threshold": 0.95,
+         "oryx.ml.eval.hyperparam-search": "grid"},
+    )
+    assert broker.read("U", 0, 0, 10) == []
+    assert not (tmp_path / "models" / "1234567890123").exists()
+
+
+def test_harness_train_test_split_sizes(tmp_path):
+    """Binomial-style statistical assertion on the split, like
+    SimpleMLUpdateIT (reference :77-95)."""
+    cfg = load_config(overlay={"oryx.ml.eval.test-fraction": 0.2})
+
+    class _SplitProbe(_MockUpdate):
+        def build_model(self, train, hp):
+            self.n_train = len(train)
+            return super().build_model(train, hp)
+
+        def evaluate(self, model, train, test):
+            self.n_test = len(test)
+            return 1.0
+
+    upd = _SplitProbe(cfg)
+    broker = get_broker("mem://ml2")
+    broker.create_topic("U", partitions=1)
+    data = [KeyMessage(None, f"l{i}") for i in range(1000)]
+    upd.run_update(1, data, [], str(tmp_path / "m"), TopicProducer(broker, "U"))
+    n_test = upd.n_test
+    # mean 200, sd ~12.6; 5 sd window
+    assert 137 < n_test < 263, n_test
+
+
+def test_harness_model_ref_when_oversized(tmp_path):
+    cfg = load_config(overlay={"oryx.update-topic.message.max-size": 64})
+
+    class _BigModel(_MockUpdate):
+        def build_model(self, train, hp):
+            return ModelArtifact("mock", content={"blob": "z" * 500})
+
+        def evaluate(self, model, train, test):
+            return 1.0
+
+    broker = get_broker("mem://ml3")
+    broker.create_topic("U", partitions=1)
+    upd = _BigModel(cfg)
+    upd.run_update(7, [KeyMessage(None, "x")], [], str(tmp_path / "m"), TopicProducer(broker, "U"))
+    recs = broker.read("U", 0, 0, 10)
+    assert recs[0][1] == "MODEL-REF"
+    assert ModelArtifact.read(recs[0][2]).content["blob"] == "z" * 500
+
+
+def test_harness_failed_candidate_tolerated(tmp_path):
+    cfg = load_config(overlay={"oryx.ml.eval.candidates": 3,
+                               "oryx.ml.eval.hyperparam-search": "grid"})
+
+    class _Flaky(_MockUpdate):
+        def build_model(self, train, hp):
+            if hp["q"] == 0.9:
+                raise RuntimeError("boom")
+            return super().build_model(train, hp)
+
+    broker = get_broker("mem://ml4")
+    broker.create_topic("U", partitions=1)
+    upd = _Flaky(cfg)
+    upd.run_update(9, [KeyMessage(None, "x")] * 200, [], str(tmp_path / "m"),
+                   TopicProducer(broker, "U"))
+    recs = broker.read("U", 0, 0, 10)
+    # best surviving candidate (q=0.5) won
+    assert ModelArtifact.from_string(recs[0][2]).get_extension("q") == "0.5"
