@@ -1,0 +1,16 @@
+"""ALS collaborative-filtering application (the flagship app).
+
+Three tiers mirroring the reference's ALS app family:
+  batch.py    ALSUpdate — full model rebuild on TPU (vs app/oryx-app-mllib
+              ALSUpdate.java on Spark MLlib)
+  speed.py    ALSSpeedModelManager — incremental fold-in deltas
+              (vs app/oryx-app .../speed/als/ALSSpeedModelManager.java)
+  serving.py  ALSServingModel(+Manager) — in-device factor store answering
+              recommend/similarity/estimate queries
+              (vs app/oryx-app-serving .../als/model/ALSServingModel.java)
+Endpoints live in oryx_tpu/serving/resources/als.py.
+"""
+
+from oryx_tpu.apps.als.batch import ALSUpdate
+from oryx_tpu.apps.als.speed import ALSSpeedModelManager
+from oryx_tpu.apps.als.serving import ALSServingModel, ALSServingModelManager

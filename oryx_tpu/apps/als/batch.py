@@ -1,0 +1,178 @@
+"""ALS batch tier: the full TPU model rebuild per generation.
+
+Replaces the reference's Spark-MLlib pipeline (app/oryx-app-mllib
+.../als/ALSUpdate.java): parse events, aggregate with decay/delete
+semantics, train pjit ALS, evaluate (implicit: mean per-user AUC; explicit:
+negative RMSE), publish a *skeleton* artifact (hyperparams + expected ID
+lists, no tensors — factor matrices are streamed row-by-row as UP messages
+through publish_additional_model_data, the reference's
+EnqueueFeatureVecsFn pattern at ALSUpdate.java:286-318), and split
+train/test by time instead of randomly (ALSUpdate.java:325-342).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Sequence
+
+import numpy as np
+
+from oryx_tpu.bus.api import KeyMessage, TopicProducer
+from oryx_tpu.common.artifact import ModelArtifact
+from oryx_tpu.common.config import Config
+from oryx_tpu.ml.evaluate import auc_mean_per_user, rmse
+from oryx_tpu.ml.update import MLUpdate
+from oryx_tpu.ops.als import aggregate_interactions, train_als
+from oryx_tpu.apps.als.common import (
+    ALSConfig,
+    parse_events,
+    x_update_message,
+    y_update_message,
+)
+
+log = logging.getLogger(__name__)
+
+
+class ALSUpdate(MLUpdate):
+    def __init__(self, config: Config, mesh=None):
+        super().__init__(config)
+        self.als = ALSConfig.from_config(config)
+        self.mesh = mesh
+
+    def hyperparam_ranges(self) -> dict[str, Any]:
+        return {
+            "features": self.als.features,
+            "lambda": self.als.lam,
+            "alpha": self.als.alpha,
+        }
+
+    def split_train_test(self, data: Sequence[KeyMessage]):
+        """Temporal split: newest test-fraction of events held out
+        (ALSUpdate.java:325-342 sorts by timestamp). Timestamps are read
+        per-line in place (unparseable lines get -1 and stay in train) so
+        indices always align with `data` even when lines are skipped."""
+        if self.test_fraction <= 0 or len(data) == 0:
+            return data, []
+        from oryx_tpu.common.text import parse_input_line
+
+        ts = np.full(len(data), -1, dtype=np.int64)
+        for j, km in enumerate(data):
+            try:
+                tok = parse_input_line(km.message)
+                if len(tok) > 3 and tok[3] != "":
+                    ts[j] = int(float(tok[3]))
+            except (ValueError, IndexError):
+                pass
+        valid = ts[ts >= 0]
+        if len(valid) == 0 or np.all(valid == valid[0]):
+            return super().split_train_test(data)
+        order = np.argsort(ts, kind="stable")
+        n_test = int(len(data) * self.test_fraction)
+        if n_test == 0:
+            return data, []
+        test_set = set(order[-n_test:].tolist())
+        train = [d for j, d in enumerate(data) if j not in test_set]
+        test = [d for j, d in enumerate(data) if j in test_set]
+        return train, test
+
+    def _aggregate(self, data: Sequence[KeyMessage]):
+        users, items, vals, tss = parse_events(data)
+        if len(vals) == 0:
+            raise ValueError("no parseable interactions")
+        return aggregate_interactions(
+            users, items, vals, tss,
+            implicit=self.als.implicit,
+            decay_factor=self.als.decay_factor,
+            zero_threshold=self.als.zero_threshold,
+            now_ms=int(time.time() * 1000),
+            log_strength=self.als.log_strength,
+            epsilon=self.als.epsilon,
+        )
+
+    def build_model(self, train: Sequence[KeyMessage], hyperparams: dict[str, Any]) -> ModelArtifact:
+        agg = self._aggregate(train)
+        m = train_als(
+            agg,
+            features=int(hyperparams["features"]),
+            lam=float(hyperparams["lambda"]),
+            alpha=float(hyperparams["alpha"]),
+            iterations=self.als.iterations,
+            implicit=self.als.implicit,
+            mesh=self.mesh,
+        )
+        art = ModelArtifact(
+            "als",
+            extensions={
+                "features": str(int(hyperparams["features"])),
+                "lambda": str(float(hyperparams["lambda"])),
+                "alpha": str(float(hyperparams["alpha"])),
+                "implicit": str(self.als.implicit).lower(),
+                "logStrength": str(self.als.log_strength).lower(),
+            },
+            tensors={"X": m.x, "Y": m.y},
+        )
+        art.set_extension("XIDs", m.user_ids)
+        art.set_extension("YIDs", m.item_ids)
+        # knownItems per user ride with the X rows at publish time
+        if not self.als.no_known_items:
+            known: dict[str, list[str]] = {}
+            for u, i in zip(agg.users, agg.items):
+                known.setdefault(agg.user_ids[u], []).append(agg.item_ids[i])
+            art.content["knownItems"] = known
+        return art
+
+    def evaluate(self, model: ModelArtifact, train, test) -> float:
+        users, items, vals, _ = parse_events(test)
+        if len(vals) == 0:
+            return float("nan")
+        xids = model.get_extension_list("XIDs")
+        yids = model.get_extension_list("YIDs")
+        umap = {u: j for j, u in enumerate(xids)}
+        imap = {i: j for j, i in enumerate(yids)}
+        keep = [
+            (umap[u], imap[i], v)
+            for u, i, v in zip(users, items, vals)
+            if u in umap and i in imap and not np.isnan(v)
+        ]
+        if not keep:
+            return float("nan")
+        tu = np.asarray([a for a, _, _ in keep])
+        ti = np.asarray([b for _, b, _ in keep])
+        tv = np.asarray([c for _, _, c in keep])
+        x, y = model.tensors["X"], model.tensors["Y"]
+        if self.als.implicit:
+            known = {
+                umap[u]: {imap[i] for i in its if i in imap}
+                for u, its in model.content.get("knownItems", {}).items()
+                if u in umap
+            }
+            return auc_mean_per_user(x, y, tu, ti, known)
+        return -rmse(x, y, tu, ti, tv)
+
+    def publish_model(self, model: ModelArtifact, model_path: str, producer: TopicProducer) -> None:
+        """Publish a tensor-free skeleton; factor rows stream separately
+        (the reference's skeleton-PMML-with-extensions pattern)."""
+        skeleton = ModelArtifact("als", dict(model.extensions), {})
+        serialized = skeleton.to_string()
+        if len(serialized.encode("utf-8")) <= self.max_message_size:
+            producer.send("MODEL", serialized)
+        else:
+            producer.send("MODEL-REF", model_path)
+
+    def publish_additional_model_data(
+        self, model: ModelArtifact, model_path: str, producer: TopicProducer
+    ) -> None:
+        """Stream every Y row then every X row as UP messages
+        (ALSUpdate.java:286-318: Y first so user solves see item vectors)."""
+        xids = model.get_extension_list("XIDs")
+        yids = model.get_extension_list("YIDs")
+        x, y = model.tensors["X"], model.tensors["Y"]
+        known = model.content.get("knownItems", {})
+        producer.send_batch(
+            y_update_message(iid, y[j]) for j, iid in enumerate(yids)
+        )
+        producer.send_batch(
+            x_update_message(uid, x[j], known.get(uid, [])) for j, uid in enumerate(xids)
+        )
+        log.info("published %d Y and %d X factor rows", len(yids), len(xids))

@@ -1,0 +1,105 @@
+"""Shared ALS app plumbing: input parsing, config view, update payloads.
+
+Input lines are CSV or JSON arrays `user,item[,strength[,timestamp]]`
+(reference MLFunctions.PARSE_FN semantics): empty strength = 1, "delete"
+semantics = empty-string strength on DELETE paths encoded as NaN.
+Update-topic payloads are JSON arrays: ["X", id, [vector], [knownItems]] and
+["Y", id, [vector]] (reference ALSUpdate.publishAdditionalModelData /
+ALSSpeedModelManager.buildUpdates payload shapes).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+import numpy as np
+
+from oryx_tpu.bus.api import KeyMessage
+from oryx_tpu.common.config import Config
+from oryx_tpu.common.text import parse_input_line
+
+
+@dataclass
+class ALSConfig:
+    implicit: bool
+    log_strength: bool
+    epsilon: float
+    decay_factor: float
+    zero_threshold: float
+    no_known_items: bool
+    features: object
+    lam: object
+    alpha: object
+    iterations: int
+    sample_rate: float
+
+    @staticmethod
+    def from_config(config: Config) -> "ALSConfig":
+        g = lambda k, d=None: config.get(f"oryx.als.{k}", d)
+        return ALSConfig(
+            implicit=bool(g("implicit", True)),
+            log_strength=bool(g("logStrength", False)),
+            epsilon=float(g("epsilon", 1.0)),
+            decay_factor=float(g("decay.factor", 1.0)),
+            zero_threshold=float(g("decay.zero-threshold", 0.0)),
+            no_known_items=bool(g("no-known-items", False)),
+            features=g("hyperparams.features", 10),
+            lam=g("hyperparams.lambda", 0.001),
+            alpha=g("hyperparams.alpha", 1.0),
+            iterations=int(g("hyperparams.iterations", 10)),
+            sample_rate=float(g("sample-rate", 1.0)),
+        )
+
+
+def parse_events(data) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """KeyMessages -> (users, items, values, timestamps) arrays. Bad lines
+    are skipped. Empty/absent strength = 1.0; empty-string with a 'delete'
+    convention arrives as NaN from the /pref DELETE path."""
+    users, items, vals, tss = [], [], [], []
+    for km in data:
+        line = km.message if isinstance(km, KeyMessage) else str(km)
+        try:
+            tok = parse_input_line(line)
+            if len(tok) < 2 or not tok[0] or not tok[1]:
+                continue
+            u, i = tok[0], tok[1]
+            v = 1.0
+            if len(tok) > 2 and tok[2] != "":
+                v = float(tok[2])
+            elif len(tok) > 2 and tok[2] == "":
+                v = float("nan")  # delete marker
+            ts = int(float(tok[3])) if len(tok) > 3 and tok[3] != "" else 0
+        except (ValueError, IndexError):
+            continue
+        users.append(u)
+        items.append(i)
+        vals.append(v)
+        tss.append(ts)
+    return (
+        np.asarray(users, dtype=object),
+        np.asarray(items, dtype=object),
+        np.asarray(vals, dtype=np.float64),
+        np.asarray(tss, dtype=np.int64),
+    )
+
+
+def x_update_message(user_id: str, vector, known_items) -> tuple[str, str]:
+    return "UP", json.dumps(
+        ["X", user_id, [round(float(v), 6) for v in vector], sorted(known_items)],
+        separators=(",", ":"),
+    )
+
+
+def y_update_message(item_id: str, vector) -> tuple[str, str]:
+    return "UP", json.dumps(
+        ["Y", item_id, [round(float(v), 6) for v in vector]], separators=(",", ":")
+    )
+
+
+def parse_update_message(message: str):
+    """-> (kind 'X'|'Y', id, np vector, known_ids list)."""
+    arr = json.loads(message)
+    kind, ident, vec = arr[0], str(arr[1]), np.asarray(arr[2], dtype=np.float32)
+    known = [str(k) for k in arr[3]] if len(arr) > 3 and arr[3] else []
+    return kind, ident, vec, known

@@ -1,0 +1,230 @@
+"""ALS serving tier: in-device factor store + query methods + manager.
+
+Mirrors ALSServingModel/ALSServingModelManager (app/oryx-app-serving
+.../als/model/ALSServingModel.java:96-409, ALSServingModelManager.java:
+69-182). The reference partitions Y by LSH bucket and fans requests over a
+thread pool with bounded heaps; here the whole Y store is one device matrix
+and top-N is a single matmul + lax.top_k (so LSH becomes an optional
+approximation, not a necessity — sample-rate < 1 subsamples rows instead).
+knownItems ingestion rides the X update flood like the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from oryx_tpu.api import AbstractServingModelManager, ServingModel
+from oryx_tpu.common.artifact import read_artifact_from_update
+from oryx_tpu.common.config import Config
+from oryx_tpu.ops.als import compute_updated_xu, topk_dot
+from oryx_tpu.apps.als.common import ALSConfig, parse_update_message
+from oryx_tpu.apps.als.state import ALSState
+
+log = logging.getLogger(__name__)
+
+
+class ALSServingModel(ServingModel):
+    def __init__(self, state: ALSState):
+        self.state = state
+        # (device matrix, ids, version) swapped as ONE tuple: readers always
+        # see a matched pair, no lock on the read path
+        self._device_view: tuple | None = None
+        self._sync_lock = threading.Lock()
+
+    def fraction_loaded(self) -> float:
+        return self.state.fraction_loaded()
+
+    # -- device scoring view ----------------------------------------------
+
+    def _y_view(self):
+        """(device Y matrix, row ids) resynced lazily on version drift —
+        a double-buffered atomic tuple swap instead of the reference's
+        fine-grained read locks on the hot path. Staleness probe is a cheap
+        version read; the full arena copies only on drift."""
+        view = self._device_view
+        version = self.state.y.get_version()
+        if view is not None and view[2] == version:
+            return view[0], view[1]
+        with self._sync_lock:
+            view = self._device_view
+            if view is not None and view[2] == self.state.y.get_version():
+                return view[0], view[1]
+            mat, ids, version = self.state.y.snapshot()
+            view = (jnp.asarray(mat), ids, version)
+            self._device_view = view
+        return view[0], view[1]
+
+    # -- queries -----------------------------------------------------------
+
+    def top_n(
+        self,
+        user_vector: np.ndarray,
+        how_many: int,
+        exclude: set[str] = frozenset(),
+        rescorer=None,
+    ) -> list[tuple[str, float]]:
+        y, ids = self._y_view()
+        n = len(ids)
+        if n == 0:
+            return []
+        # over-fetch to survive exclusions/filters, then trim
+        k = min(n, how_many + len(exclude) + 8)
+        vals, idx = topk_dot(jnp.asarray(user_vector, dtype=jnp.float32), y, k=k)
+        out = []
+        for v, j in zip(np.asarray(vals), np.asarray(idx)):
+            ident = ids[int(j)]
+            if ident in exclude:
+                continue
+            score = float(v)
+            if rescorer is not None:
+                if rescorer.is_filtered(ident):
+                    continue
+                score = rescorer.rescore(ident, score)
+                if score is None or np.isnan(score):
+                    continue
+            out.append((ident, score))
+            if len(out) == how_many and rescorer is None:
+                break
+        if rescorer is not None:
+            out.sort(key=lambda t: -t[1])
+            out = out[:how_many]
+        return out
+
+    def get_user_vector(self, user: str) -> np.ndarray | None:
+        return self.state.x.get(user)
+
+    def get_item_vector(self, item: str) -> np.ndarray | None:
+        return self.state.y.get(item)
+
+    def dot(self, user: str, item: str) -> float | None:
+        xu = self.state.x.get(user)
+        yi = self.state.y.get(item)
+        if xu is None or yi is None:
+            return None
+        return float(xu @ yi)
+
+    def fold_in_user_vector(
+        self, item_strengths: list[tuple[str, float]], implicit: bool | None = None
+    ) -> np.ndarray | None:
+        """Anonymous-user vector from (item, strength) prefs: iterated
+        fold-in against the cached Y solver (EstimateForAnonymous.java:
+        47-85 / RecommendToAnonymous pattern)."""
+        chol = self.state.yty.get()
+        if chol is None:
+            return None
+        implicit = self.state.implicit if implicit is None else implicit
+        xu = np.zeros(self.state.features, dtype=np.float32)
+        folded = False
+        for item, strength in item_strengths:
+            yi = self.state.y.get(item)
+            if yi is None:
+                continue
+            xu = np.asarray(
+                compute_updated_xu(
+                    jnp.asarray(chol), jnp.float32(strength),
+                    jnp.asarray(xu), jnp.asarray(yi), implicit=implicit,
+                )
+            )
+            folded = True
+        return xu if folded else None
+
+    def cosine_to_items(self, items: list[str]) -> np.ndarray | None:
+        """Mean unit-vector of the given items (similarity queries)."""
+        vecs = [self.state.y.get(i) for i in items]
+        vecs = [v for v in vecs if v is not None]
+        if not vecs:
+            return None
+        m = np.stack(vecs)
+        norms = np.linalg.norm(m, axis=1, keepdims=True)
+        norms[norms == 0] = 1
+        return (m / norms).mean(axis=0)
+
+    def most_popular_items(self, how_many: int, rescorer=None) -> list[tuple[str, int]]:
+        counts: dict[str, int] = {}
+        for items in self.state.known_items_snapshot().values():
+            for i in items:
+                counts[i] = counts.get(i, 0) + 1
+        out = [
+            (i, c) for i, c in counts.items()
+            if rescorer is None or not rescorer.is_filtered(i)
+        ]
+        out.sort(key=lambda t: (-t[1], t[0]))
+        return out[:how_many]
+
+    def most_active_users(self, how_many: int) -> list[tuple[str, int]]:
+        out = [(u, len(s)) for u, s in self.state.known_items_snapshot().items()]
+        out.sort(key=lambda t: (-t[1], t[0]))
+        return out[:how_many]
+
+
+class ALSServingModelManager(AbstractServingModelManager):
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.als = ALSConfig.from_config(config)
+        self.model: ALSServingModel | None = None
+        self._rescorer_provider = _load_rescorer_provider(config)
+
+    def get_model(self) -> ALSServingModel | None:
+        return self.model
+
+    def rescorer_provider(self):
+        return self._rescorer_provider
+
+    def consume_key_message(self, key: str | None, message: str) -> None:
+        if key in ("MODEL", "MODEL-REF"):
+            art = read_artifact_from_update(key, message)
+            features = int(art.get_extension("features"))
+            implicit = art.get_extension("implicit", "true") == "true"
+            if self.model is None or self.model.state.features != features:
+                self.model = ALSServingModel(ALSState(features, implicit))
+            st = self.model.state
+            xids = art.get_extension_list("XIDs")
+            yids = art.get_extension_list("YIDs")
+            if xids or yids:
+                st.set_expected(xids, yids)
+                st.retain_only(set(xids), set(yids))
+            else:
+                st.set_expected(st.x.ids(), st.y.ids())
+            if art.tensors:
+                x, y = art.tensors.get("X"), art.tensors.get("Y")
+                if y is not None and len(yids) == len(y):
+                    for j, iid in enumerate(yids):
+                        st.y.set(iid, y[j])
+                if x is not None and len(xids) == len(x):
+                    for j, uid in enumerate(xids):
+                        st.x.set(uid, x[j])
+                for u, items in art.content.get("knownItems", {}).items():
+                    st.add_known_items(u, items)
+        elif key == "UP":
+            if self.model is None:
+                return
+            st = self.model.state
+            kind, ident, vec, known = parse_update_message(message)
+            if len(vec) != st.features:
+                return
+            if kind == "X":
+                st.x.set(ident, vec)
+                if st.expected_x is not None:
+                    st.expected_x.add(ident)
+                if known:
+                    st.add_known_items(ident, known)
+            elif kind == "Y":
+                st.y.set(ident, vec)
+                if st.expected_y is not None:
+                    st.expected_y.add(ident)
+
+
+def _load_rescorer_provider(config: Config):
+    """Optional result-rescoring plugin, config-named like the reference's
+    oryx.als.rescorer-provider-class (ALSServingModelManager.java:147-180)."""
+    name = config.get_string("oryx.als.rescorer-provider-class", None)
+    if not name:
+        return None
+    from oryx_tpu.common.classutil import load_instance_of
+
+    return load_instance_of(name)

@@ -1,0 +1,145 @@
+"""ALS speed tier: micro-batch fold-in deltas.
+
+Mirrors ALSSpeedModelManager (app/oryx-app .../speed/als/
+ALSSpeedModelManager.java:68-221): consume MODEL/MODEL-REF (new or retained
+state keyed on the features hyperparam) and UP X/Y vector writes; per
+micro-batch, aggregate interactions with the batch tier's dup semantics and
+compute fold-in deltas for BOTH the user and item vectors of every
+interaction against the cached X^T.X / Y^T.Y solvers — emitted as UP
+messages. Skips everything until the model is min-model-load-fraction
+loaded. The fold-in solves run as one vmapped batch on device rather than a
+parallelStream over interactions.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from oryx_tpu.api import AbstractSpeedModelManager
+from oryx_tpu.bus.api import KeyMessage
+from oryx_tpu.common.artifact import read_artifact_from_update
+from oryx_tpu.common.config import Config
+from oryx_tpu.common.locks import RateLimitCheck
+from oryx_tpu.ops.als import aggregate_interactions, fold_in_batch, fold_in_batch_explicit
+from oryx_tpu.apps.als.common import (
+    ALSConfig,
+    parse_events,
+    parse_update_message,
+    x_update_message,
+    y_update_message,
+)
+from oryx_tpu.apps.als.state import ALSState
+
+log = logging.getLogger(__name__)
+
+
+class ALSSpeedModelManager(AbstractSpeedModelManager):
+    def __init__(self, config: Config):
+        self.config = config
+        self.als = ALSConfig.from_config(config)
+        self.min_fraction = config.get_float("oryx.speed.min-model-load-fraction", 0.8)
+        self.state: ALSState | None = None
+        self._not_ready_log = RateLimitCheck(60.0)
+
+    # -- update-topic consumption ------------------------------------------
+
+    def consume_key_message(self, key: str | None, message: str) -> None:
+        if key in ("MODEL", "MODEL-REF"):
+            art = read_artifact_from_update(key, message)
+            features = int(art.get_extension("features"))
+            implicit = art.get_extension("implicit", "true") == "true"
+            if self.state is None or self.state.features != features:
+                # rank changed: a fresh state (ALSSpeedModelManager.java:
+                # 100-115 keys retention on the features hyperparam)
+                self.state = ALSState(features, implicit)
+            st = self.state
+            xids = art.get_extension_list("XIDs")
+            yids = art.get_extension_list("YIDs")
+            if xids or yids:
+                st.set_expected(xids, yids)
+                st.retain_only(set(xids), set(yids))
+            else:
+                # skeleton without ID lists: expected IDs arrive via UP flood;
+                # treat current contents as the expectation baseline
+                st.set_expected(st.x.ids(), st.y.ids())
+            if art.tensors:
+                x, y = art.tensors.get("X"), art.tensors.get("Y")
+                if x is not None and len(xids) == len(x):
+                    for j, uid in enumerate(xids):
+                        st.x.set(uid, x[j])
+                if y is not None and len(yids) == len(y):
+                    for j, iid in enumerate(yids):
+                        st.y.set(iid, y[j])
+        elif key == "UP":
+            if self.state is None:
+                return  # updates before any model: nothing to apply to
+            kind, ident, vec, _known = parse_update_message(message)
+            if len(vec) != self.state.features:
+                return  # stale update from a different-rank model
+            if kind == "X":
+                self.state.x.set(ident, vec)
+                if self.state.expected_x is not None:
+                    self.state.expected_x.add(ident)
+            elif kind == "Y":
+                self.state.y.set(ident, vec)
+                if self.state.expected_y is not None:
+                    self.state.expected_y.add(ident)
+
+    # -- micro-batch -> updates --------------------------------------------
+
+    def build_updates(self, new_data):
+        st = self.state
+        if st is None or st.fraction_loaded() < self.min_fraction:
+            if self._not_ready_log.test():
+                log.info("speed model not yet loaded; skipping micro-batch")
+            return []
+        users, items, vals, tss = parse_events(new_data)
+        if len(vals) == 0:
+            return []
+        agg = aggregate_interactions(
+            users, items, vals, tss,
+            implicit=st.implicit,
+            zero_threshold=self.als.zero_threshold,
+        )
+        if len(agg.values) == 0:
+            return []
+
+        # gather current vectors; zeros mark absent (new) entities
+        k = st.features
+        xu = np.zeros((len(agg.values), k), dtype=np.float32)
+        yi = np.zeros((len(agg.values), k), dtype=np.float32)
+        have_y = np.zeros(len(agg.values), dtype=bool)
+        for j in range(len(agg.values)):
+            u_vec = st.x.get(agg.user_ids[agg.users[j]])
+            i_vec = st.y.get(agg.item_ids[agg.items[j]])
+            if u_vec is not None:
+                xu[j] = u_vec
+            if i_vec is not None:
+                yi[j] = i_vec
+                have_y[j] = True
+
+        out: list[tuple[str, str]] = []
+        fold = fold_in_batch if st.implicit else fold_in_batch_explicit
+        vals32 = agg.values.astype(np.float32)
+
+        # user-side deltas need Y'Y; item-side need X'X — both one vmapped
+        # solve over the whole micro-batch
+        chol_y = st.yty.get()
+        if chol_y is not None and have_y.any():
+            new_xu = np.asarray(fold(chol_y, vals32, xu, yi))
+            for j in np.nonzero(have_y)[0]:
+                uid = agg.user_ids[agg.users[j]]
+                iid = agg.item_ids[agg.items[j]]
+                if np.all(np.isfinite(new_xu[j])):
+                    out.append(x_update_message(uid, new_xu[j], [iid]))
+        chol_x = st.xtx.get()
+        have_x = np.any(xu != 0.0, axis=1)
+        if chol_x is not None and have_x.any():
+            new_yi = np.asarray(fold(chol_x, vals32, yi, xu))
+            for j in np.nonzero(have_x)[0]:
+                iid = agg.item_ids[agg.items[j]]
+                if np.all(np.isfinite(new_yi[j])):
+                    out.append(y_update_message(iid, new_yi[j]))
+        return out

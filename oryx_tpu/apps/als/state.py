@@ -1,0 +1,193 @@
+"""In-memory ALS model state shared by the speed and serving tiers.
+
+The reference splits this across ALSSpeedModel (app/oryx-app .../speed/als/
+ALSSpeedModel.java) and ALSServingModel (app/oryx-app-serving .../als/model/
+ALSServingModel.java): string-keyed user/item factor stores, expected-ID
+bookkeeping for fraction-loaded readiness, known-items map, and cached
+Y^T.Y / X^T.X solvers invalidated on factor writes (SolverCache.java).
+
+TPU-native twist: instead of lock-partitioned hash maps scanned by a thread
+pool, vectors live in a growing numpy arena whose device copy is resynced
+lazily (version-stamped) — queries are one matmul + top_k over the arena.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from oryx_tpu.common.locks import AutoReadWriteLock
+
+
+class FactorStore:
+    """Append/update factor vectors keyed by string id, backed by a growing
+    arena so the whole store is one [N,K] matrix for device scoring."""
+
+    def __init__(self, features: int):
+        self.features = features
+        self._ids: dict[str, int] = {}
+        self._rev: list[str] = []
+        self._arena = np.zeros((64, features), dtype=np.float32)
+        self._n = 0
+        self.version = 0
+        self._lock = AutoReadWriteLock()
+
+    def set(self, ident: str, vector: np.ndarray) -> None:
+        v = np.asarray(vector, dtype=np.float32)
+        if v.shape != (self.features,):
+            raise ValueError(f"vector rank {v.shape} != ({self.features},)")
+        with self._lock.write():
+            row = self._ids.get(ident)
+            if row is None:
+                if self._n == len(self._arena):
+                    self._arena = np.vstack(
+                        [self._arena, np.zeros_like(self._arena)]
+                    )
+                row = self._n
+                self._ids[ident] = row
+                self._rev.append(ident)
+                self._n += 1
+            self._arena[row] = v
+            self.version += 1
+
+    def get(self, ident: str) -> np.ndarray | None:
+        with self._lock.read():
+            row = self._ids.get(ident)
+            return None if row is None else self._arena[row].copy()
+
+    def __contains__(self, ident: str) -> bool:
+        with self._lock.read():
+            return ident in self._ids
+
+    def __len__(self) -> int:
+        with self._lock.read():
+            return self._n
+
+    def ids(self) -> list[str]:
+        with self._lock.read():
+            return list(self._rev)
+
+    def snapshot(self) -> tuple[np.ndarray, list[str], int]:
+        """(matrix [N,K] copy, row ids, version) — the scoring view."""
+        with self._lock.read():
+            return self._arena[: self._n].copy(), list(self._rev), self.version
+
+    def get_version(self) -> int:
+        """Cheap staleness probe — no arena copy."""
+        with self._lock.read():
+            return self.version
+
+    def index_of(self, ident: str) -> int | None:
+        with self._lock.read():
+            return self._ids.get(ident)
+
+    def retain(self, keep: set[str]) -> None:
+        """Drop vectors not in `keep` — the model-swap retention step
+        (ALSServingModel retainRecent*, :317-370). Compacts the arena."""
+        with self._lock.write():
+            pairs = [(i, self._ids[i]) for i in self._rev if i in keep]
+            new_arena = np.zeros((max(64, len(pairs)), self.features), dtype=np.float32)
+            new_ids: dict[str, int] = {}
+            new_rev: list[str] = []
+            for j, (ident, old_row) in enumerate(pairs):
+                new_arena[j] = self._arena[old_row]
+                new_ids[ident] = j
+                new_rev.append(ident)
+            self._arena = new_arena
+            self._ids = new_ids
+            self._rev = new_rev
+            self._n = len(pairs)
+            self.version += 1
+
+
+class SolverCache:
+    """Lazily-computed Cholesky of a store's Gram matrix, invalidated by
+    version drift (reference SolverCache.java's dirty-flag recompute)."""
+
+    def __init__(self, store: FactorStore):
+        self._store = store
+        self._chol: np.ndarray | None = None
+        self._built_version = -1
+        self._lock = threading.Lock()
+
+    def get(self) -> np.ndarray | None:
+        """Current Cholesky factor of (F^T.F + eps.I), or None if the store
+        is empty."""
+        with self._lock:
+            v = self._store.version
+            if self._chol is None or self._built_version != v:
+                mat, _, _ = self._store.snapshot()
+                if len(mat) == 0:
+                    return None
+                gram = mat.T @ mat + 1e-4 * np.eye(self._store.features, dtype=np.float32)
+                self._chol = np.linalg.cholesky(gram).astype(np.float32)
+                self._built_version = v
+            return self._chol
+
+
+class ALSState:
+    """Full speed/serving-side model: X and Y stores, known-items, expected
+    IDs, solver caches."""
+
+    def __init__(self, features: int, implicit: bool):
+        self.features = features
+        self.implicit = implicit
+        self.x = FactorStore(features)
+        self.y = FactorStore(features)
+        self.known_items: dict[str, set[str]] = {}
+        self._known_lock = threading.Lock()
+        self.expected_x: set[str] | None = None
+        self.expected_y: set[str] | None = None
+        self.yty = SolverCache(self.y)
+        self.xtx = SolverCache(self.x)
+
+    # -- known items -------------------------------------------------------
+
+    def add_known_items(self, user: str, items) -> None:
+        with self._known_lock:
+            self.known_items.setdefault(user, set()).update(items)
+
+    def remove_known_item(self, user: str, item: str) -> None:
+        with self._known_lock:
+            s = self.known_items.get(user)
+            if s:
+                s.discard(item)
+
+    def get_known_items(self, user: str) -> set[str]:
+        with self._known_lock:
+            return set(self.known_items.get(user, ()))
+
+    def known_items_snapshot(self) -> dict[str, set[str]]:
+        """Consistent copy for whole-map scans (popularity/activity)."""
+        with self._known_lock:
+            return {u: set(s) for u, s in self.known_items.items()}
+
+    # -- readiness ---------------------------------------------------------
+
+    def set_expected(self, x_ids, y_ids) -> None:
+        self.expected_x = set(x_ids)
+        self.expected_y = set(y_ids)
+
+    def fraction_loaded(self) -> float:
+        """Loaded fraction of the announced model's vectors
+        (ALSServingModel.getFractionLoaded, :386-400)."""
+        if self.expected_x is None or self.expected_y is None:
+            return 0.0
+        total = len(self.expected_x) + len(self.expected_y)
+        if total == 0:
+            return 1.0
+        have = sum(1 for i in self.expected_x if i in self.x) + sum(
+            1 for i in self.expected_y if i in self.y
+        )
+        return have / total
+
+    # -- model swap --------------------------------------------------------
+
+    def retain_only(self, x_keep: set[str], y_keep: set[str]) -> None:
+        self.x.retain(x_keep)
+        self.y.retain(y_keep)
+        with self._known_lock:
+            self.known_items = {
+                u: s for u, s in self.known_items.items() if u in x_keep
+            }

@@ -41,14 +41,17 @@ def atomic_rename(src: str | Path, dst: str | Path) -> None:
     os.replace(str(src), str(dst))
 
 
-_TS_DIR_RE = re.compile(r"(?:oryx-)?(\d{10,})")
+_TS_DIR_RE = re.compile(r"^oryx-(\d+)$|^(\d{10,})$")
 
 
 def timestamp_from_dirname(name: str) -> int | None:
-    """Extract the epoch-millis timestamp from a generation dir name,
-    the convention of SaveToHDFSFunction/DeleteOldDataFn."""
-    m = _TS_DIR_RE.search(name)
-    return int(m.group(1)) if m else None
+    """Extract the epoch-millis timestamp from a generation dir name
+    (oryx-<ts> data dirs, bare <ts> model dirs), the convention of
+    SaveToHDFSFunction/DeleteOldDataFn."""
+    m = _TS_DIR_RE.match(name)
+    if not m:
+        return None
+    return int(m.group(1) or m.group(2))
 
 
 def list_generation_dirs(root: str | Path) -> list[Path]:

@@ -1,0 +1,15 @@
+"""Layer runtimes: the batch and speed halves of the lambda architecture.
+
+TPU-native equivalents of framework/oryx-lambda (SURVEY.md §2.4): the batch
+layer re-trains a full model from all history on a long cadence; the speed
+layer folds micro-batches into incremental update messages on a short
+cadence; both read the input topic and write the update topic, persisting
+stream positions so restarts resume (the ZK-offset pattern of
+UpdateOffsetsFn.java). Spark Streaming's scheduling is replaced by plain
+interval loops — the heavy compute happens inside jitted ops, not in the
+carrier runtime.
+"""
+
+from oryx_tpu.layers.batch import BatchLayer
+from oryx_tpu.layers.speed import SpeedLayer
+from oryx_tpu.layers.datastore import load_all_data, save_generation

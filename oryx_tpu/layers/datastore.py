@@ -1,0 +1,54 @@
+"""Generation data store: append-only persistence of each batch window.
+
+The reference appends every generation's input as Hadoop SequenceFiles
+under dataDir/oryx-<timestamp>/ (SaveToHDFSFunction, skipping empty RDDs,
+BatchLayer.java:122-130) and re-reads ALL past data each generation with a
+glob (BatchUpdateFunction.java:103-130); TTL cleanup deletes aged dirs
+(DeleteOldDataFn). Here each generation is one record-log file using the
+bus wire format — so the native appender/scanner accelerate it too — under
+<data-dir>/oryx-<timestamp>/data.log.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Sequence
+
+from oryx_tpu.bus.api import KeyMessage
+from oryx_tpu.bus.filelog import _PartitionIndex, encode_record, _maybe_native
+from oryx_tpu.common.ioutil import list_generation_dirs, mkdirs, strip_scheme
+
+_DATA_FILE = "data.log"
+
+
+def save_generation(data_dir: str, timestamp_ms: int, records: Sequence[KeyMessage]) -> Path | None:
+    """Persist one generation's window; empty windows write nothing
+    (SaveToHDFSFunction skips empty RDDs)."""
+    if not records:
+        return None
+    d = mkdirs(Path(strip_scheme(data_dir)) / f"oryx-{timestamp_ms}")
+    path = d / _DATA_FILE
+    blob = b"".join(encode_record(km.key, km.message) for km in records)
+    native = _maybe_native()
+    if native is not None:
+        native.append_batch(str(path), blob)
+    else:
+        with open(path, "ab") as f:
+            f.write(blob)
+    return d
+
+
+def load_all_data(data_dir: str) -> list[KeyMessage]:
+    """All persisted generations, oldest first — the 'pastData' input to a
+    batch model build."""
+    out: list[KeyMessage] = []
+    for gen_dir in list_generation_dirs(strip_scheme(data_dir)):
+        path = gen_dir / _DATA_FILE
+        if not path.exists():
+            continue
+        idx = _PartitionIndex(path, _maybe_native())
+        recs = idx.read(0, 1 << 30)
+        out.extend(KeyMessage(k, m) for _, k, m in recs)
+    return out
+
+

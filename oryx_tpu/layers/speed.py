@@ -1,0 +1,144 @@
+"""Speed layer runtime: short-cadence incremental model updates.
+
+Mirrors the reference SpeedLayer (framework/oryx-lambda .../speed/
+SpeedLayer.java:52-192 + SpeedLayerUpdate.java): a dedicated listener
+thread replays the update topic from earliest into the user's
+SpeedModelManager.consume() forever (so the in-memory model rebuilds on
+restart), while the micro-batch loop drains the input topic every interval,
+asks the manager for update messages (buildUpdates), and publishes them to
+the update topic. The manager class comes from oryx.speed.model-manager-class.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from oryx_tpu.api import SpeedModelManager
+from oryx_tpu.bus.api import ConsumeDataIterator, TopicProducer
+from oryx_tpu.bus.broker import get_broker
+from oryx_tpu.common.classutil import load_instance_of
+from oryx_tpu.common.config import Config
+
+log = logging.getLogger(__name__)
+
+
+class SpeedLayer:
+    def __init__(self, config: Config, manager: SpeedModelManager | None = None):
+        self.config = config
+        self.group = f"OryxGroup-{config.get_string('oryx.id', None) or 'speed'}-speed"
+        self.input_uri = config.get_string("oryx.input-topic.broker")
+        self.input_topic = config.get_string("oryx.input-topic.message.topic")
+        self.update_uri = config.get_string("oryx.update-topic.broker")
+        self.update_topic = config.get_string("oryx.update-topic.message.topic")
+        self.interval_sec = config.get_int("oryx.speed.streaming.generation-interval-sec", 10)
+        if manager is not None:
+            self.manager = manager
+        else:
+            cls_name = config.get_string("oryx.speed.model-manager-class")
+            if not cls_name:
+                raise ValueError("no oryx.speed.model-manager-class configured")
+            self.manager = load_instance_of(cls_name, SpeedModelManager, config)
+
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._input_consumer: ConsumeDataIterator | None = None
+        self._update_consumer: ConsumeDataIterator | None = None
+        self.batch_count = 0
+
+    def ensure_streams(self) -> None:
+        """Open consumers/producers now (otherwise lazily on first use).
+        First-run consumers start at the live end of the input topic, like
+        the reference's auto.offset.reset=latest direct stream. Idempotent:
+        existing streams (and their positions) are kept."""
+        if self._input_consumer is not None:
+            return
+        input_broker = get_broker(self.input_uri)
+        update_broker = get_broker(self.update_uri)
+        for broker, topic in ((input_broker, self.input_topic), (update_broker, self.update_topic)):
+            if not broker.topic_exists(topic):
+                raise RuntimeError(f"topic does not exist: {topic}")
+        self._input_consumer = ConsumeDataIterator(
+            input_broker, self.input_topic, group=self.group, start="committed"
+        )
+        # model listener replays from earliest so the in-memory model
+        # rebuilds after restart (SpeedLayer.java:99-110)
+        self._update_consumer = ConsumeDataIterator(
+            update_broker, self.update_topic, group=f"{self.group}-updates", start="earliest"
+        )
+        self._producer = TopicProducer(update_broker, self.update_topic)
+
+    def run_batch(self) -> int:
+        """One micro-batch synchronously: drain input, build updates,
+        publish. Returns records processed. On failure the window is NOT
+        committed — unlike the batch layer (which persists the window and
+        retries over history), the speed tier keeps nothing, so committing
+        past a failed build would silently drop those interactions; instead
+        the consumer rewinds to the committed offsets and reprocesses."""
+        if self._input_consumer is None:
+            self.ensure_streams()
+        batch = self._input_consumer.poll_available()
+        if batch:
+            try:
+                updates = list(self.manager.build_updates(batch))
+                if updates:
+                    self._producer.send_batch(updates)
+            except Exception:
+                log.exception("speed update build failed; window will be reprocessed")
+                self._rewind_input()
+                self.batch_count += 1
+                return len(batch)
+        self._input_consumer.commit()
+        self.batch_count += 1
+        return len(batch)
+
+    def _rewind_input(self) -> None:
+        """Reopen the input consumer at the last committed offsets."""
+        broker = get_broker(self.input_uri)
+        self._input_consumer.close()
+        self._input_consumer = ConsumeDataIterator(
+            broker, self.input_topic, group=self.group, start="committed"
+        )
+
+    def start(self) -> None:
+        self.ensure_streams()
+
+        def listen():
+            try:
+                self.manager.consume(self._update_consumer)
+            except Exception:
+                if not self._stop.is_set():
+                    log.exception("speed model listener died")
+
+        def loop():
+            while not self._stop.wait(self.interval_sec):
+                try:
+                    self.run_batch()
+                except Exception:
+                    log.exception("speed micro-batch failed")
+
+        t1 = threading.Thread(target=listen, name="oryx-speed-model-listener", daemon=True)
+        t2 = threading.Thread(target=loop, name="oryx-speed", daemon=True)
+        self._threads = [t1, t2]
+        t1.start()
+        t2.start()
+
+    def await_termination(self) -> None:
+        for t in self._threads:
+            t.join()
+
+    def close(self) -> None:
+        self._stop.set()
+        for c in (self._input_consumer, self._update_consumer):
+            if c:
+                c.close()
+        self.manager.close()
+        for t in self._threads:
+            t.join(timeout=10)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,13 @@
+"""Serving layer: low-latency REST over in-memory (in-device) models.
+
+TPU-native equivalent of framework/oryx-lambda-serving + app/oryx-app-serving
+(SURVEY.md §2.5, §2.11): an embedded threaded HTTP server hosts app
+resources; a listener thread replays the update topic into the app's
+ServingModelManager; endpoints gate on model-load fraction (503 before
+ready) and render CSV or JSON by Accept header. The model's hot path is a
+device matmul + top-k instead of the reference's LSH-partitioned thread
+fan-out.
+"""
+
+from oryx_tpu.serving.app import OryxServingException, ServingApp
+from oryx_tpu.serving.server import ServingLayer

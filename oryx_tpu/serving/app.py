@@ -1,0 +1,209 @@
+"""Mini resource framework: routing, content negotiation, readiness gating.
+
+Plays the role of Jersey + the serving base resources
+(OryxApplication.java's annotation scan, AbstractOryxResource's model
+readiness gate and sendInput, CSVMessageBodyWriter's text/csv rendering,
+OryxExceptionMapper's error mapping — SURVEY.md §2.5, §2.11). Routes are
+registered by app modules through register(app); path patterns support
+{name} segments and {name:rest} tails.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from oryx_tpu.api import ServingModelManager
+from oryx_tpu.bus.api import TopicProducer
+from oryx_tpu.common.classutil import load_class
+from oryx_tpu.common.config import Config
+
+
+class OryxServingException(Exception):
+    """HTTP-status-carrying error (reference OryxServingException)."""
+
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    params: dict[str, str]
+    query: dict[str, list[str]]
+    body: bytes
+    headers: dict[str, str]
+
+    def q1(self, name: str, default: str | None = None) -> str | None:
+        vals = self.query.get(name)
+        return vals[0] if vals else default
+
+    def q_list(self, name: str) -> list[str]:
+        return self.query.get(name, [])
+
+    def body_text(self) -> str:
+        return self.body.decode("utf-8")
+
+
+@dataclass
+class _Route:
+    method: str
+    pattern: re.Pattern
+    handler: Callable[["ServingApp", Request], Any]
+
+
+def _compile(pattern: str) -> re.Pattern:
+    parts = []
+    for seg in pattern.strip("/").split("/"):
+        if seg.startswith("{") and seg.endswith("}"):
+            name = seg[1:-1]
+            if name.endswith(":rest"):
+                parts.append(f"(?P<{name[:-5]}>.+)")
+            else:
+                parts.append(f"(?P<{name}>[^/]+)")
+        else:
+            parts.append(re.escape(seg))
+    return re.compile("^/" + "/".join(parts) + "$")
+
+
+class ServingApp:
+    """Holds the model manager, input producer, config, and route table."""
+
+    def __init__(
+        self,
+        config: Config,
+        model_manager: ServingModelManager,
+        input_producer: TopicProducer | None = None,
+    ):
+        self.config = config
+        self.model_manager = model_manager
+        self.input_producer = input_producer
+        self.min_fraction = config.get_float("oryx.serving.min-model-load-fraction", 0.8)
+        self.routes: list[_Route] = []
+        self._load_resources()
+
+    def _load_resources(self) -> None:
+        """Import configured resource modules and let them register routes —
+        the OryxApplication package-scan equivalent."""
+        import importlib
+
+        for mod_name in self.config.get_list("oryx.serving.application-resources", []):
+            mod = importlib.import_module(str(mod_name))
+            register = getattr(mod, "register", None)
+            if register is None:
+                raise ValueError(f"resource module {mod_name} has no register(app)")
+            register(self)
+
+    def route(self, method: str, pattern: str):
+        def deco(fn):
+            self.routes.append(_Route(method.upper(), _compile(pattern), fn))
+            return fn
+
+        return deco
+
+    # -- helpers resources use (AbstractOryxResource equivalents) ----------
+
+    def get_serving_model(self):
+        """The loaded model, or 503 until fraction-loaded crosses the
+        threshold (AbstractOryxResource.java:75-95)."""
+        model = self.model_manager.get_model()
+        if model is None or model.fraction_loaded() < self.min_fraction:
+            raise OryxServingException(503, "model not yet available")
+        return model
+
+    def send_input(self, line: str) -> None:
+        """POST a raw input line to the input topic, keyed by its hash
+        (AbstractOryxResource.sendInput)."""
+        if self.input_producer is None:
+            raise OryxServingException(405, "serving layer is read-only")
+        self.input_producer.send(str(abs(hash(line)) % (1 << 31)), line)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def dispatch(self, req: Request) -> tuple[int, bytes, str]:
+        """Route and render; returns (status, body_bytes, content_type)."""
+        matched_path = False
+        for r in self.routes:
+            m = r.pattern.match(req.path)
+            if not m:
+                continue
+            matched_path = True
+            if r.method != req.method:
+                continue
+            req.params = {k: _unquote(v) for k, v in m.groupdict().items()}
+            try:
+                result = r.handler(self, req)
+            except OryxServingException as e:
+                return _render_error(e.status, e.message, req)
+            except Exception as e:  # noqa: BLE001 - boundary: render a 500
+                return _render_error(500, f"{type(e).__name__}: {e}", req)
+            return _render(result, req)
+        if matched_path:
+            return _render_error(405, "method not allowed", req)
+        return _render_error(404, f"no such endpoint: {req.path}", req)
+
+
+def _unquote(s: str) -> str:
+    from urllib.parse import unquote
+
+    return unquote(s)
+
+
+def _wants_json(req: Request) -> bool:
+    accept = req.headers.get("accept", "")
+    if "application/json" in accept:
+        return True
+    if "text/csv" in accept or "text/plain" in accept:
+        return False
+    return True  # default JSON
+
+
+def _to_csv_rows(value: Any) -> list[list]:
+    from oryx_tpu.common.text import join_csv
+
+    if value is None:
+        return []
+    if isinstance(value, dict):
+        return [[k, v] for k, v in value.items()]
+    if isinstance(value, (list, tuple)):
+        rows = []
+        for item in value:
+            if isinstance(item, (list, tuple)):
+                rows.append(list(item))
+            elif isinstance(item, dict):
+                rows.append(list(item.values()))
+            else:
+                rows.append([item])
+        return rows
+    return [[value]]
+
+
+def _render(result: Any, req: Request) -> tuple[int, bytes, str]:
+    if result is None:
+        return 204, b"", "text/plain"
+    if isinstance(result, tuple) and len(result) == 2 and isinstance(result[0], int):
+        status, payload = result
+        if payload is None:
+            return status, b"", "text/plain"
+    else:
+        status, payload = 200, result
+    if _wants_json(req):
+        return status, json.dumps(payload).encode("utf-8"), "application/json"
+    from oryx_tpu.common.text import join_csv
+
+    rows = _to_csv_rows(payload)
+    text = "\n".join(join_csv(r) for r in rows)
+    return status, (text + ("\n" if text else "")).encode("utf-8"), "text/csv"
+
+
+def _render_error(status: int, message: str, req: Request) -> tuple[int, bytes, str]:
+    """Error body rendering (reference ErrorResource: JSON or plain)."""
+    if _wants_json(req):
+        body = json.dumps({"status": status, "error": message}).encode("utf-8")
+        return status, body, "application/json"
+    return status, f"{status} {message}\n".encode("utf-8"), "text/plain"

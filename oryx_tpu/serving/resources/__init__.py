@@ -1,0 +1,3 @@
+"""Serving REST resources; modules here export register(app) and are named
+in oryx.serving.application-resources (the OryxApplication scan analogue).
+"""

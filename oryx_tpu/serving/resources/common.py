@@ -1,0 +1,35 @@
+"""Resources every app shares: /ready health check and /ingest bulk input.
+
+Mirrors the reference's Ready.java:33-46 (GET/HEAD 200-or-503 on model
+load fraction) and Ingest.java (bulk lines -> input topic, gzip-aware via
+the server's request decoding).
+"""
+
+from __future__ import annotations
+
+from oryx_tpu.serving.app import OryxServingException, Request, ServingApp
+
+
+def register(app: ServingApp) -> None:
+    @app.route("GET", "/ready")
+    def ready(a: ServingApp, req: Request):
+        a.get_serving_model()  # raises 503 if not ready
+        return 200, {"ready": True}
+
+    @app.route("HEAD", "/ready")
+    def ready_head(a: ServingApp, req: Request):
+        a.get_serving_model()
+        return 200, None
+
+    @app.route("POST", "/ingest")
+    def ingest(a: ServingApp, req: Request):
+        text = req.body_text()
+        if not text.strip():
+            raise OryxServingException(400, "empty ingest body")
+        n = 0
+        for line in text.splitlines():
+            line = line.strip()
+            if line:
+                a.send_input(line)
+                n += 1
+        return 200, {"ingested": n}

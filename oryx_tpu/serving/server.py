@@ -1,0 +1,175 @@
+"""Serving layer runtime: embedded threaded HTTP server + model listener.
+
+Mirrors the reference ServingLayer + ModelManagerListener (framework/
+oryx-lambda-serving .../ServingLayer.java:58-339, ModelManagerListener.java:
+59-235): on start it reflectively loads the user's ServingModelManager,
+spawns an update-topic listener thread replaying from earliest (so the
+in-memory model rebuilds), creates an input-topic producer unless read-only,
+and serves the app's routes on a thread-pooled HTTP server with optional
+basic auth and gzip request bodies.
+"""
+
+from __future__ import annotations
+
+import base64
+import gzip
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+from oryx_tpu.api import ServingModelManager
+from oryx_tpu.bus.api import ConsumeDataIterator, TopicProducer
+from oryx_tpu.bus.broker import get_broker
+from oryx_tpu.common.classutil import load_instance_of
+from oryx_tpu.common.config import Config
+from oryx_tpu.serving.app import Request, ServingApp
+
+log = logging.getLogger(__name__)
+
+
+class ServingLayer:
+    def __init__(self, config: Config, model_manager: ServingModelManager | None = None):
+        self.config = config
+        self.port = config.get_int("oryx.serving.api.port", 8080)
+        self.read_only = config.get_bool("oryx.serving.api.read-only", False)
+        self.user = config.get_string("oryx.serving.api.user-name", None)
+        self.password = config.get_string("oryx.serving.api.password", None)
+        self.group = f"OryxGroup-{config.get_string('oryx.id', None) or 'serving'}-serving"
+        self.update_uri = config.get_string("oryx.update-topic.broker")
+        self.update_topic = config.get_string("oryx.update-topic.message.topic")
+        self.input_uri = config.get_string("oryx.input-topic.broker")
+        self.input_topic = config.get_string("oryx.input-topic.message.topic")
+
+        if model_manager is not None:
+            self.model_manager = model_manager
+        else:
+            cls_name = config.get_string("oryx.serving.model-manager-class")
+            if not cls_name:
+                raise ValueError("no oryx.serving.model-manager-class configured")
+            self.model_manager = load_instance_of(cls_name, ServingModelManager, config)
+
+        self._update_consumer: ConsumeDataIterator | None = None
+        self._listener: threading.Thread | None = None
+        self._httpd: ThreadingHTTPServer | None = None
+        self._http_thread: threading.Thread | None = None
+        self.app: ServingApp | None = None
+
+    def start(self) -> None:
+        update_broker = get_broker(self.update_uri)
+        if not update_broker.topic_exists(self.update_topic):
+            raise RuntimeError(f"topic does not exist: {self.update_topic}")
+
+        input_producer = None
+        if not self.read_only:
+            input_broker = get_broker(self.input_uri)
+            if not input_broker.topic_exists(self.input_topic):
+                raise RuntimeError(f"topic does not exist: {self.input_topic}")
+            input_producer = TopicProducer(input_broker, self.input_topic)
+
+        # model listener: replay update topic from earliest forever
+        # (ModelManagerListener.java:118-149)
+        self._update_consumer = ConsumeDataIterator(
+            update_broker, self.update_topic, group=f"{self.group}-updates", start="earliest"
+        )
+
+        def listen():
+            try:
+                self.model_manager.consume(self._update_consumer)
+            except Exception:
+                log.exception("serving model listener died")
+
+        self._listener = threading.Thread(
+            target=listen, name="oryx-serving-model-listener", daemon=True
+        )
+        self._listener.start()
+
+        self.app = ServingApp(self.config, self.model_manager, input_producer)
+        handler = _make_handler(self.app, self._auth_header())
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+        self.port = self._httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="oryx-serving-http", daemon=True
+        )
+        self._http_thread.start()
+        log.info("serving layer listening on :%d", self.port)
+
+    def _auth_header(self) -> str | None:
+        if self.user and self.password:
+            token = base64.b64encode(f"{self.user}:{self.password}".encode()).decode()
+            return f"Basic {token}"
+        return None
+
+    def await_termination(self) -> None:
+        if self._http_thread:
+            self._http_thread.join()
+
+    def close(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._update_consumer:
+            self._update_consumer.close()
+        self.model_manager.close()
+        if self._listener:
+            self._listener.join(timeout=10)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _make_handler(app: ServingApp, auth: str | None):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route to logging, not stderr
+            log.debug("http: " + fmt, *args)
+
+        def _handle(self, method: str) -> None:
+            if auth is not None and self.headers.get("Authorization") != auth:
+                body = b'{"status":401,"error":"unauthorized"}'
+                self.send_response(401)
+                self.send_header("WWW-Authenticate", 'Basic realm="Oryx"')
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            split = urlsplit(self.path)
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            if self.headers.get("Content-Encoding", "").lower() == "gzip" and body:
+                body = gzip.decompress(body)
+            req = Request(
+                method=method,
+                path=split.path,
+                params={},
+                query=parse_qs(split.query),
+                body=body,
+                headers={k.lower(): v for k, v in self.headers.items()},
+            )
+            status, payload, ctype = app.dispatch(req)
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            if method != "HEAD":
+                self.wfile.write(payload)
+
+        def do_GET(self):
+            self._handle("GET")
+
+        def do_HEAD(self):
+            self._handle("HEAD")
+
+        def do_POST(self):
+            self._handle("POST")
+
+        def do_DELETE(self):
+            self._handle("DELETE")
+
+    return Handler

@@ -1,0 +1,201 @@
+"""Tests for the batch/speed layer runtimes and the generation datastore,
+using mock updates/managers (the MockBatchUpdate pattern, SURVEY.md §4)."""
+
+import threading
+import time
+
+import pytest
+
+from oryx_tpu.api import AbstractSpeedModelManager, BatchLayerUpdate
+from oryx_tpu.bus.api import KeyMessage, TopicProducer
+from oryx_tpu.bus.broker import get_broker, topics
+from oryx_tpu.bus.inproc import InProcBroker
+from oryx_tpu.common.config import load_config
+from oryx_tpu.layers import BatchLayer, SpeedLayer
+from oryx_tpu.layers.datastore import load_all_data, save_generation
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    InProcBroker.reset_all()
+    yield
+    InProcBroker.reset_all()
+
+
+def _cfg(tmp_path, name, **extra):
+    overlay = {
+        "oryx.id": name,
+        "oryx.input-topic.broker": f"mem://{name}",
+        "oryx.update-topic.broker": f"mem://{name}",
+        "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+        "oryx.batch.streaming.generation-interval-sec": 1,
+        "oryx.speed.streaming.generation-interval-sec": 1,
+    }
+    overlay.update(extra)
+    cfg = load_config(overlay=overlay)
+    topics.maybe_create(f"mem://{name}", cfg.get_string("oryx.input-topic.message.topic"), 2)
+    topics.maybe_create(f"mem://{name}", cfg.get_string("oryx.update-topic.message.topic"), 1)
+    return cfg
+
+
+# ---- datastore ------------------------------------------------------------
+
+def test_datastore_roundtrip_and_order(tmp_path):
+    d = str(tmp_path / "ds")
+    save_generation(d, 1000, [KeyMessage("a", "m1"), KeyMessage(None, "m2")])
+    save_generation(d, 2000, [KeyMessage("b", "m3")])
+    assert save_generation(d, 3000, []) is None  # empty window writes nothing
+    got = load_all_data(d)
+    assert [km.message for km in got] == ["m1", "m2", "m3"]
+    assert got[1].key is None
+
+
+# ---- batch layer ----------------------------------------------------------
+
+class _RecordingUpdate(BatchLayerUpdate):
+    def __init__(self):
+        self.calls = []
+
+    def run_update(self, ts, new_data, past_data, model_dir, producer):
+        self.calls.append((len(new_data), len(past_data)))
+        producer.send("MODEL", f"model-at-{ts}")
+
+
+def test_batch_layer_generations_accumulate_history(tmp_path):
+    cfg = _cfg(tmp_path, "b1")
+    upd = _RecordingUpdate()
+    layer = BatchLayer(cfg, update=upd)
+    layer.ensure_streams()  # consumers start at 'latest' on first run
+    broker = get_broker("mem://b1")
+    in_topic = cfg.get_string("oryx.input-topic.message.topic")
+
+    for i in range(3):
+        broker.send(in_topic, None, f"g1-{i}")
+    layer.run_generation(timestamp_ms=1000)
+    for i in range(2):
+        broker.send(in_topic, None, f"g2-{i}")
+    layer.run_generation(timestamp_ms=2000)
+    layer.run_generation(timestamp_ms=3000)
+
+    assert upd.calls == [(3, 0), (2, 3), (0, 5)]
+    # models published per generation with data
+    recs = broker.read(cfg.get_string("oryx.update-topic.message.topic"), 0, 0, 10)
+    assert [m for _, _, m in recs] == ["model-at-1000", "model-at-2000", "model-at-3000"]
+    layer.close()
+
+
+def test_batch_layer_resumes_from_committed_offsets(tmp_path):
+    cfg = _cfg(tmp_path, "b2")
+    broker = get_broker("mem://b2")
+    in_topic = cfg.get_string("oryx.input-topic.message.topic")
+    upd1 = _RecordingUpdate()
+    layer1 = BatchLayer(cfg, update=upd1)
+    layer1.ensure_streams()
+    broker.send(in_topic, None, "first")
+    layer1.run_generation(timestamp_ms=1000)
+    layer1.close()
+    # restart: same group resumes after 'first'
+    broker.send(in_topic, None, "second")
+    upd2 = _RecordingUpdate()
+    layer2 = BatchLayer(cfg, update=upd2)
+    layer2.run_generation(timestamp_ms=2000)
+    assert upd2.calls == [(1, 1)]  # only 'second' is new; 'first' is history
+    layer2.close()
+
+
+def test_batch_layer_survives_failing_update(tmp_path):
+    class _Boom(BatchLayerUpdate):
+        def run_update(self, *a):
+            raise RuntimeError("boom")
+
+    cfg = _cfg(tmp_path, "b3")
+    broker = get_broker("mem://b3")
+    layer = BatchLayer(cfg, update=_Boom())
+    layer.ensure_streams()
+    broker.send(cfg.get_string("oryx.input-topic.message.topic"), None, "x")
+    layer.run_generation(timestamp_ms=1000)  # must not raise
+    # window persisted + offsets committed despite failure
+    assert len(load_all_data(str(tmp_path / "data"))) == 1
+    layer.close()
+
+
+def test_batch_layer_interval_loop(tmp_path):
+    cfg = _cfg(tmp_path, "b4")
+    upd = _RecordingUpdate()
+    layer = BatchLayer(cfg, update=upd)
+    layer.ensure_streams()
+    broker = get_broker("mem://b4")
+    broker.send(cfg.get_string("oryx.input-topic.message.topic"), None, "x")
+    layer.start()
+    deadline = time.time() + 10
+    while layer.generation_count == 0 and time.time() < deadline:
+        time.sleep(0.05)
+    layer.close()
+    assert layer.generation_count >= 1
+    assert upd.calls and upd.calls[0][0] == 1
+
+
+# ---- speed layer ----------------------------------------------------------
+
+class _EchoSpeedManager(AbstractSpeedModelManager):
+    def __init__(self):
+        self.seen_updates = []
+
+    def consume_key_message(self, key, message):
+        self.seen_updates.append((key, message))
+
+    def build_updates(self, new_data):
+        return [("UP", f"delta:{km.message}") for km in new_data]
+
+
+def test_speed_layer_micro_batch_and_listener(tmp_path):
+    cfg = _cfg(tmp_path, "s1")
+    broker = get_broker("mem://s1")
+    in_topic = cfg.get_string("oryx.input-topic.message.topic")
+    up_topic = cfg.get_string("oryx.update-topic.message.topic")
+    # a model already on the update topic: listener must replay it
+    broker.send(up_topic, "MODEL", "the-model")
+
+    mgr = _EchoSpeedManager()
+    layer = SpeedLayer(cfg, manager=mgr)
+    layer.start()
+    deadline = time.time() + 10
+    while not mgr.seen_updates and time.time() < deadline:
+        time.sleep(0.05)
+    assert ("MODEL", "the-model") in mgr.seen_updates
+
+    broker.send(in_topic, None, "interaction1")
+    deadline = time.time() + 10
+    while layer.batch_count < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    layer.close()
+    recs = broker.read(up_topic, 0, 0, 100)
+    assert ("UP", "delta:interaction1") in [(k, m) for _, k, m in recs]
+
+
+def test_speed_layer_run_batch_sync(tmp_path):
+    cfg = _cfg(tmp_path, "s2")
+    broker = get_broker("mem://s2")
+    in_topic = cfg.get_string("oryx.input-topic.message.topic")
+    mgr = _EchoSpeedManager()
+    layer = SpeedLayer(cfg, manager=mgr)
+    layer.ensure_streams()
+    broker.send(in_topic, None, "a")
+    broker.send(in_topic, None, "b")
+    n = layer.run_batch()
+    assert n == 2
+    assert layer.run_batch() == 0  # drained
+    layer.close()
+
+
+def test_layer_requires_existing_topics(tmp_path):
+    cfg = load_config(overlay={
+        "oryx.input-topic.broker": "mem://missing",
+        "oryx.update-topic.broker": "mem://missing",
+        "oryx.batch.storage.data-dir": str(tmp_path / "d"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "m"),
+    })
+    layer = BatchLayer(cfg, update=_RecordingUpdate())
+    with pytest.raises(RuntimeError, match="topic does not exist"):
+        layer.run_generation()
