@@ -17,11 +17,43 @@ the heavy lifting happens inside jitted ops, not in the carrier collection.
 
 from __future__ import annotations
 
+import logging
+import time
 from abc import ABC, abstractmethod
 from typing import Iterable, Iterator, Sequence
 
 from oryx_tpu.bus.api import KeyMessage, TopicProducer
 from oryx_tpu.common.config import Config
+
+_log = logging.getLogger(__name__)
+
+
+def _dispatch_update(handler, km: KeyMessage) -> None:
+    """Per-message dispatch with error isolation: a poison message must not
+    kill the listener thread (it replays from earliest on restart and would
+    hit the same message forever, freezing the model). MODEL/MODEL-REF I/O
+    failures may be transient (MODEL-REF points at shared storage that can
+    lag the publish), so only OSError retries — briefly, because replay
+    also walks MODEL-REFs whose artifacts were TTL-pruned long ago, and
+    every sleep here multiplies across that history. Parse/validation
+    errors are deterministic and never retried."""
+    retries = 3 if km.key in ("MODEL", "MODEL-REF") else 0
+    for attempt in range(retries + 1):
+        try:
+            handler(km.key, km.message)
+            return
+        except OSError:
+            if attempt < retries:
+                _log.warning(
+                    "model load I/O failure (attempt %d/%d); retrying",
+                    attempt + 1, retries,
+                )
+                time.sleep(0.2 * (attempt + 1))
+            else:
+                _log.exception("giving up on update message (key=%r)", km.key)
+        except Exception:
+            _log.exception("ignoring bad update message (key=%r)", km.key)
+            return
 
 
 class BatchLayerUpdate(ABC):
@@ -60,7 +92,7 @@ class AbstractSpeedModelManager(SpeedModelManager):
 
     def consume(self, updates: Iterator[KeyMessage]) -> None:
         for km in updates:
-            self.consume_key_message(km.key, km.message)
+            _dispatch_update(self.consume_key_message, km)
 
     @abstractmethod
     def consume_key_message(self, key: str | None, message: str) -> None: ...
@@ -96,7 +128,7 @@ class ServingModelManager(ABC):
 class AbstractServingModelManager(ServingModelManager):
     def consume(self, updates: Iterator[KeyMessage]) -> None:
         for km in updates:
-            self.consume_key_message(km.key, km.message)
+            _dispatch_update(self.consume_key_message, km)
 
     @abstractmethod
     def consume_key_message(self, key: str | None, message: str) -> None: ...

@@ -19,11 +19,10 @@ import numpy as np
 import jax.numpy as jnp
 
 from oryx_tpu.api import AbstractServingModelManager, ServingModel
-from oryx_tpu.common.artifact import read_artifact_from_update
 from oryx_tpu.common.config import Config
 from oryx_tpu.ops.als import compute_updated_xu, topk_dot
-from oryx_tpu.apps.als.common import ALSConfig, parse_update_message
-from oryx_tpu.apps.als.state import ALSState
+from oryx_tpu.apps.als.common import ALSConfig
+from oryx_tpu.apps.als.state import ALSState, apply_update_message
 
 log = logging.getLogger(__name__)
 
@@ -34,6 +33,7 @@ class ALSServingModel(ServingModel):
         # (device matrix, ids, version) swapped as ONE tuple: readers always
         # see a matched pair, no lock on the read path
         self._device_view: tuple | None = None
+        self._unit_view: tuple | None = None  # row-normalized Y, same keying
         self._sync_lock = threading.Lock()
 
     def fraction_loaded(self) -> float:
@@ -59,6 +59,23 @@ class ALSServingModel(ServingModel):
             self._device_view = view
         return view[0], view[1]
 
+    def _y_unit_view(self):
+        """Row-normalized Y for cosine queries, cached per store version so
+        the O(N.K) normalization runs once per model drift, not per request."""
+        y, ids = self._y_view()
+        version = self._device_view[2]
+        view = self._unit_view
+        if view is not None and view[2] == version:
+            return view[0], view[1]
+        with self._sync_lock:
+            view = self._unit_view
+            if view is not None and view[2] == version:
+                return view[0], view[1]
+            norms = jnp.maximum(jnp.linalg.norm(y, axis=1, keepdims=True), 1e-12)
+            view = (y / norms, ids, version)
+            self._unit_view = view
+        return view[0], view[1]
+
     # -- queries -----------------------------------------------------------
 
     def top_n(
@@ -67,8 +84,9 @@ class ALSServingModel(ServingModel):
         how_many: int,
         exclude: set[str] = frozenset(),
         rescorer=None,
+        cosine: bool = False,
     ) -> list[tuple[str, float]]:
-        y, ids = self._y_view()
+        y, ids = self._y_unit_view() if cosine else self._y_view()
         n = len(ids)
         if n == 0:
             return []
@@ -176,47 +194,10 @@ class ALSServingModelManager(AbstractServingModelManager):
         return self._rescorer_provider
 
     def consume_key_message(self, key: str | None, message: str) -> None:
-        if key in ("MODEL", "MODEL-REF"):
-            art = read_artifact_from_update(key, message)
-            features = int(art.get_extension("features"))
-            implicit = art.get_extension("implicit", "true") == "true"
-            if self.model is None or self.model.state.features != features:
-                self.model = ALSServingModel(ALSState(features, implicit))
-            st = self.model.state
-            xids = art.get_extension_list("XIDs")
-            yids = art.get_extension_list("YIDs")
-            if xids or yids:
-                st.set_expected(xids, yids)
-                st.retain_only(set(xids), set(yids))
-            else:
-                st.set_expected(st.x.ids(), st.y.ids())
-            if art.tensors:
-                x, y = art.tensors.get("X"), art.tensors.get("Y")
-                if y is not None and len(yids) == len(y):
-                    for j, iid in enumerate(yids):
-                        st.y.set(iid, y[j])
-                if x is not None and len(xids) == len(x):
-                    for j, uid in enumerate(xids):
-                        st.x.set(uid, x[j])
-                for u, items in art.content.get("knownItems", {}).items():
-                    st.add_known_items(u, items)
-        elif key == "UP":
-            if self.model is None:
-                return
-            st = self.model.state
-            kind, ident, vec, known = parse_update_message(message)
-            if len(vec) != st.features:
-                return
-            if kind == "X":
-                st.x.set(ident, vec)
-                if st.expected_x is not None:
-                    st.expected_x.add(ident)
-                if known:
-                    st.add_known_items(ident, known)
-            elif kind == "Y":
-                st.y.set(ident, vec)
-                if st.expected_y is not None:
-                    st.expected_y.add(ident)
+        prev = self.model.state if self.model is not None else None
+        state = apply_update_message(prev, key, message, with_known_items=True)
+        if state is not None and state is not prev:
+            self.model = ALSServingModel(state)
 
 
 def _load_rescorer_provider(config: Config):

@@ -18,19 +18,16 @@ import logging
 import numpy as np
 
 from oryx_tpu.api import AbstractSpeedModelManager
-from oryx_tpu.bus.api import KeyMessage
-from oryx_tpu.common.artifact import read_artifact_from_update
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.locks import RateLimitCheck
 from oryx_tpu.ops.als import aggregate_interactions, fold_in_batch, fold_in_batch_explicit
 from oryx_tpu.apps.als.common import (
     ALSConfig,
     parse_events,
-    parse_update_message,
     x_update_message,
     y_update_message,
 )
-from oryx_tpu.apps.als.state import ALSState
+from oryx_tpu.apps.als.state import ALSState, apply_update_message
 
 log = logging.getLogger(__name__)
 
@@ -46,46 +43,9 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
     # -- update-topic consumption ------------------------------------------
 
     def consume_key_message(self, key: str | None, message: str) -> None:
-        if key in ("MODEL", "MODEL-REF"):
-            art = read_artifact_from_update(key, message)
-            features = int(art.get_extension("features"))
-            implicit = art.get_extension("implicit", "true") == "true"
-            if self.state is None or self.state.features != features:
-                # rank changed: a fresh state (ALSSpeedModelManager.java:
-                # 100-115 keys retention on the features hyperparam)
-                self.state = ALSState(features, implicit)
-            st = self.state
-            xids = art.get_extension_list("XIDs")
-            yids = art.get_extension_list("YIDs")
-            if xids or yids:
-                st.set_expected(xids, yids)
-                st.retain_only(set(xids), set(yids))
-            else:
-                # skeleton without ID lists: expected IDs arrive via UP flood;
-                # treat current contents as the expectation baseline
-                st.set_expected(st.x.ids(), st.y.ids())
-            if art.tensors:
-                x, y = art.tensors.get("X"), art.tensors.get("Y")
-                if x is not None and len(xids) == len(x):
-                    for j, uid in enumerate(xids):
-                        st.x.set(uid, x[j])
-                if y is not None and len(yids) == len(y):
-                    for j, iid in enumerate(yids):
-                        st.y.set(iid, y[j])
-        elif key == "UP":
-            if self.state is None:
-                return  # updates before any model: nothing to apply to
-            kind, ident, vec, _known = parse_update_message(message)
-            if len(vec) != self.state.features:
-                return  # stale update from a different-rank model
-            if kind == "X":
-                self.state.x.set(ident, vec)
-                if self.state.expected_x is not None:
-                    self.state.expected_x.add(ident)
-            elif kind == "Y":
-                self.state.y.set(ident, vec)
-                if self.state.expected_y is not None:
-                    self.state.expected_y.add(ident)
+        self.state = apply_update_message(
+            self.state, key, message, with_known_items=False
+        )
 
     # -- micro-batch -> updates --------------------------------------------
 
@@ -98,10 +58,14 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
         users, items, vals, tss = parse_events(new_data)
         if len(vals) == 0:
             return []
+        # same strength transform the batch model was trained with — folding
+        # raw strengths into a log1p-trained model would overweight them
         agg = aggregate_interactions(
             users, items, vals, tss,
             implicit=st.implicit,
             zero_threshold=self.als.zero_threshold,
+            log_strength=self.als.log_strength,
+            epsilon=self.als.epsilon,
         )
         if len(agg.values) == 0:
             return []

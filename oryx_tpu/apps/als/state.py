@@ -191,3 +191,91 @@ class ALSState:
             self.known_items = {
                 u: s for u, s in self.known_items.items() if u in x_keep
             }
+
+
+# ---------------------------------------------------------------------------
+# shared update-topic consumption (speed + serving tiers)
+# ---------------------------------------------------------------------------
+
+def apply_update_message(
+    state: ALSState | None,
+    key: str | None,
+    message: str,
+    *,
+    with_known_items: bool = False,
+) -> ALSState | None:
+    """Apply one update-topic message to the in-memory model, returning the
+    (possibly new) state. The single implementation behind both
+    ALSSpeedModelManager.consumeKeyMessage (app/oryx-app .../als/
+    ALSSpeedModelManager.java:68-133) and ALSServingModelManager's
+    (app/oryx-app-serving .../als/model/ALSServingModelManager.java:69-135):
+
+    MODEL / MODEL-REF -> a fresh state when the features hyperparam changed
+    (retention is keyed on rank, ALSSpeedModelManager.java:100-115),
+    otherwise retain only the announced IDs; ingest any inline factor
+    tensors; the implicit flag is refreshed even when the state is kept.
+    UP -> set one user/item vector (rank-mismatched stale updates dropped).
+    """
+    from oryx_tpu.common.artifact import read_artifact_from_update
+    from oryx_tpu.apps.als.common import parse_update_message
+
+    if key in ("MODEL", "MODEL-REF"):
+        art = read_artifact_from_update(key, message)
+        features = int(art.get_extension("features"))
+        implicit = art.get_extension("implicit", "true") == "true"
+        # validate BEFORE mutating: a raise below this block would leave a
+        # half-applied model (pruned vectors, swapped expected sets) serving
+        # silently after the listener skips the message
+        xids_v = art.get_extension_list("XIDs")
+        yids_v = art.get_extension_list("YIDs")
+        for tname, ids in (("X", xids_v), ("Y", yids_v)):
+            t = art.tensors.get(tname) if art.tensors else None
+            if t is not None and len(ids) == len(t) and len(t) > 0:
+                if t.ndim != 2 or t.shape[1] != features:
+                    raise ValueError(
+                        f"model artifact {tname} tensor shape {t.shape} "
+                        f"inconsistent with features={features}"
+                    )
+        if state is None or state.features != features:
+            state = ALSState(features, implicit)
+        else:
+            # same rank but possibly flipped feedback mode: the vectors stay
+            # valid, the fold-in rule must follow the new model
+            state.implicit = implicit
+        xids = art.get_extension_list("XIDs")
+        yids = art.get_extension_list("YIDs")
+        if xids or yids:
+            state.set_expected(xids, yids)
+            state.retain_only(set(xids), set(yids))
+        else:
+            # skeleton without ID lists: expected IDs arrive via UP flood;
+            # treat current contents as the expectation baseline
+            state.set_expected(state.x.ids(), state.y.ids())
+        if art.tensors:
+            x, y = art.tensors.get("X"), art.tensors.get("Y")
+            if y is not None and len(yids) == len(y):
+                for j, iid in enumerate(yids):
+                    state.y.set(iid, y[j])
+            if x is not None and len(xids) == len(x):
+                for j, uid in enumerate(xids):
+                    state.x.set(uid, x[j])
+            if with_known_items:
+                for u, items in art.content.get("knownItems", {}).items():
+                    state.add_known_items(u, items)
+    elif key == "UP":
+        if state is None:
+            return None  # updates before any model: nothing to apply to
+        kind, ident, vec, known = parse_update_message(message)
+        if len(vec) != state.features:
+            return state  # stale update from a different-rank model
+        if kind == "X":
+            state.x.set(ident, vec)
+            if state.expected_x is not None:
+                state.expected_x.add(ident)
+            if with_known_items and known:
+                state.add_known_items(ident, known)
+        elif kind == "Y":
+            state.y.set(ident, vec)
+            if state.expected_y is not None:
+                state.expected_y.add(ident)
+    return state

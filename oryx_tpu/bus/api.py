@@ -94,6 +94,15 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
         """Next-to-deliver offset per partition (what commit() records)."""
         return dict(self._delivered_pos)
 
+    def seek(self, positions: dict[int, int]) -> None:
+        """Rewind/advance to explicit per-partition offsets, dropping any
+        prefetched records — the recovery path when a window must be
+        reprocessed after a failed build."""
+        self._buffer = []
+        self._buf_i = 0
+        self._fetch_pos = dict(positions)
+        self._delivered_pos = dict(positions)
+
     def commit(self) -> None:
         self._broker.commit_offsets(self._group, self._topic, self._delivered_pos)
 

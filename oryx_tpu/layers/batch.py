@@ -69,6 +69,10 @@ class BatchLayer:
         self._consumer = ConsumeDataIterator(
             input_broker, self.input_topic, group=self.group, start="committed"
         )
+        # pin the start position durably: on a fresh group "committed" falls
+        # back to the log END, so a crash before the first generation commit
+        # would otherwise re-resolve to a LATER end and drop the gap
+        self._consumer.commit()
         self._producer = TopicProducer(update_broker, self.update_topic)
 
     def run_generation(self, timestamp_ms: int | None = None) -> int:

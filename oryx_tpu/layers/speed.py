@@ -62,6 +62,10 @@ class SpeedLayer:
         self._input_consumer = ConsumeDataIterator(
             input_broker, self.input_topic, group=self.group, start="committed"
         )
+        # pin the start position durably: on a fresh group "committed" falls
+        # back to the log END, so a crash before the first commit would
+        # otherwise re-resolve to a later end and silently drop the gap
+        self._input_consumer.commit()
         # model listener replays from earliest so the in-memory model
         # rebuilds after restart (SpeedLayer.java:99-110)
         self._update_consumer = ConsumeDataIterator(
@@ -78,6 +82,7 @@ class SpeedLayer:
         the consumer rewinds to the committed offsets and reprocesses."""
         if self._input_consumer is None:
             self.ensure_streams()
+        window_start = self._input_consumer.positions()
         batch = self._input_consumer.poll_available()
         if batch:
             try:
@@ -85,21 +90,16 @@ class SpeedLayer:
                 if updates:
                     self._producer.send_batch(updates)
             except Exception:
+                # rewind to where this window began (NOT the committed
+                # offsets — on a fresh group those fall back to the log end,
+                # which would silently drop the failed window)
                 log.exception("speed update build failed; window will be reprocessed")
-                self._rewind_input()
+                self._input_consumer.seek(window_start)
                 self.batch_count += 1
                 return len(batch)
         self._input_consumer.commit()
         self.batch_count += 1
         return len(batch)
-
-    def _rewind_input(self) -> None:
-        """Reopen the input consumer at the last committed offsets."""
-        broker = get_broker(self.input_uri)
-        self._input_consumer.close()
-        self._input_consumer = ConsumeDataIterator(
-            broker, self.input_topic, group=self.group, start="committed"
-        )
 
     def start(self) -> None:
         self.ensure_streams()

@@ -150,7 +150,9 @@ def register(app: ServingApp) -> None:
             raise OryxServingException(404, "no known items")
         how_many, offset = _how_many(req)
         rescorer = _rescorer(a, "get_most_similar_items_rescorer", req, model)
-        pairs = model.top_n(mean_vec, how_many + offset, set(items), rescorer)
+        pairs = model.top_n(
+            mean_vec, how_many + offset, set(items), rescorer, cosine=True
+        )
         return _page(pairs, how_many, offset)
 
     @app.route("GET", "/similarityToItem/{toItemID}/{itemIDs:rest}")

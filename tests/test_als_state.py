@@ -1,0 +1,95 @@
+"""Unit tests for the shared ALS state/update-consumption logic and the
+serving model's scoring variants (review regressions)."""
+
+import numpy as np
+
+from oryx_tpu.apps.als.serving import ALSServingModel
+from oryx_tpu.apps.als.state import ALSState, apply_update_message
+from oryx_tpu.common.artifact import ModelArtifact
+
+
+def _model_message(features=2, implicit=True, xids=(), yids=()):
+    art = ModelArtifact(app="als")
+    art.set_extension("features", str(features))
+    art.set_extension("implicit", "true" if implicit else "false")
+    if xids:
+        art.set_extension("XIDs", list(xids))
+    if yids:
+        art.set_extension("YIDs", list(yids))
+    return art.to_string()
+
+
+def test_apply_update_flips_implicit_without_discarding_vectors():
+    st = apply_update_message(None, "MODEL", _model_message(implicit=True))
+    st.x.set("u1", np.array([1.0, 0.0], dtype=np.float32))
+    assert st.implicit is True
+    st2 = apply_update_message(st, "MODEL", _model_message(implicit=False))
+    assert st2 is st  # same rank: state retained
+    assert st2.implicit is False  # but the feedback mode follows the model
+    assert st2.x.get("u1") is not None
+
+
+def test_apply_update_rank_change_resets_state():
+    st = apply_update_message(None, "MODEL", _model_message(features=2))
+    st2 = apply_update_message(st, "MODEL", _model_message(features=3))
+    assert st2 is not st
+    assert st2.features == 3
+
+
+def test_apply_update_up_and_stale_rank_drop():
+    st = apply_update_message(None, "MODEL", _model_message(features=2))
+    st = apply_update_message(st, "UP", '["X","u9",[0.5,0.5]]')
+    assert st.x.get("u9") is not None
+    st = apply_update_message(st, "UP", '["X","u10",[0.5,0.5,0.5]]')  # rank 3
+    assert st.x.get("u10") is None
+
+
+def test_known_items_only_with_flag():
+    st = apply_update_message(
+        None, "MODEL", _model_message(), with_known_items=True
+    )
+    st = apply_update_message(
+        st, "UP", '["X","u1",[1.0,0.0],["i1","i2"]]', with_known_items=True
+    )
+    assert st.get_known_items("u1") == {"i1", "i2"}
+    st2 = apply_update_message(None, "MODEL", _model_message())
+    st2 = apply_update_message(st2, "UP", '["X","u1",[1.0,0.0],["i1"]]')
+    assert st2.get_known_items("u1") == set()
+
+
+def test_top_n_cosine_ignores_norm():
+    """/similarity must rank by direction, not raw dot: a huge-norm vector
+    pointing elsewhere must lose to an aligned unit vector."""
+    st = ALSState(2, True)
+    st.y.set("aligned", np.array([0.9, 0.1], dtype=np.float32))
+    st.y.set("big-off", np.array([0.0, 10.0], dtype=np.float32))
+    model = ALSServingModel(st)
+    q = np.array([1.0, 0.0], dtype=np.float32)
+    dot_first = model.top_n(q, 2)[0][0]
+    cos_first = model.top_n(q, 2, cosine=True)[0][0]
+    assert dot_first in ("aligned", "big-off")  # dot may prefer the big norm
+    assert cos_first == "aligned"
+
+
+def test_corrupt_model_tensor_rejected_before_mutation():
+    """A MODEL whose tensors disagree with its features extension must fail
+    BEFORE retain/expected mutation — not leave a half-applied model."""
+    import pytest
+    from oryx_tpu.apps.als.state import apply_update_message as apply
+
+    st = apply(None, "MODEL", _model_message(features=2, xids=("u1",), yids=("i1",)))
+    st = apply(st, "UP", '["X","u1",[1.0,0.0]]')
+    st = apply(st, "UP", '["Y","i1",[0.0,1.0]]')
+    assert st.fraction_loaded() == 1.0
+
+    import numpy as np
+    from oryx_tpu.common.artifact import ModelArtifact
+    bad = ModelArtifact(app="als", tensors={"Y": np.ones((2, 3), dtype=np.float32)})
+    bad.set_extension("features", "2")  # claims rank 2, tensor is rank 3
+    bad.set_extension("XIDs", [])
+    bad.set_extension("YIDs", ["i1", "i2"])
+    with pytest.raises(ValueError):
+        apply(st, "MODEL", bad.to_string())
+    # state untouched: still fully loaded with the old expectations
+    assert st.fraction_loaded() == 1.0
+    assert st.x.get("u1") is not None

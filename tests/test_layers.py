@@ -199,3 +199,92 @@ def test_layer_requires_existing_topics(tmp_path):
     layer = BatchLayer(cfg, update=_RecordingUpdate())
     with pytest.raises(RuntimeError, match="topic does not exist"):
         layer.run_generation()
+
+
+# ---- review regressions ----------------------------------------------------
+
+class _FailOnceManager(AbstractSpeedModelManager):
+    """build_updates fails on its first call, then echoes everything seen."""
+
+    def __init__(self):
+        self.fail_next = True
+        self.seen = []
+
+    def consume_key_message(self, key, message):
+        pass
+
+    def build_updates(self, new_data):
+        if self.fail_next:
+            self.fail_next = False
+            raise RuntimeError("transient build failure")
+        self.seen.extend(km.message for km in new_data)
+        return []
+
+
+def test_speed_layer_failed_window_reprocessed_without_commits(tmp_path):
+    """A failed first micro-batch must be reprocessed even though the group
+    has no committed offsets yet (committed-fallback is the log END, so a
+    naive reopen would silently drop the window)."""
+    cfg = _cfg(tmp_path, "srw")
+    broker = get_broker("mem://srw")
+    in_topic = cfg.get_string("oryx.input-topic.message.topic")
+    mgr = _FailOnceManager()
+    layer = SpeedLayer(cfg, manager=mgr)
+    layer.ensure_streams()
+    for i in range(4):
+        broker.send(in_topic, None, f"evt-{i}")
+    assert layer.run_batch() == 4  # fails inside, window rewound
+    assert mgr.seen == []
+    assert layer.run_batch() == 4  # same window again, now processed
+    assert sorted(mgr.seen) == [f"evt-{i}" for i in range(4)]
+    layer.close()
+
+
+class _CountingManager(AbstractSpeedModelManager):
+    def __init__(self):
+        self.good = []
+
+    def consume_key_message(self, key, message):
+        if message == "poison":
+            raise ValueError("bad payload")
+        self.good.append(message)
+
+    def build_updates(self, new_data):
+        return []
+
+
+def test_poison_update_message_does_not_kill_consume():
+    mgr = _CountingManager()
+    mgr.consume(iter([
+        KeyMessage("UP", "ok-1"),
+        KeyMessage("UP", "poison"),
+        KeyMessage("UP", "ok-2"),
+    ]))
+    assert mgr.good == ["ok-1", "ok-2"]
+
+
+class _FlakyModelManager(AbstractSpeedModelManager):
+    """MODEL load fails twice (simulating lagging shared storage) then works."""
+
+    def __init__(self):
+        self.attempts = 0
+        self.loaded = []
+
+    def consume_key_message(self, key, message):
+        if key == "MODEL":
+            self.attempts += 1
+            if self.attempts < 3:
+                raise IOError("artifact not visible yet")
+        self.loaded.append((key, message))
+
+    def build_updates(self, new_data):
+        return []
+
+
+def test_transient_model_load_failure_retries(monkeypatch):
+    import oryx_tpu.api as api_mod
+    monkeypatch.setattr(api_mod.time, "sleep", lambda s: None)
+    mgr = _FlakyModelManager()
+    mgr.consume(iter([KeyMessage("MODEL", "m-payload")]))
+    assert mgr.attempts == 3
+    assert mgr.loaded == [("MODEL", "m-payload")]
