@@ -41,29 +41,34 @@ class ALSServingModel(ServingModel):
 
     # -- device scoring view ----------------------------------------------
 
-    def _y_view(self):
-        """(device Y matrix, row ids) resynced lazily on version drift —
-        a double-buffered atomic tuple swap instead of the reference's
-        fine-grained read locks on the hot path. Staleness probe is a cheap
-        version read; the full arena copies only on drift."""
+    def _y_view_full(self) -> tuple:
+        """(device Y matrix, row ids, version) resynced lazily on version
+        drift — a double-buffered atomic tuple swap instead of the
+        reference's fine-grained read locks on the hot path. Staleness probe
+        is a cheap version read; the full arena copies only on drift."""
         view = self._device_view
         version = self.state.y.get_version()
         if view is not None and view[2] == version:
-            return view[0], view[1]
+            return view
         with self._sync_lock:
             view = self._device_view
             if view is not None and view[2] == self.state.y.get_version():
-                return view[0], view[1]
+                return view
             mat, ids, version = self.state.y.snapshot()
             view = (jnp.asarray(mat), ids, version)
             self._device_view = view
+        return view
+
+    def _y_view(self):
+        view = self._y_view_full()
         return view[0], view[1]
 
     def _y_unit_view(self):
         """Row-normalized Y for cosine queries, cached per store version so
-        the O(N.K) normalization runs once per model drift, not per request."""
-        y, ids = self._y_view()
-        version = self._device_view[2]
+        the O(N.K) normalization runs once per model drift, not per request.
+        y/ids/version come from ONE view tuple — re-reading the version
+        separately could cache a stale matrix under a newer stamp."""
+        y, ids, version = self._y_view_full()
         view = self._unit_view
         if view is not None and view[2] == version:
             return view[0], view[1]

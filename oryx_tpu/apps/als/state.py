@@ -226,9 +226,9 @@ def apply_update_message(
         # validate BEFORE mutating: a raise below this block would leave a
         # half-applied model (pruned vectors, swapped expected sets) serving
         # silently after the listener skips the message
-        xids_v = art.get_extension_list("XIDs")
-        yids_v = art.get_extension_list("YIDs")
-        for tname, ids in (("X", xids_v), ("Y", yids_v)):
+        xids = art.get_extension_list("XIDs")
+        yids = art.get_extension_list("YIDs")
+        for tname, ids in (("X", xids), ("Y", yids)):
             t = art.tensors.get(tname) if art.tensors else None
             if t is not None and len(ids) == len(t) and len(t) > 0:
                 if t.ndim != 2 or t.shape[1] != features:
@@ -242,8 +242,6 @@ def apply_update_message(
             # same rank but possibly flipped feedback mode: the vectors stay
             # valid, the fold-in rule must follow the new model
             state.implicit = implicit
-        xids = art.get_extension_list("XIDs")
-        yids = art.get_extension_list("YIDs")
         if xids or yids:
             state.set_expected(xids, yids)
             state.retain_only(set(xids), set(yids))

@@ -1,0 +1,10 @@
+from oryx_tpu.apps.kmeans.batch import KMeansUpdate
+from oryx_tpu.apps.kmeans.speed import KMeansSpeedModelManager
+from oryx_tpu.apps.kmeans.serving import KMeansServingModel, KMeansServingModelManager
+
+__all__ = [
+    "KMeansUpdate",
+    "KMeansSpeedModelManager",
+    "KMeansServingModel",
+    "KMeansServingModelManager",
+]

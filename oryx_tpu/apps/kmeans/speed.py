@@ -1,0 +1,71 @@
+"""k-means speed tier: per-micro-batch centroid shifts.
+
+Mirrors KMeansSpeedModelManager (app/oryx-app .../speed/kmeans/
+KMeansSpeedModelManager.java:55-125): "UP" messages are ignored (hearing
+our own updates — the serving tier applies them); MODEL(-REF) replaces the
+local model; build_updates assigns each datum to its closest cluster, one
+batched device call for the whole window, reduces per-cluster (mean, count),
+applies ClusterInfo.update to the local copy, and emits
+[clusterID, newCenter, newCount] messages.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from oryx_tpu.api import AbstractSpeedModelManager
+from oryx_tpu.common.artifact import read_artifact_from_update
+from oryx_tpu.common.config import Config
+from oryx_tpu.ops.kmeans import assign_clusters, online_update
+from oryx_tpu.apps.kmeans.common import cluster_update_message, vectorize_rows
+from oryx_tpu.apps.schema import InputSchema
+
+log = logging.getLogger(__name__)
+
+
+class KMeansSpeedModelManager(AbstractSpeedModelManager):
+    def __init__(self, config: Config):
+        self.config = config
+        self.schema = InputSchema(config)
+        self.centers: np.ndarray | None = None  # [K,D] f64
+        self.counts: np.ndarray | None = None  # [K] i64
+
+    def consume_key_message(self, key: str | None, message: str) -> None:
+        if key == "UP":
+            return  # hearing our own updates
+        if key in ("MODEL", "MODEL-REF"):
+            art = read_artifact_from_update(key, message)
+            self.centers = np.asarray(art.tensors["centers"], dtype=np.float64)
+            counts = art.content.get("counts")
+            self.counts = (
+                np.asarray(counts, dtype=np.int64)
+                if counts is not None
+                else np.ones(len(self.centers), dtype=np.int64)
+            )
+            log.info("new model loaded: %d clusters", len(self.centers))
+        else:
+            raise ValueError(f"bad key: {key}")
+
+    def build_updates(self, new_data):
+        if self.centers is None:
+            return []
+        points = vectorize_rows(self.schema, (km.message for km in new_data))
+        if len(points) == 0:
+            return []
+        ids, _ = assign_clusters(
+            np.asarray(points, dtype=np.float32),
+            np.asarray(self.centers, dtype=np.float32),
+        )
+        ids = np.asarray(ids)
+        out = []
+        for c in np.unique(ids):
+            members = points[ids == c]
+            new_center, new_total = online_update(
+                self.centers[c], int(self.counts[c]), members.mean(axis=0), len(members)
+            )
+            self.centers[c] = new_center
+            self.counts[c] = new_total
+            out.append(cluster_update_message(int(c), new_center, new_total))
+        return out

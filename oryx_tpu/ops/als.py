@@ -399,18 +399,6 @@ def topk_dot(xu, y, *, k: int, exclude_mask=None):
 
 
 @partial(jax.jit, static_argnames=("k",))
-def topk_cosine(xu, y, *, k: int):
-    """Top-k by cosine: candidate rows are unit-normalized in-kernel so
-    high-norm items can't dominate direction (the reference's similarity
-    endpoints are cosine-based, …/als/Similarity.java:59-99). The query's
-    own norm only scales all scores and is left alone."""
-    yf = y.astype(jnp.float32)
-    norms = jnp.maximum(jnp.linalg.norm(yf, axis=1), 1e-12)
-    scores = (yf @ xu.astype(jnp.float32)) / norms
-    return jax.lax.top_k(scores, k)
-
-
-@partial(jax.jit, static_argnames=("k",))
 def topk_dot_batch(xs, y, *, k: int):
     """Batched variant: [B,K] users at once -> one [B,I] matmul."""
     scores = xs.astype(jnp.float32) @ y.astype(jnp.float32).T

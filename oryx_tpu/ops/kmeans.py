@@ -1,0 +1,292 @@
+"""k-means — pjit-sharded Lloyd's iterations + k-means|| init + metrics.
+
+TPU-native re-design of the reference's k-means compute path (app/
+oryx-app-mllib .../kmeans/KMeansUpdate.java:104-116 invoking MLlib
+KMeans.train, with k-means|| or random init):
+
+- Each Lloyd iteration is two MXU ops over the whole dataset: a [N,K]
+  distance matrix via the ||x||^2 - 2x.c + ||c||^2 expansion (the x.c term
+  is one [N,D]x[D,K] matmul), then centroid recomputation as a one-hot
+  [K,N]x[N,D] matmul — segment-sum expressed as matrix product so XLA maps
+  it onto the systolic array. Points shard over the mesh "data" axis;
+  XLA inserts the psum for the per-shard partial center sums.
+
+- k-means|| init (Bahmani et al.) oversamples ~2k candidates per round by
+  distance-proportional sampling, then reduces the weighted candidate set
+  to k centers with weighted Lloyd — matching MLlib's K_MEANS_PARALLEL
+  default; "random" picks k distinct points.
+
+- Metrics mirror app/oryx-app-mllib .../kmeans/{SumSquaredError,
+  DaviesBouldinIndex,DunnIndex,SilhouetteCoefficient}.java semantics:
+  euclidean distances, mean-distance cluster scatter, silhouette over a
+  bounded sample with single-point clusters contributing 0.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oryx_tpu.common.rng import RandomManager
+
+SILHOUETTE_MAX_SAMPLE = 100_000
+
+
+# ---------------------------------------------------------------------------
+# assignment + training
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _sq_dists(points, centers):
+    """[N,K] squared euclidean distances via the matmul expansion."""
+    p2 = jnp.sum(points * points, axis=1, keepdims=True)
+    c2 = jnp.sum(centers * centers, axis=1)
+    cross = points @ centers.T
+    return jnp.maximum(p2 - 2.0 * cross + c2[None, :], 0.0)
+
+
+@jax.jit
+def assign_clusters(points, centers):
+    """-> (cluster ids [N] int32, distance-to-nearest [N] f32)."""
+    d2 = _sq_dists(points, centers)
+    ids = jnp.argmin(d2, axis=1).astype(jnp.int32)
+    return ids, jnp.sqrt(jnp.min(d2, axis=1))
+
+
+@partial(jax.jit, static_argnames=("iterations",))
+def lloyd_jit(points, weights, centers0, *, iterations: int):
+    """Weighted Lloyd's as one compiled lax.scan. Zero-weight rows (padding)
+    can never move a centroid; empty clusters keep their previous center."""
+
+    def body(centers, _):
+        d2 = _sq_dists(points, centers)
+        ids = jnp.argmin(d2, axis=1)
+        onehot = (
+            jax.nn.one_hot(ids, centers.shape[0], dtype=jnp.float32)
+            * weights[:, None]
+        )
+        sums = onehot.T @ points  # [K,D] segment-sum as matmul
+        counts = onehot.sum(axis=0)
+        new_centers = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers
+        )
+        return new_centers, None
+
+    centers, _ = jax.lax.scan(body, centers0, None, length=iterations)
+    # final assignment for cluster sizes
+    ids = jnp.argmin(_sq_dists(points, centers), axis=1)
+    counts = (
+        jax.nn.one_hot(ids, centers.shape[0], dtype=jnp.float32) * weights[:, None]
+    ).sum(axis=0)
+    return centers, counts
+
+
+def _kmeans_parallel_init(
+    points: np.ndarray, weights: np.ndarray, k: int, key, rounds: int = 5
+) -> np.ndarray:
+    """k-means|| oversampling, reduced to k centers by weighted Lloyd."""
+    n = len(points)
+    keys = jax.random.split(key, rounds + 2)
+    first = int(jax.random.randint(keys[0], (), 0, n))
+    candidates = [points[first]]
+    ell = 2 * k
+    for r in range(rounds):
+        centers = np.stack(candidates)
+        _, dist = assign_clusters(jnp.asarray(points), jnp.asarray(centers))
+        d2 = np.asarray(dist, dtype=np.float64) ** 2 * weights
+        total = d2.sum()
+        if total <= 0:
+            break
+        prob = np.minimum(1.0, ell * d2 / total)
+        draw = np.asarray(
+            jax.random.uniform(keys[r + 1], (n,), dtype=jnp.float32)
+        )
+        picked = np.nonzero(draw < prob)[0]
+        candidates.extend(points[j] for j in picked)
+        if len(candidates) >= max(ell * rounds, k):
+            break
+    cand = np.unique(np.stack(candidates), axis=0)
+    if len(cand) <= k:
+        # not enough distinct candidates: fill with random distinct points
+        extra_idx = np.asarray(
+            jax.random.choice(keys[-1], n, (min(n, 2 * k),), replace=False)
+        )
+        cand = np.unique(np.concatenate([cand, points[extra_idx]]), axis=0)
+    if len(cand) < k:
+        raise ValueError(f"fewer than k={k} distinct points")
+    # weight candidates by the total point weight attracted to each
+    ids, _ = assign_clusters(jnp.asarray(points), jnp.asarray(cand))
+    w = np.zeros(len(cand), dtype=np.float32)
+    np.add.at(w, np.asarray(ids), weights.astype(np.float32))
+    # reduce candidates -> k centers (weighted Lloyd from a random k-subset)
+    sub = np.asarray(jax.random.choice(keys[-1], len(cand), (k,), replace=False))
+    centers, _ = lloyd_jit(
+        jnp.asarray(cand), jnp.asarray(w), jnp.asarray(cand[sub]), iterations=10
+    )
+    return np.asarray(centers)
+
+
+@dataclass
+class KMeansModelArrays:
+    centers: np.ndarray  # [K,D] f32
+    counts: np.ndarray  # [K] int64 cluster sizes on training data
+
+
+def train_kmeans(
+    points: np.ndarray,
+    k: int,
+    iterations: int = 30,
+    init: str = "k-means||",
+    mesh=None,
+    seed_key=None,
+) -> KMeansModelArrays:
+    """Train k-means. With a mesh, points shard over the "data" axis and the
+    whole scan runs SPMD (centers replicated, partial sums psum'd)."""
+    points = np.asarray(points, dtype=np.float32)
+    points = points[~np.isnan(points).any(axis=1)]
+    n = len(points)
+    if n == 0:
+        raise ValueError("no valid points")
+    k = min(k, len(np.unique(points, axis=0)))
+    key = seed_key if seed_key is not None else RandomManager.get_key()
+    k_init, k_run = jax.random.split(key)
+
+    weights = np.ones(n, dtype=np.float32)
+    if init == "random":
+        idx = np.asarray(jax.random.choice(k_init, n, (k,), replace=False))
+        centers0 = points[idx]
+    else:
+        centers0 = _kmeans_parallel_init(points, weights, k, k_init)
+
+    p, w = points, weights
+    if mesh is not None:
+        from oryx_tpu.parallel.mesh import DATA_AXIS, shard_array
+
+        axis = mesh.shape[DATA_AXIS]
+        pad = (-n) % axis
+        if pad:
+            # zero-weight padding rows: never move a centroid
+            p = np.concatenate([p, np.zeros((pad, p.shape[1]), dtype=np.float32)])
+            w = np.concatenate([w, np.zeros(pad, dtype=np.float32)])
+        p = shard_array(p, mesh)
+        w = shard_array(w, mesh)
+
+    centers, counts = lloyd_jit(
+        jnp.asarray(p), jnp.asarray(w), jnp.asarray(centers0), iterations=iterations
+    )
+    return KMeansModelArrays(
+        np.asarray(centers), np.asarray(counts).round().astype(np.int64)
+    )
+
+
+# ---------------------------------------------------------------------------
+# evaluation metrics (KMeansUpdate.java:137-173 strategies)
+# ---------------------------------------------------------------------------
+
+def _cluster_metrics(points: np.ndarray, centers: np.ndarray):
+    """Per-cluster (count, mean dist, sum sq dist) over assigned points —
+    the ClusterMetric reduction of AbstractKMeansEvaluation.java."""
+    ids, dist = assign_clusters(jnp.asarray(points), jnp.asarray(centers))
+    ids, dist = np.asarray(ids), np.asarray(dist, dtype=np.float64)
+    k = len(centers)
+    counts = np.bincount(ids, minlength=k).astype(np.float64)
+    sum_d = np.bincount(ids, weights=dist, minlength=k)
+    sum_d2 = np.bincount(ids, weights=dist**2, minlength=k)
+    mean_d = np.divide(sum_d, counts, out=np.zeros(k), where=counts > 0)
+    return ids, counts, mean_d, sum_d2
+
+
+def sum_squared_error(points: np.ndarray, centers: np.ndarray) -> float:
+    _, _, _, sum_d2 = _cluster_metrics(points, centers)
+    return float(sum_d2.sum())
+
+
+def davies_bouldin_index(points: np.ndarray, centers: np.ndarray) -> float:
+    """Lower is better; for each cluster i, max over j of
+    (scatter_i + scatter_j) / d(center_i, center_j), averaged."""
+    _, _, mean_d, _ = _cluster_metrics(points, centers)
+    k = len(centers)
+    if k < 2:
+        return 0.0
+    cd = np.sqrt(
+        np.maximum(np.asarray(_sq_dists(jnp.asarray(centers), jnp.asarray(centers))), 0)
+    )
+    total = 0.0
+    for i in range(k):
+        ratios = [
+            (mean_d[i] + mean_d[j]) / cd[i, j]
+            for j in range(k)
+            if j != i and cd[i, j] > 0
+        ]
+        total += max(ratios) if ratios else 0.0
+    return total / k
+
+
+def dunn_index(points: np.ndarray, centers: np.ndarray) -> float:
+    """Higher is better: min inter-centroid distance over max mean
+    intra-cluster distance."""
+    _, _, mean_d, _ = _cluster_metrics(points, centers)
+    k = len(centers)
+    if k < 2:
+        return 0.0
+    cd = np.sqrt(
+        np.maximum(np.asarray(_sq_dists(jnp.asarray(centers), jnp.asarray(centers))), 0)
+    )
+    inter = min(cd[i, j] for i in range(k) for j in range(i + 1, k))
+    intra = mean_d.max()
+    return float(inter / intra) if intra > 0 else 0.0
+
+
+def silhouette_coefficient(
+    points: np.ndarray, centers: np.ndarray, seed_key=None
+) -> float:
+    """Mean silhouette over a bounded sample; singleton clusters contribute
+    0 per the reference's convention (SilhouetteCoefficient.java)."""
+    points = np.asarray(points, dtype=np.float64)
+    if len(points) > SILHOUETTE_MAX_SAMPLE:
+        key = seed_key if seed_key is not None else RandomManager.get_key()
+        idx = np.asarray(
+            jax.random.choice(key, len(points), (SILHOUETTE_MAX_SAMPLE,), replace=False)
+        )
+        points = points[idx]
+    ids, _ = assign_clusters(
+        jnp.asarray(points, dtype=jnp.float32), jnp.asarray(centers)
+    )
+    ids = np.asarray(ids)
+    k = len(centers)
+    members = [points[ids == c] for c in range(k)]
+    total, count = 0.0, 0
+    for c in range(k):
+        pts = members[c]
+        count += len(pts)
+        if len(pts) <= 1:
+            continue
+        for x in pts:
+            d = np.linalg.norm(pts - x, axis=1)
+            a = d.sum() / (len(pts) - 1)  # exclude self
+            b = min(
+                (
+                    np.linalg.norm(members[o] - x, axis=1).mean()
+                    for o in range(k)
+                    if o != c and len(members[o]) > 0
+                ),
+                default=0.0,
+            )
+            m = max(a, b)
+            total += (b - a) / m if m > 0 else 0.0
+    return total / count if count else 0.0
+
+
+def online_update(
+    center: np.ndarray, count: int, new_point: np.ndarray, new_count: int
+) -> tuple[np.ndarray, int]:
+    """ClusterInfo.update (app/oryx-app-common .../kmeans/ClusterInfo.java:52):
+    shift the centroid toward the new (mean) point by newCount/total."""
+    center = np.asarray(center, dtype=np.float64)
+    total = count + new_count
+    frac = new_count / total
+    return center + frac * (np.asarray(new_point, dtype=np.float64) - center), total

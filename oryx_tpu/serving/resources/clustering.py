@@ -1,0 +1,64 @@
+"""Clustering REST endpoints — parity with the reference's generic
+clustering resources (app/oryx-app-serving .../clustering/{Assign,
+DistanceToNearest,Add}.java):
+
+  GET  /assign/{datum}            -> assigned cluster ID
+  POST /assign                    -> one ID per input line
+  GET  /distanceToNearest/{datum} -> distance to closest centroid
+  POST /add  (or /add/{datum})    -> send data points to the input topic
+"""
+
+from __future__ import annotations
+
+from oryx_tpu.serving.app import OryxServingException, Request, ServingApp
+
+
+def _vectorize_or_400(model, datum: str):
+    try:
+        return model.vectorize(datum)
+    except ValueError as e:
+        raise OryxServingException(400, f"bad datum: {e}") from None
+
+
+def register(app: ServingApp) -> None:
+    @app.route("GET", "/assign/{datum}")
+    def assign(a: ServingApp, req: Request):
+        model = a.get_serving_model()
+        cid, _ = model.closest_cluster(_vectorize_or_400(model, req.params["datum"]))
+        return str(cid)
+
+    @app.route("POST", "/assign")
+    def assign_post(a: ServingApp, req: Request):
+        model = a.get_serving_model()
+        out = []
+        for line in req.body_text().splitlines():
+            line = line.strip()
+            if line:
+                cid, _ = model.closest_cluster(_vectorize_or_400(model, line))
+                out.append(str(cid))
+        if not out:
+            raise OryxServingException(400, "no data points given")
+        return out
+
+    @app.route("GET", "/distanceToNearest/{datum}")
+    def distance_to_nearest(a: ServingApp, req: Request):
+        model = a.get_serving_model()
+        _, dist = model.closest_cluster(_vectorize_or_400(model, req.params["datum"]))
+        return str(dist)
+
+    @app.route("POST", "/add/{datum}")
+    def add_one(a: ServingApp, req: Request):
+        a.send_input(req.params["datum"])
+        return 200, None
+
+    @app.route("POST", "/add")
+    def add(a: ServingApp, req: Request):
+        n = 0
+        for line in req.body_text().splitlines():
+            line = line.strip()
+            if line:
+                a.send_input(line)
+                n += 1
+        if n == 0:
+            raise OryxServingException(400, "no data points given")
+        return 200, None

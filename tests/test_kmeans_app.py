@@ -1,0 +1,164 @@
+"""k-means application-tier tests: batch build + eval strategies, speed
+centroid shifts, serving assignment + live updates, and the REST surface
+over a real HTTP server (the KMeansUpdateIT / speed/serving IT pattern)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from oryx_tpu.apps.kmeans import (
+    KMeansServingModelManager,
+    KMeansSpeedModelManager,
+    KMeansUpdate,
+)
+from oryx_tpu.bus.api import KeyMessage, TopicProducer
+from oryx_tpu.bus.broker import get_broker, topics
+from oryx_tpu.bus.inproc import InProcBroker
+from oryx_tpu.common.config import load_config
+from oryx_tpu.common.ioutil import choose_free_port
+from oryx_tpu.serving.server import ServingLayer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    InProcBroker.reset_all()
+    yield
+    InProcBroker.reset_all()
+
+
+def _cfg(port=0):
+    return load_config(overlay={
+        "oryx.id": "kmt",
+        "oryx.input-topic.broker": "mem://kmt",
+        "oryx.update-topic.broker": "mem://kmt",
+        "oryx.serving.api.port": port,
+        "oryx.serving.model-manager-class":
+            "oryx_tpu.apps.kmeans.serving.KMeansServingModelManager",
+        "oryx.serving.application-resources": [
+            "oryx_tpu.serving.resources.common",
+            "oryx_tpu.serving.resources.clustering",
+        ],
+        "oryx.input-schema.num-features": 2,
+        "oryx.input-schema.numeric-features": ["0", "1"],
+        "oryx.kmeans.hyperparams.k": 2,
+        "oryx.kmeans.iterations": 10,
+        "oryx.ml.eval.test-fraction": 0.2,
+    })
+
+
+def _blob_lines(seed=0):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for c in ((0.0, 0.0), (10.0, 10.0)):
+        for _ in range(40):
+            x = rng.normal(c[0], 0.2)
+            y = rng.normal(c[1], 0.2)
+            lines.append(f"{x:.4f},{y:.4f}")
+    return [KeyMessage(None, ln) for ln in lines]
+
+
+def test_batch_build_and_eval_strategies():
+    data = _blob_lines()
+    for strategy in ("SILHOUETTE", "DAVIES_BOULDIN", "DUNN", "SSE"):
+        upd = KMeansUpdate(_cfg().overlay(
+            {"oryx.kmeans.evaluation-strategy": strategy}))
+        art = upd.build_model(data, {"k": 2})
+        assert art.tensors["centers"].shape == (2, 2)
+        assert sorted(art.content["counts"]) == [40, 40]
+        ev = upd.evaluate(art, data, [])
+        assert np.isfinite(ev)
+        if strategy == "SILHOUETTE":
+            assert ev > 0.8  # well-separated blobs
+        if strategy in ("DAVIES_BOULDIN", "SSE"):
+            assert ev < 0  # negated lower-is-better
+
+
+def test_speed_manager_shifts_centroids():
+    cfg = _cfg()
+    upd = KMeansUpdate(cfg)
+    art = upd.build_model(_blob_lines(), {"k": 2})
+    mgr = KMeansSpeedModelManager(cfg)
+    assert mgr.build_updates([KeyMessage(None, "0,0")]) == []  # no model yet
+    mgr.consume_key_message("MODEL", art.to_string())
+    # a window of points near one blob, displaced toward (2,2)
+    window = [KeyMessage(None, "2.0,2.0")] * 10
+    ups = mgr.build_updates(window)
+    assert len(ups) == 1
+    key, msg = ups[0]
+    assert key == "UP"
+    cid, center, count = json.loads(msg)[0], json.loads(msg)[1], json.loads(msg)[2]
+    assert count == 50  # 40 original + 10 new
+    # centroid moved from ~(0,0) toward (2,2) by 10/50
+    assert 0.3 < center[0] < 0.6
+    # UP messages are ignored on re-consume (hearing our own updates)
+    mgr.consume_key_message("UP", msg)
+
+
+def test_serving_model_applies_updates():
+    cfg = _cfg()
+    art = KMeansUpdate(cfg).build_model(_blob_lines(), {"k": 2})
+    mgr = KMeansServingModelManager(cfg)
+    mgr.consume_key_message("UP", json.dumps([0, [1.0, 1.0], 5]))  # pre-model: noop
+    mgr.consume_key_message("MODEL", art.to_string())
+    model = mgr.get_model()
+    cid0, d0 = model.closest_cluster(model.vectorize("0.1,0.1"))
+    cid1, d1 = model.closest_cluster(model.vectorize("9.9,10.1"))
+    assert cid0 != cid1 and d0 < 1 and d1 < 1
+    # live centroid replacement
+    mgr.consume_key_message(
+        "UP", json.dumps([cid0, [5.0, 5.0], 99]))
+    _, d_after = model.closest_cluster(model.vectorize("5.0,5.0"))
+    assert d_after < 1e-6
+    assert model.counts[cid0] == 99
+
+
+def _http(method, url, body=None):
+    req = urllib.request.Request(
+        url, method=method, data=body, headers={"Accept": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_clustering_rest_surface():
+    port = choose_free_port()
+    cfg = _cfg(port)
+    topics.maybe_create("mem://kmt", cfg.get_string("oryx.input-topic.message.topic"), 1)
+    topics.maybe_create("mem://kmt", cfg.get_string("oryx.update-topic.message.topic"), 1)
+    broker = get_broker("mem://kmt")
+    art = KMeansUpdate(cfg).build_model(_blob_lines(), {"k": 2})
+    broker.send(cfg.get_string("oryx.update-topic.message.topic"), "MODEL", art.to_string())
+
+    with ServingLayer(cfg) as layer:
+        base = f"http://127.0.0.1:{port}"
+        for _ in range(100):
+            try:
+                if _http("GET", f"{base}/ready")[0] == 200:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.1)
+        s, one = _http("GET", f"{base}/assign/0.1,0.2")
+        assert s == 200
+        s, other = _http("GET", f"{base}/assign/9.9,10.0")
+        assert s == 200 and json.loads(one) != json.loads(other)
+        s, body = _http("POST", f"{base}/assign", b"0.0,0.0\n10.0,10.0\n")
+        assert s == 200 and len(json.loads(body)) == 2
+        s, body = _http("GET", f"{base}/distanceToNearest/0.0,0.0")
+        assert s == 200 and float(json.loads(body)) < 1.0
+        s, body = _http("GET", f"{base}/assign/not-a-number,1")
+        assert s == 400
+        s, body = _http("GET", f"{base}/assign/1")  # wrong arity
+        assert s == 400
+        s, _ = _http("POST", f"{base}/add/3.0,4.0")
+        assert s == 200
+        in_topic = cfg.get_string("oryx.input-topic.message.topic")
+        recs = broker.read(in_topic, 0, 0, 10)
+        assert any(m == "3.0,4.0" for _, _, m in recs)

@@ -1,0 +1,90 @@
+"""k-means ops tests: training recovers planted blobs (single-device and
+8-device mesh), metrics match hand-computed values, online update parity
+with ClusterInfo.update."""
+
+import numpy as np
+import pytest
+
+from oryx_tpu.ops.kmeans import (
+    assign_clusters,
+    davies_bouldin_index,
+    dunn_index,
+    online_update,
+    silhouette_coefficient,
+    sum_squared_error,
+    train_kmeans,
+)
+from oryx_tpu.parallel.mesh import host_mesh
+
+
+def _blobs(n_per=60, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.array(
+        [[5.0] * d, [-5.0] * d, [5.0] * (d // 2) + [-5.0] * (d - d // 2)],
+        dtype=np.float32,
+    )
+    pts = np.concatenate(
+        [c + rng.normal(0, 0.3, (n_per, d)).astype(np.float32) for c in centers]
+    )
+    return pts, centers
+
+
+@pytest.mark.parametrize("init", ["k-means||", "random"])
+def test_train_recovers_blobs(init):
+    pts, true_centers = _blobs()
+    m = train_kmeans(pts, k=3, iterations=20, init=init)
+    assert m.centers.shape == (3, 4)
+    assert m.counts.sum() == len(pts)
+    # each true center has a learned center within noise distance
+    for tc in true_centers:
+        assert np.linalg.norm(m.centers - tc, axis=1).min() < 0.5
+    assert sorted(m.counts) == [60, 60, 60]
+
+
+def test_train_on_mesh_matches_shapes():
+    pts, true_centers = _blobs(n_per=50)  # 150 points: not divisible by 8
+    m = train_kmeans(pts, k=3, iterations=15, mesh=host_mesh())
+    for tc in true_centers:
+        assert np.linalg.norm(m.centers - tc, axis=1).min() < 0.5
+    assert m.counts.sum() == len(pts)  # zero-weight padding rows don't count
+
+
+def test_k_clamped_to_distinct_points():
+    pts = np.array([[0.0, 0.0], [1.0, 1.0], [0.0, 0.0]], dtype=np.float32)
+    m = train_kmeans(pts, k=5, iterations=5)
+    assert len(m.centers) == 2
+
+
+def test_assign_and_metrics_tiny():
+    centers = np.array([[0.0, 0.0], [10.0, 0.0]], dtype=np.float32)
+    pts = np.array(
+        [[1.0, 0.0], [-1.0, 0.0], [9.0, 0.0], [11.0, 0.0]], dtype=np.float32
+    )
+    ids, dist = assign_clusters(pts, centers)
+    assert list(np.asarray(ids)) == [0, 0, 1, 1]
+    assert np.allclose(np.asarray(dist), 1.0, atol=1e-5)
+    assert sum_squared_error(pts, centers) == pytest.approx(4.0, abs=1e-4)
+    # scatter_i = 1 for both; centroid distance 10 -> DB = (1+1)/10 = 0.2
+    assert davies_bouldin_index(pts, centers) == pytest.approx(0.2, abs=1e-4)
+    # dunn = min inter (10) / max mean intra (1)
+    assert dunn_index(pts, centers) == pytest.approx(10.0, abs=1e-3)
+    s = silhouette_coefficient(pts, centers)
+    assert 0.5 < s <= 1.0  # well-separated clusters
+
+
+def test_silhouette_singleton_cluster_zero():
+    centers = np.array([[0.0], [100.0]], dtype=np.float32)
+    pts = np.array([[0.0], [1.0], [100.0]], dtype=np.float32)
+    s = silhouette_coefficient(pts, centers)
+    # cluster 1 is a singleton (contributes 0); cluster 0's pair is tight
+    # vs far cluster -> strongly positive overall
+    assert s > 0.5
+
+
+def test_online_update_matches_reference_formula():
+    center, count = online_update(
+        np.array([0.0, 0.0]), 3, np.array([4.0, 8.0]), 1
+    )
+    # newToTotal = 1/4 -> center + 0.25*(p - center)
+    assert np.allclose(center, [1.0, 2.0])
+    assert count == 4

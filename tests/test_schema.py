@@ -1,0 +1,86 @@
+"""InputSchema / CategoricalValueEncodings tests (reference:
+InputSchemaTest, CategoricalValueEncodingsTest patterns)."""
+
+import numpy as np
+import pytest
+
+from oryx_tpu.apps.schema import (
+    CategoricalValueEncodings,
+    InputSchema,
+    encode_matrix,
+)
+from oryx_tpu.common.config import load_config
+
+
+def _schema(**overlay):
+    base = {
+        "oryx.input-schema.feature-names": ["id", "a", "b", "c", "label"],
+        "oryx.input-schema.id-features": ["id"],
+        "oryx.input-schema.ignored-features": ["c"],
+        "oryx.input-schema.categorical-features": ["b", "label"],
+        "oryx.input-schema.target-feature": "label",
+    }
+    base.update(overlay)
+    return InputSchema(load_config(overlay=base))
+
+
+def test_roles_and_predictor_maps():
+    s = _schema()
+    assert s.num_features == 5
+    assert s.num_predictors == 2  # a, b (id/c/label excluded)
+    assert s.is_id("id") and not s.is_active("id")
+    assert s.is_numeric("a") and s.is_categorical("b")
+    assert s.is_target("label") and s.is_classification()
+    assert s.feature_to_predictor_index(1) == 0
+    assert s.feature_to_predictor_index(2) == 1
+    assert s.predictor_to_feature_index(1) == 2
+    with pytest.raises(KeyError):
+        s.feature_to_predictor_index(0)  # id is not a predictor
+
+
+def test_generated_names_and_numeric_complement():
+    s = InputSchema(load_config(overlay={
+        "oryx.input-schema.num-features": 3,
+        "oryx.input-schema.numeric-features": ["0", "2"],
+    }))
+    assert s.feature_names == ["0", "1", "2"]
+    assert s.is_categorical("1")  # complement of numeric
+    assert s.num_predictors == 3
+    assert not s.has_target()
+
+
+def test_schema_validation_errors():
+    with pytest.raises(ValueError):
+        InputSchema(load_config(overlay={
+            "oryx.input-schema.feature-names": ["a", "a"],
+            "oryx.input-schema.numeric-features": ["a"],
+        }))
+    with pytest.raises(ValueError):
+        _schema(**{"oryx.input-schema.target-feature": "id"})  # not active
+
+
+def test_encodings_deterministic_and_roundtrip():
+    enc = CategoricalValueEncodings({2: ["z", "y", "z", "x"]})
+    assert enc.get_values(2) == ["x", "y", "z"]  # sorted, deduped
+    assert enc.encode(2, "y") == 1
+    assert enc.decode(2, 0) == "x"
+    assert enc.get_value_count(2) == 3
+    rt = CategoricalValueEncodings.from_content(enc.to_content())
+    assert rt.get_encoding_map(2) == enc.get_encoding_map(2)
+
+
+def test_encode_matrix():
+    s = _schema()
+    rows = [
+        ["u1", "1.5", "red", "junk", "yes"],
+        ["u2", "", "blue", "junk", "no"],
+        ["u3", "2.5", "green", "junk", ""],
+    ]
+    enc = CategoricalValueEncodings.from_data(s, rows)
+    x, t = encode_matrix(s, enc, rows)
+    assert x.shape == (3, 2)
+    assert x[0, 0] == 1.5 and np.isnan(x[1, 0])
+    # categorical codes: blue=0, green=1, red=2
+    assert x[0, 1] == 2.0 and x[1, 1] == 0.0 and x[2, 1] == 1.0
+    # target: no=0, yes=1; missing -> NaN
+    assert t[0] == 1.0 and t[1] == 0.0 and np.isnan(t[2])
