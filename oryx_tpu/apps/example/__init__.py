@@ -1,0 +1,12 @@
+"""Tutorial "wordcount" application — the minimal custom app showing the
+three-tier SPI without any ML, mirroring app/example in the reference."""
+
+from oryx_tpu.apps.example.batch import ExampleBatchLayerUpdate
+from oryx_tpu.apps.example.serving import ExampleServingModelManager
+from oryx_tpu.apps.example.speed import ExampleSpeedModelManager
+
+__all__ = [
+    "ExampleBatchLayerUpdate",
+    "ExampleServingModelManager",
+    "ExampleSpeedModelManager",
+]

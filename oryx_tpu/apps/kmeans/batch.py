@@ -9,12 +9,9 @@ sizes, and evaluate with the configured strategy over train+test
 
 from __future__ import annotations
 
-import logging
 from typing import Any, Sequence
 
-import numpy as np
-
-from oryx_tpu.bus.api import KeyMessage, TopicProducer
+from oryx_tpu.bus.api import KeyMessage
 from oryx_tpu.common.artifact import ModelArtifact
 from oryx_tpu.common.config import Config
 from oryx_tpu.ml.update import MLUpdate
@@ -27,9 +24,6 @@ from oryx_tpu.ops.kmeans import (
 )
 from oryx_tpu.apps.kmeans.common import KMeansConfig, vectorize_rows
 from oryx_tpu.apps.schema import InputSchema
-
-log = logging.getLogger(__name__)
-
 
 class KMeansUpdate(MLUpdate):
     def __init__(self, config: Config, mesh=None):
@@ -53,6 +47,7 @@ class KMeansUpdate(MLUpdate):
             iterations=self.kmeans.iterations,
             init=self.kmeans.init_strategy,
             mesh=self.mesh,
+            runs=self.kmeans.runs,
         )
         art = ModelArtifact(
             "kmeans",

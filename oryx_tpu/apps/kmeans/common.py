@@ -23,6 +23,7 @@ class KMeansConfig:
     init_strategy: str
     eval_strategy: str
     iterations: int
+    runs: int
     k: object  # hyperparam range value
 
     @classmethod
@@ -32,6 +33,7 @@ class KMeansConfig:
             init_strategy=str(g("initialization-strategy", "k-means||")),
             eval_strategy=str(g("evaluation-strategy", "SILHOUETTE")).upper(),
             iterations=int(g("iterations", 30)),
+            runs=int(g("runs", 1)),
             k=g("hyperparams.k", 10),
         )
 

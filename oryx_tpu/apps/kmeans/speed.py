@@ -29,43 +29,57 @@ class KMeansSpeedModelManager(AbstractSpeedModelManager):
     def __init__(self, config: Config):
         self.config = config
         self.schema = InputSchema(config)
-        self.centers: np.ndarray | None = None  # [K,D] f64
-        self.counts: np.ndarray | None = None  # [K] i64
+        # (centers [K,D] f64, counts [K] i64) published as ONE attribute so
+        # a reader can never pair new centers with old counts
+        self._model: tuple[np.ndarray, np.ndarray] | None = None
+
+    @property
+    def centers(self) -> np.ndarray | None:
+        return self._model[0] if self._model else None
+
+    @property
+    def counts(self) -> np.ndarray | None:
+        return self._model[1] if self._model else None
 
     def consume_key_message(self, key: str | None, message: str) -> None:
         if key == "UP":
             return  # hearing our own updates
         if key in ("MODEL", "MODEL-REF"):
             art = read_artifact_from_update(key, message)
-            self.centers = np.asarray(art.tensors["centers"], dtype=np.float64)
+            centers = np.asarray(art.tensors["centers"], dtype=np.float64)
             counts = art.content.get("counts")
-            self.counts = (
+            self._model = (
+                centers,
                 np.asarray(counts, dtype=np.int64)
                 if counts is not None
-                else np.ones(len(self.centers), dtype=np.int64)
+                else np.ones(len(centers), dtype=np.int64),
             )
-            log.info("new model loaded: %d clusters", len(self.centers))
+            log.info("new model loaded: %d clusters", len(centers))
         else:
             raise ValueError(f"bad key: {key}")
 
     def build_updates(self, new_data):
-        if self.centers is None:
+        # snapshot: the listener thread may swap in a new model (possibly a
+        # different k) mid-batch; compute the whole window against one model
+        model = self._model
+        if model is None:
             return []
+        centers, counts = model
         points = vectorize_rows(self.schema, (km.message for km in new_data))
         if len(points) == 0:
             return []
         ids, _ = assign_clusters(
             np.asarray(points, dtype=np.float32),
-            np.asarray(self.centers, dtype=np.float32),
+            np.asarray(centers, dtype=np.float32),
         )
         ids = np.asarray(ids)
         out = []
         for c in np.unique(ids):
             members = points[ids == c]
             new_center, new_total = online_update(
-                self.centers[c], int(self.counts[c]), members.mean(axis=0), len(members)
+                centers[c], int(counts[c]), members.mean(axis=0), len(members)
             )
-            self.centers[c] = new_center
-            self.counts[c] = new_total
+            centers[c] = new_center
+            counts[c] = new_total
             out.append(cluster_update_message(int(c), new_center, new_total))
         return out

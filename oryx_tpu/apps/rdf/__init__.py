@@ -1,0 +1,1 @@
+"""Random-decision-forest application (batch/speed/serving tiers)."""

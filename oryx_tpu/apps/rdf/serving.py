@@ -1,0 +1,84 @@
+"""RDF serving tier: in-memory forest + live terminal-node updates.
+
+Mirrors RDFServingModel / RDFServingModelManager (app/oryx-app-serving
+.../rdf/model/): MODEL(-REF) replaces the forest; "UP"
+[treeID, nodeID, ...] messages fold counts (classification) or a
+(mean, count) summary (regression) into the addressed terminal node's
+prediction (RDFServingModelManager.java:57-84). fraction_loaded is 1
+once a model is present (the forest arrives whole, unlike ALS factors).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from oryx_tpu.api import AbstractServingModelManager, ServingModel
+from oryx_tpu.common.artifact import read_artifact_from_update
+from oryx_tpu.common.config import Config
+from oryx_tpu.apps.rdf.common import RDFModel, artifact_to_model
+from oryx_tpu.apps.schema import InputSchema
+
+log = logging.getLogger(__name__)
+
+
+class RDFServingModel(ServingModel):
+    def __init__(self, model: RDFModel):
+        self.rdf = model
+
+    def fraction_loaded(self) -> float:
+        return 1.0
+
+    @property
+    def schema(self) -> InputSchema:
+        return self.rdf.schema
+
+    def predict(self, datum: str):
+        return self.rdf.predict_datum(datum)
+
+    def classification_distribution(self, datum: str) -> dict[str, float]:
+        """Category value -> probability for one datum."""
+        if not self.rdf.forest.is_classification:
+            raise ValueError("not a classification model")
+        _, probs = self.rdf.predict_datum(datum)
+        ti = self.schema.target_index
+        return {
+            self.rdf.encodings.decode(ti, c): float(p) for c, p in enumerate(probs)
+        }
+
+    def feature_importance(self) -> list[float]:
+        return self.rdf.feature_importance()
+
+
+class RDFServingModelManager(AbstractServingModelManager):
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.schema = InputSchema(config)
+        self.model: RDFServingModel | None = None
+
+    def get_model(self) -> RDFServingModel | None:
+        return self.model
+
+    def consume_key_message(self, key: str | None, message: str) -> None:
+        if key == "UP":
+            model = self.model
+            if model is None:
+                return  # no model to interpret with yet
+            update = json.loads(message)
+            tree = int(update[0])
+            node_id = str(update[1])
+            if model.rdf.forest.is_classification:
+                model.rdf.update_classification_leaf(tree, node_id, update[2])
+            else:
+                model.rdf.update_regression_leaf(
+                    tree, node_id, float(update[2]), int(update[3])
+                )
+        elif key in ("MODEL", "MODEL-REF"):
+            art = read_artifact_from_update(key, message)
+            self.model = RDFServingModel(artifact_to_model(art, self.schema))
+            log.info(
+                "new model loaded: %d trees",
+                self.model.rdf.forest.num_trees,
+            )
+        else:
+            raise ValueError(f"bad key: {key}")

@@ -1,0 +1,88 @@
+"""RDF speed tier: per-micro-batch terminal-node statistics.
+
+Mirrors RDFSpeedModelManager (app/oryx-app .../speed/rdf/
+RDFSpeedModelManager.java:68-148): "UP" is ignored (hearing our own
+updates), MODEL(-REF) replaces the local forest, and build_updates routes
+every example down every tree — one vectorized [T,N] routing pass instead
+of the reference's per-example flatMap — groups targets by (tree,
+terminal node), and emits
+  classification: [treeID, nodeID, {targetEncoding: count}]
+  regression:     [treeID, nodeID, mean, count]
+JSON messages, byte-compatible with the reference wire format.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+import numpy as np
+
+from oryx_tpu.api import AbstractSpeedModelManager
+from oryx_tpu.common.artifact import read_artifact_from_update
+from oryx_tpu.common.config import Config
+from oryx_tpu.common.text import parse_input_line
+from oryx_tpu.ops.rdf import heap_to_node_id
+from oryx_tpu.apps.rdf.common import RDFModel, artifact_to_model
+from oryx_tpu.apps.schema import InputSchema
+
+log = logging.getLogger(__name__)
+
+
+class RDFSpeedModelManager(AbstractSpeedModelManager):
+    def __init__(self, config: Config):
+        self.config = config
+        self.schema = InputSchema(config)
+        self.model: RDFModel | None = None
+
+    def consume_key_message(self, key: str | None, message: str) -> None:
+        if key == "UP":
+            return  # hearing our own updates
+        if key in ("MODEL", "MODEL-REF"):
+            art = read_artifact_from_update(key, message)
+            self.model = artifact_to_model(art, self.schema)
+            log.info(
+                "new model loaded: %d trees, depth %d",
+                self.model.forest.num_trees,
+                self.model.forest.max_depth,
+            )
+        else:
+            raise ValueError(f"bad key: {key}")
+
+    def build_updates(self, new_data):
+        model = self.model
+        if model is None:
+            return []
+        rows = []
+        for km in new_data:
+            try:
+                rows.append(parse_input_line(km.message))
+            except ValueError:
+                continue
+        if not rows:
+            return []
+        x, y = model.rows_to_matrix(rows)
+        keep = ~np.isnan(y)
+        x, y = x[keep], y[keep]
+        if len(y) == 0:
+            return []
+        binned = model.bin_matrix(x)
+        leaves = model.terminal_nodes(binned)  # [T, N]
+        classification = model.forest.is_classification
+
+        out = []
+        for t in range(leaves.shape[0]):
+            for slot in np.unique(leaves[t]):
+                targets = y[leaves[t] == slot]
+                nid = heap_to_node_id(int(slot))
+                if classification:
+                    codes, counts = np.unique(targets.astype(np.int64), return_counts=True)
+                    payload = {str(int(c)): int(n) for c, n in zip(codes, counts)}
+                    out.append(json.dumps([t, nid, payload]))
+                else:
+                    out.append(
+                        json.dumps(
+                            [t, nid, float(np.mean(targets)), int(len(targets))]
+                        )
+                    )
+        return out

@@ -194,6 +194,8 @@ def encode_matrix(
             if fi >= len(row) or row[fi] == "":
                 continue
             if cat:
+                if encodings is None:
+                    continue  # no dictionaries: categorical stays NaN
                 try:
                     x[r, p] = encodings.encode(fi, row[fi])
                 except KeyError:
@@ -210,6 +212,8 @@ def encode_matrix(
             if ti >= len(row) or row[ti] == "":
                 continue
             if cat:
+                if encodings is None:
+                    continue
                 try:
                     t[r] = encodings.encode(ti, row[ti])
                 except KeyError:

@@ -1,0 +1,175 @@
+"""Operational CLI — the oryx-run.sh + deploy/Main.java tier.
+
+Mirrors the reference's command surface (deploy/bin/oryx-run.sh:16-36,
+104-119 and the three one-class launchers under deploy/oryx-*/.../Main.java):
+
+  python -m oryx_tpu.cli batch   --conf oryx.conf   run the batch layer
+  python -m oryx_tpu.cli speed   --conf oryx.conf   run the speed layer
+  python -m oryx_tpu.cli serving --conf oryx.conf   run the serving layer
+  python -m oryx_tpu.cli setup   --conf oryx.conf   create the two topics
+  python -m oryx_tpu.cli tail    --conf oryx.conf   tail input+update topics
+  python -m oryx_tpu.cli input   --conf oryx.conf   stdin lines -> input topic
+
+Where spark-submit/YARN flags would go, there is nothing: processes are
+plain Python; multi-chip scale comes from the in-process jax mesh, not a
+cluster scheduler. -D-style overrides are --set key=value (the
+-Dconfig.file / ConfigToProperties path, oryx-run.sh:90-101,138-139).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import time
+
+from oryx_tpu.common.config import Config, load_config
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(prog="oryx_tpu", description=__doc__)
+    p.add_argument(
+        "command",
+        choices=["batch", "speed", "serving", "setup", "tail", "input"],
+    )
+    p.add_argument("--conf", help="user config file (HOCON-like key paths)")
+    p.add_argument(
+        "--set",
+        action="append",
+        default=[],
+        metavar="KEY=VALUE",
+        help="config override, repeatable (e.g. --set oryx.serving.api.port=8080)",
+    )
+    return p.parse_args(argv)
+
+
+def _build_config(args) -> Config:
+    overlay = {}
+    for kv in args.set:
+        if "=" not in kv:
+            raise SystemExit(f"--set needs KEY=VALUE, got: {kv}")
+        k, v = kv.split("=", 1)
+        try:
+            overlay[k] = json.loads(v)
+        except json.JSONDecodeError:
+            overlay[k] = v
+    return load_config(args.conf, overlay=overlay)
+
+
+def _topic_pairs(config: Config) -> list[tuple[str, str, int]]:
+    return [
+        (
+            config.get_string(f"oryx.{t}-topic.broker"),
+            config.get_string(f"oryx.{t}-topic.message.topic"),
+            config.get_int(f"oryx.{t}-topic.message.partitions", 1),
+        )
+        for t in ("input", "update")
+    ]
+
+
+def cmd_setup(config: Config) -> int:
+    """Create input/update topics (oryx-run.sh kafka-setup)."""
+    from oryx_tpu.bus.broker import topics
+
+    for uri, topic, partitions in _topic_pairs(config):
+        topics.maybe_create(uri, topic, partitions)
+        print(f"ready: {uri} {topic} ({partitions} partitions)")
+    return 0
+
+
+def cmd_tail(config: Config) -> int:
+    """Follow both topics, printing topic<TAB>key<TAB>message
+    (oryx-run.sh kafka-tail)."""
+    from oryx_tpu.bus.broker import get_broker
+
+    pairs = _topic_pairs(config)
+    brokers = {uri: get_broker(uri) for uri, _, _ in pairs}
+    positions: dict[tuple[str, str, int], int] = {}
+    for uri, topic, _ in pairs:
+        for part, end in enumerate(brokers[uri].end_offsets(topic)):
+            positions[(uri, topic, part)] = end
+    stop = []
+    signal.signal(signal.SIGINT, lambda *_: stop.append(True))
+    while not stop:
+        idle = True
+        for (uri, topic, part), off in list(positions.items()):
+            recs = brokers[uri].read(topic, part, off, 100)
+            for o, key, msg in recs:
+                print(f"{topic}\t{key}\t{msg}", flush=True)
+                positions[(uri, topic, part)] = o + 1
+                idle = False
+        if idle:
+            time.sleep(0.2)
+    return 0
+
+
+def cmd_input(config: Config) -> int:
+    """Pump stdin lines into the input topic, keyed by line hash
+    (oryx-run.sh kafka-input; keying as AbstractOryxResource.sendInput)."""
+    from oryx_tpu.bus.broker import get_broker
+
+    uri, topic, _ = _topic_pairs(config)[0]
+    broker = get_broker(uri)
+    n = 0
+    for line in sys.stdin:
+        line = line.rstrip("\n")
+        if line:
+            broker.send(topic, str(abs(hash(line)) % (1 << 31)), line)
+            n += 1
+    print(f"sent {n} lines to {topic}", file=sys.stderr)
+    return 0
+
+
+def _run_until_interrupt(layer) -> int:
+    stop = signal.getsignal(signal.SIGTERM)
+    signal.signal(signal.SIGTERM, lambda *_: layer.close())
+    try:
+        layer.start()
+        layer.await_termination()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        layer.close()
+        signal.signal(signal.SIGTERM, stop)
+    return 0
+
+
+def cmd_batch(config: Config) -> int:
+    from oryx_tpu.layers import BatchLayer
+
+    return _run_until_interrupt(BatchLayer(config))
+
+
+def cmd_speed(config: Config) -> int:
+    from oryx_tpu.layers import SpeedLayer
+
+    return _run_until_interrupt(SpeedLayer(config))
+
+
+def cmd_serving(config: Config) -> int:
+    from oryx_tpu.serving.server import ServingLayer
+
+    return _run_until_interrupt(ServingLayer(config))
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    config = _build_config(args)
+    return {
+        "batch": cmd_batch,
+        "speed": cmd_speed,
+        "serving": cmd_serving,
+        "setup": cmd_setup,
+        "tail": cmd_tail,
+        "input": cmd_input,
+    }[args.command](config)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

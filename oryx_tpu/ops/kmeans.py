@@ -33,7 +33,7 @@ import numpy as np
 
 from oryx_tpu.common.rng import RandomManager
 
-SILHOUETTE_MAX_SAMPLE = 100_000
+SILHOUETTE_MAX_SAMPLE = 4096
 
 
 # ---------------------------------------------------------------------------
@@ -112,12 +112,15 @@ def _kmeans_parallel_init(
     cand = np.unique(np.stack(candidates), axis=0)
     if len(cand) <= k:
         # not enough distinct candidates: fill with random distinct points
+        # (own key — reusing keys[-1] would correlate with the k-subset draw)
+        fill_key, _ = jax.random.split(keys[-1])
         extra_idx = np.asarray(
-            jax.random.choice(keys[-1], n, (min(n, 2 * k),), replace=False)
+            jax.random.choice(fill_key, n, (min(n, 2 * k),), replace=False)
         )
         cand = np.unique(np.concatenate([cand, points[extra_idx]]), axis=0)
-    if len(cand) < k:
-        raise ValueError(f"fewer than k={k} distinct points")
+    # duplicate-heavy data may simply not have k distinct points: clamp,
+    # matching the reference's tolerance of k > distinct-count inputs
+    k = min(k, len(cand))
     # weight candidates by the total point weight attracted to each
     ids, _ = assign_clusters(jnp.asarray(points), jnp.asarray(cand))
     w = np.zeros(len(cand), dtype=np.float32)
@@ -143,22 +146,55 @@ def train_kmeans(
     init: str = "k-means||",
     mesh=None,
     seed_key=None,
+    runs: int = 1,
 ) -> KMeansModelArrays:
     """Train k-means. With a mesh, points shard over the "data" axis and the
-    whole scan runs SPMD (centers replicated, partial sums psum'd)."""
+    whole scan runs SPMD (centers replicated, partial sums psum'd).
+
+    runs > 1 restarts from fresh inits and keeps the lowest-SSE result
+    (the oryx.kmeans.runs knob; guards random init's local optima)."""
     points = np.asarray(points, dtype=np.float32)
     points = points[~np.isnan(points).any(axis=1)]
     n = len(points)
     if n == 0:
         raise ValueError("no valid points")
-    k = min(k, len(np.unique(points, axis=0)))
+    if runs > 1:
+        key = seed_key if seed_key is not None else RandomManager.get_key()
+        best, best_sse = None, np.inf
+        for rk in jax.random.split(key, runs):
+            m = train_kmeans(points, k, iterations, init, mesh, seed_key=rk)
+            sse = sum_squared_error(points, m.centers)
+            if best is None or sse < best_sse:
+                best, best_sse = m, sse
+        return best
+    if k >= n:
+        # only in this degenerate regime is the distinct-row count worth
+        # computing; a full-dataset np.unique on every call would dominate
+        # host time for large N
+        k = min(k, len(np.unique(points, axis=0)))
     key = seed_key if seed_key is not None else RandomManager.get_key()
     k_init, k_run = jax.random.split(key)
 
     weights = np.ones(n, dtype=np.float32)
     if init == "random":
-        idx = np.asarray(jax.random.choice(k_init, n, (k,), replace=False))
-        centers0 = points[idx]
+        # sample k *distinct points* (not merely distinct indices):
+        # resample over progressively larger candidate draws, falling back
+        # to a full distinct scan only if duplicates persist
+        centers0 = None
+        for attempt in range(3):
+            k_init, sub = jax.random.split(k_init)
+            draw = np.asarray(
+                jax.random.choice(sub, n, (min(n, k * (2**attempt)),), replace=False)
+            )
+            uniq = np.unique(points[draw], axis=0)  # note: sorts rows
+            if len(uniq) >= k:
+                break
+        else:
+            uniq = np.unique(points, axis=0)
+        k = min(k, len(uniq))
+        k_init, sub = jax.random.split(k_init)
+        pick = np.asarray(jax.random.choice(sub, len(uniq), (k,), replace=False))
+        centers0 = uniq[pick]
     else:
         centers0 = _kmeans_parallel_init(points, weights, k, k_init)
 
@@ -241,44 +277,45 @@ def dunn_index(points: np.ndarray, centers: np.ndarray) -> float:
     return float(inter / intra) if intra > 0 else 0.0
 
 
+@jax.jit
+def _silhouette_jit(points, centers):
+    """Vectorized silhouette: one [S,S] pairwise-distance matmul and a
+    [S,K] per-cluster mean-distance reduction; singleton clusters
+    contribute 0 (SilhouetteCoefficient.java's convention)."""
+    d = jnp.sqrt(_sq_dists(points, points))  # [S,S]
+    ids, _ = assign_clusters(points, centers)
+    k = centers.shape[0]
+    onehot = jax.nn.one_hot(ids, k, dtype=jnp.float32)  # [S,K]
+    n_c = onehot.sum(axis=0)  # [K]
+    sum_to_cluster = d @ onehot  # [S,K]
+    own_n = n_c[ids]
+    a = jnp.take_along_axis(sum_to_cluster, ids[:, None], axis=1)[:, 0] / jnp.maximum(
+        own_n - 1.0, 1.0
+    )
+    mean_other = jnp.where(
+        (n_c[None, :] > 0) & (jax.nn.one_hot(ids, k) == 0),
+        sum_to_cluster / jnp.maximum(n_c[None, :], 1.0),
+        jnp.inf,
+    )
+    b = jnp.min(mean_other, axis=1)
+    m = jnp.maximum(a, b)
+    s = jnp.where((own_n > 1) & (m > 0) & jnp.isfinite(b), (b - a) / m, 0.0)
+    return s.mean()
+
+
 def silhouette_coefficient(
     points: np.ndarray, centers: np.ndarray, seed_key=None
 ) -> float:
-    """Mean silhouette over a bounded sample; singleton clusters contribute
-    0 per the reference's convention (SilhouetteCoefficient.java)."""
-    points = np.asarray(points, dtype=np.float64)
+    """Mean silhouette over a bounded sample (the [S,S] distance matrix
+    caps S; the reference also evaluates on a sample)."""
+    points = np.asarray(points, dtype=np.float32)
     if len(points) > SILHOUETTE_MAX_SAMPLE:
         key = seed_key if seed_key is not None else RandomManager.get_key()
         idx = np.asarray(
             jax.random.choice(key, len(points), (SILHOUETTE_MAX_SAMPLE,), replace=False)
         )
         points = points[idx]
-    ids, _ = assign_clusters(
-        jnp.asarray(points, dtype=jnp.float32), jnp.asarray(centers)
-    )
-    ids = np.asarray(ids)
-    k = len(centers)
-    members = [points[ids == c] for c in range(k)]
-    total, count = 0.0, 0
-    for c in range(k):
-        pts = members[c]
-        count += len(pts)
-        if len(pts) <= 1:
-            continue
-        for x in pts:
-            d = np.linalg.norm(pts - x, axis=1)
-            a = d.sum() / (len(pts) - 1)  # exclude self
-            b = min(
-                (
-                    np.linalg.norm(members[o] - x, axis=1).mean()
-                    for o in range(k)
-                    if o != c and len(members[o]) > 0
-                ),
-                default=0.0,
-            )
-            m = max(a, b)
-            total += (b - a) / m if m > 0 else 0.0
-    return total / count if count else 0.0
+    return float(_silhouette_jit(jnp.asarray(points), jnp.asarray(centers)))
 
 
 def online_update(

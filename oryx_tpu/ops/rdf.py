@@ -1,0 +1,498 @@
+"""Random decision forest — TPU-native histogram trainer + array forest.
+
+Re-design of the reference's RDF compute path (app/oryx-app-mllib
+.../batch/mllib/rdf/RDFUpdate.java:143-165 invoking MLlib
+RandomForest.trainClassifier/trainRegressor, and the serving-side tree
+walk in app/oryx-app-common .../rdf/tree/DecisionTree.java:50-64). The
+reference leans on MLlib's pointer-based trees; here the whole forest is
+a handful of dense arrays so both training and inference are single
+compiled XLA programs:
+
+- **Implicit-heap layout.** Every tree is padded to 2^(max_depth+1)-1
+  slots; node i's children are 2i+1 (left, the reference's '-' branch)
+  and 2i+2 (right, '+'). Routing an example is a fixed-trip-count gather
+  loop — no pointers, no recursion, vectorized over trees x examples.
+
+- **Binned features.** Numeric predictors are quantile-binned to at most
+  `max-split-candidates` bins (the same role the parameter plays in
+  MLlib); categorical predictors use their value encodings as bins. A
+  split is stored as a goes-left bitmask over bins, which represents
+  numeric threshold splits (prefix masks) and categorical subset splits
+  (arbitrary masks) uniformly — the reference's NumericDecision /
+  CategoricalDecision pair (.../rdf/decision/) collapses into one array.
+
+- **Level-by-level histogram growth.** Each depth level is one scatter-add
+  building [nodes, predictors, bins, stats] label histograms, a cumulative
+  sum over (score-ordered) bins, and an argmax over candidate splits by
+  impurity gain (entropy/gini in nats, variance for regression) — the
+  classic histogram-forest formulation that maps onto the VPU instead of
+  MLlib's per-partition binned aggregation. Categorical subset search
+  orders categories by per-bin target score (Breiman's sorted-category
+  trick; exact for binary/regression, principled heuristic for
+  multiclass, like MLlib's ordered-category mode).
+
+- **Bootstrap as weights.** Each tree carries a multinomial count-weight
+  vector over the shared binned matrix, so trees differ only in a [T,N]
+  weight array and "auto" per-node feature subsets (sqrt(P) for
+  classification, P/3 for regression, MLlib's defaults) drawn inside the
+  compiled program. Tree growth is vmapped over the tree axis; with a
+  mesh the tree axis shards over "data" (trees are embarrassingly
+  parallel, the idiomatic forest sharding).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oryx_tpu.common.rng import RandomManager
+
+MAX_BINS_CAP = 256
+
+
+# ---------------------------------------------------------------------------
+# node-ID strings (wire parity with the reference's TreePath IDs:
+# root "r", '-' = left child, '+' = right child; RDFUpdate.java:423,480-481)
+# ---------------------------------------------------------------------------
+
+def heap_to_node_id(index: int) -> str:
+    """Heap slot -> reference-style path ID ("r", "r-", "r+-", ...)."""
+    path = []
+    i = index
+    while i > 0:
+        parent = (i - 1) // 2
+        path.append("-" if i == 2 * parent + 1 else "+")
+        i = parent
+    return "r" + "".join(reversed(path))
+
+
+def node_id_to_heap(node_id: str) -> int:
+    """Reference-style path ID -> heap slot."""
+    if not node_id or node_id[0] != "r":
+        raise ValueError(f"bad node ID: {node_id!r}")
+    i = 0
+    for c in node_id[1:]:
+        if c == "-":
+            i = 2 * i + 1
+        elif c == "+":
+            i = 2 * i + 2
+        else:
+            raise ValueError(f"bad node ID: {node_id!r}")
+    return i
+
+
+# ---------------------------------------------------------------------------
+# binning
+# ---------------------------------------------------------------------------
+
+@dataclass
+class BinnedData:
+    """Quantile-binned dataset + the edges needed to bin future inputs.
+
+    edges[p] has n_bins[p]-1 sorted cut points for numeric predictor p
+    (bin b covers x <= edges[b], last bin is the overflow; NaN bins to the
+    last bin); categorical predictors bin by value encoding directly.
+    """
+
+    binned: np.ndarray  # [N, P] int32
+    edges: list[np.ndarray | None]  # per predictor; None for categorical
+    n_bins: np.ndarray  # [P] int32
+    is_categorical: np.ndarray  # [P] bool
+
+
+def bin_column(
+    values: np.ndarray, edges: np.ndarray | None, n_bins: int
+) -> np.ndarray:
+    """Bin one predictor column; NaN and unseen categories go to the last
+    bin (searchsorted sends NaN past every edge)."""
+    if edges is None:  # categorical: values are already encodings
+        v = np.nan_to_num(values, nan=n_bins - 1).astype(np.int64)
+        return np.clip(v, 0, n_bins - 1).astype(np.int32)
+    return np.searchsorted(edges, values, side="left").astype(np.int32)
+
+
+def bin_dataset(
+    x: np.ndarray,
+    is_categorical: np.ndarray,
+    category_counts: np.ndarray,
+    max_split_candidates: int,
+) -> BinnedData:
+    """Quantile-bin numeric columns of x [N,P] (categoricals pass through
+    as encodings). max_split_candidates caps bins per predictor, like its
+    namesake in RDFUpdate.java:121-151."""
+    n, p = x.shape
+    max_bins = min(int(max_split_candidates), MAX_BINS_CAP)
+    binned = np.empty((n, p), dtype=np.int32)
+    edges: list[np.ndarray | None] = []
+    n_bins = np.empty(p, dtype=np.int32)
+    for j in range(p):
+        col = x[:, j]
+        if is_categorical[j]:
+            nb = max(int(category_counts[j]), 1)
+            edges.append(None)
+            n_bins[j] = nb
+            binned[:, j] = bin_column(col, None, nb)
+        else:
+            finite = col[np.isfinite(col)]
+            if len(finite) == 0:
+                e = np.empty(0, dtype=np.float32)
+            else:
+                qs = np.quantile(finite, np.linspace(0, 1, max_bins + 1)[1:-1])
+                e = np.unique(qs.astype(np.float32))
+            edges.append(e)
+            n_bins[j] = len(e) + 1
+            binned[:, j] = bin_column(col, e, len(e) + 1)
+    return BinnedData(binned, edges, n_bins, np.asarray(is_categorical, dtype=bool))
+
+
+# ---------------------------------------------------------------------------
+# forest container
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Forest:
+    """Dense array forest; T trees x M=2^(max_depth+1)-1 heap slots.
+
+    feature[t,m] is the split predictor (-1 = terminal/absent);
+    split_left[t,m,b] says bin b of that predictor goes left. For
+    classification class_counts[t,m,:] holds per-class training counts at
+    every node (terminal prediction = normalized counts, the reference's
+    CategoricalPrediction); for regression leaf_stats[t,m] = (count, sum)
+    (NumericPrediction's running mean).
+    """
+
+    feature: np.ndarray  # [T, M] int32
+    split_left: np.ndarray  # [T, M, B] bool
+    class_counts: np.ndarray | None  # [T, M, C] f64, classification
+    leaf_stats: np.ndarray | None  # [T, M, 2] f64 (count, sum), regression
+    feature_importances: np.ndarray  # [P] f64, max-normalized
+    max_depth: int
+
+    @property
+    def num_trees(self) -> int:
+        return self.feature.shape[0]
+
+    @property
+    def is_classification(self) -> bool:
+        return self.class_counts is not None
+
+    def weights(self) -> np.ndarray:
+        """Uniform tree weights (the reference forest votes uniformly for
+        MLlib models; DecisionForest.java weights)."""
+        return np.full(self.num_trees, 1.0 / self.num_trees)
+
+
+# ---------------------------------------------------------------------------
+# growth (jit core)
+# ---------------------------------------------------------------------------
+
+def _impurity(counts, kind: str):
+    """Impurity from class-count vectors [..., C]; nats for entropy."""
+    n = counts.sum(axis=-1)
+    p = counts / jnp.maximum(n, 1.0)[..., None]
+    if kind == "gini":
+        return 1.0 - jnp.sum(p * p, axis=-1)
+    # entropy
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0), axis=-1)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_depth", "n_bins_max", "n_classes", "impurity", "mtry"),
+)
+def _grow_one_tree(
+    binned,  # [N, P] int32
+    y,  # [N] int32 (classification) or f32 (regression)
+    weight,  # [N] f32 bootstrap multinomial counts
+    n_bins,  # [P] int32
+    is_cat,  # [P] bool
+    key,  # PRNG key for per-node feature subsets
+    *,
+    max_depth: int,
+    n_bins_max: int,
+    n_classes: int,  # 0 => regression
+    impurity: str,
+    mtry: int,
+):
+    n, p = binned.shape
+    b = n_bins_max
+    m = 2 ** (max_depth + 1) - 1
+    classification = n_classes > 0
+    c = n_classes if classification else 3  # regression stats: (w, wy, wy2)
+
+    feature = jnp.full((m,), -1, dtype=jnp.int32)
+    split_left = jnp.zeros((m, b), dtype=bool)
+    node_counts = jnp.zeros((m, c), dtype=jnp.float32)
+    importance = jnp.zeros((p,), dtype=jnp.float32)
+
+    if classification:
+        stat_cols = jax.nn.one_hot(y, c, dtype=jnp.float32)  # [N, C]
+    else:
+        stat_cols = jnp.stack([jnp.ones_like(y), y, y * y], axis=1)
+
+    cols = jnp.arange(p, dtype=jnp.int32)[None, :]  # [1, P]
+    valid_bin = jnp.arange(b)[None, :] < n_bins[:, None]  # [P, B]
+    # split position j is valid only below the last in-use bin
+    valid_pos = jnp.arange(b)[None, :] < (n_bins[:, None] - 1)  # [P, B]
+
+    node = jnp.zeros(n, dtype=jnp.int32)
+    keys = jax.random.split(key, max_depth)
+
+    for d in range(max_depth):
+        level_start = 2**d - 1
+        n_level = 2**d
+        local = node - level_start
+        active = (local >= 0) & (local < n_level)
+        w = jnp.where(active, weight, 0.0)
+        loc = jnp.clip(local, 0, n_level - 1)
+
+        # label histogram: [n_level, P, B, C]; one scatter-add per stat
+        # column (C is tiny) keeps the scatter rank simple
+        hist = jnp.zeros((n_level, p, b, c), dtype=jnp.float32)
+        for s in range(c):
+            hist = hist.at[loc[:, None], cols, binned, s].add(
+                w[:, None] * stat_cols[:, s][:, None]
+            )
+
+        total = hist.sum(axis=2)  # [n_level, P, C]
+        node_n = total[:, 0].sum(axis=-1)  # [n_level]
+
+        # order bins: numeric keep natural order; categorical sort by the
+        # per-bin target score (sorted-category subset trick)
+        if classification:
+            bin_n = hist.sum(axis=3)  # [n_level, P, B]
+            maj = jnp.argmax(total.sum(axis=1), axis=-1)  # [n_level]
+            maj_n = jnp.take_along_axis(hist, maj[:, None, None, None], axis=3)
+            score = maj_n[..., 0] / jnp.maximum(bin_n, 1.0)
+        else:
+            bin_n = hist[..., 0]
+            score = hist[..., 1] / jnp.maximum(bin_n, 1.0)  # mean y
+        # empty/padded bins sort last
+        score = jnp.where((bin_n > 0) & valid_bin[None], score, jnp.inf)
+        cat_order = jnp.argsort(score, axis=2)  # [n_level, P, B]
+        nat_order = jnp.broadcast_to(jnp.arange(b), cat_order.shape)
+        order = jnp.where(is_cat[None, :, None], cat_order, nat_order)
+
+        ordered = jnp.take_along_axis(hist, order[..., None], axis=2)
+        left = jnp.cumsum(ordered, axis=2)  # [n_level, P, B, C]
+        right = left[:, :, -1:, :] - left
+
+        if classification:
+            nl = left.sum(axis=3)
+            nr = right.sum(axis=3)
+            h_parent = _impurity(total, impurity)  # [n_level, P]
+            h_l = _impurity(left, impurity)
+            h_r = _impurity(right, impurity)
+        else:
+            nl, nr = left[..., 0], right[..., 0]
+
+            def var(s):
+                mean = s[..., 1] / jnp.maximum(s[..., 0], 1.0)
+                return jnp.maximum(
+                    s[..., 2] / jnp.maximum(s[..., 0], 1.0) - mean * mean, 0.0
+                )
+
+            h_parent = var(total)
+            h_l, h_r = var(left), var(right)
+
+        nn = jnp.maximum(node_n, 1.0)[:, None, None]
+        gain = h_parent[..., None] - (nl / nn) * h_l - (nr / nn) * h_r
+        ok = (nl > 0) & (nr > 0) & valid_pos[None]
+        # per-node "auto" feature subset: keep mtry features with the
+        # smallest uniform draws (MLlib featureSubsetStrategy="auto")
+        if mtry < p:
+            u = jax.random.uniform(keys[d], (n_level, p))
+            ranks = jnp.argsort(jnp.argsort(u, axis=1), axis=1)
+            ok = ok & (ranks < mtry)[:, :, None]
+        gain = jnp.where(ok, gain, -jnp.inf)
+
+        flat = gain.reshape(n_level, p * b)
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        best_p = (best // b).astype(jnp.int32)
+        best_j = (best % b).astype(jnp.int32)
+        should = (best_gain > 0.0) & (node_n >= 2.0) & jnp.isfinite(best_gain)
+
+        # goes-left mask over original bins: rank of bin in the chosen
+        # predictor's order <= best_j
+        inv_order = jnp.argsort(order, axis=2)  # [n_level, P, B]
+        inv_best = jnp.take_along_axis(
+            inv_order, best_p[:, None, None], axis=1
+        )[:, 0, :]  # [n_level, B]
+        left_mask = inv_best <= best_j[:, None]  # [n_level, B]
+
+        slots = level_start + jnp.arange(n_level)
+        feature = feature.at[slots].set(jnp.where(should, best_p, -1))
+        split_left = split_left.at[slots].set(left_mask & should[:, None])
+        # every predictor's histogram sums to the same node totals, so the
+        # mean over the predictor axis is the per-node stat exactly
+        node_counts = node_counts.at[slots].set(total.mean(axis=1))
+        importance = importance.at[best_p].add(jnp.where(should, node_n, 0.0))
+
+        # route: split nodes push actives down, others freeze (terminal)
+        ex_bin = jnp.take_along_axis(binned, best_p[loc][:, None], axis=1)[:, 0]
+        goes_left = left_mask[loc, ex_bin]
+        child = 2 * node + 1 + (1 - goes_left.astype(jnp.int32))
+        node = jnp.where(active & should[loc], child, node)
+
+    # leaf-level stats for every node examples ended on
+    final_counts = jnp.zeros((m, c), dtype=jnp.float32)
+    for s in range(c):
+        final_counts = final_counts.at[node, s].add(weight * stat_cols[:, s])
+    # internal nodes also get their totals (prediction fallback parity with
+    # the reference, where every PMML node records counts)
+    node_counts = jnp.where(
+        final_counts.sum(axis=1, keepdims=True) > 0, final_counts, node_counts
+    )
+    return feature, split_left, node_counts, importance
+
+
+def grow_forest(
+    data: BinnedData,
+    y: np.ndarray,
+    *,
+    num_trees: int,
+    max_depth: int,
+    impurity: str,
+    n_classes: int,
+    mesh=None,
+) -> Forest:
+    """Train the forest: multinomial bootstrap weights per tree, vmapped
+    single-program growth; tree axis shards over the mesh "data" axis."""
+    n, p = data.binned.shape
+    rng = RandomManager.get_random()
+    weights = rng.multinomial(n, np.full(n, 1.0 / n), size=num_trees).astype(
+        np.float32
+    )  # [T, N]
+    keys = jax.random.split(
+        jax.random.PRNGKey(int(rng.integers(2**31 - 1))), num_trees
+    )
+    classification = n_classes > 0
+    if classification:
+        mtry = max(1, int(math.sqrt(p)))
+        yy = np.nan_to_num(y, nan=0.0).astype(np.int32)
+    else:
+        mtry = max(1, p // 3)
+        yy = np.asarray(y, dtype=np.float32)
+
+    grow = jax.vmap(
+        partial(
+            _grow_one_tree,
+            max_depth=max_depth,
+            n_bins_max=int(data.n_bins.max()),
+            n_classes=n_classes,
+            impurity=impurity,
+            mtry=mtry,
+        ),
+        in_axes=(None, None, 0, None, None, 0),
+    )
+
+    binned_j = jnp.asarray(data.binned)
+    y_j = jnp.asarray(yy)
+    nb = jnp.asarray(data.n_bins)
+    ic = jnp.asarray(data.is_categorical)
+    w_j = jnp.asarray(weights)
+    keys = jnp.asarray(keys)
+    if mesh is not None:
+        from oryx_tpu.parallel.mesh import DATA_AXIS, data_sharding, replicated
+
+        # trees are embarrassingly parallel: shard the tree axis when it
+        # divides the mesh (padding would add phantom trees to the vote)
+        if num_trees % mesh.shape[DATA_AXIS] == 0:
+            w_j = jax.device_put(w_j, data_sharding(mesh, w_j.ndim))
+            keys = jax.device_put(keys, data_sharding(mesh, keys.ndim))
+            binned_j = jax.device_put(binned_j, replicated(mesh))
+            y_j = jax.device_put(y_j, replicated(mesh))
+
+    feature, split_left, counts, importance = jax.device_get(
+        grow(binned_j, y_j, w_j, nb, ic, jnp.asarray(keys))
+    )
+
+    imp = importance.sum(axis=0).astype(np.float64)
+    imp = imp / imp.max() if imp.max() > 0 else imp
+    if classification:
+        return Forest(
+            feature=np.asarray(feature),
+            split_left=np.asarray(split_left),
+            class_counts=np.asarray(counts, dtype=np.float64),
+            leaf_stats=None,
+            feature_importances=imp,
+            max_depth=max_depth,
+        )
+    stats = np.asarray(counts, dtype=np.float64)  # [T, M, 3] (w, wy, wy2)
+    return Forest(
+        feature=np.asarray(feature),
+        split_left=np.asarray(split_left),
+        class_counts=None,
+        leaf_stats=np.stack([stats[..., 0], stats[..., 1]], axis=-1),
+        feature_importances=imp,
+        max_depth=max_depth,
+    )
+
+
+# ---------------------------------------------------------------------------
+# inference
+# ---------------------------------------------------------------------------
+
+def route_binned(
+    feature: np.ndarray, split_left: np.ndarray, binned: np.ndarray, max_depth: int
+) -> np.ndarray:
+    """Host routing: binned examples [Ne,P] -> terminal heap slot per tree
+    [T,Ne] (numpy; the serving/speed tiers route small batches per request
+    against mutable leaf stats, reference DecisionTree.findTerminal)."""
+    t = feature.shape[0]
+    ne = binned.shape[0]
+    node = np.zeros((t, ne), dtype=np.int64)
+    tree_ix = np.arange(t)[:, None]
+    for _ in range(max_depth):
+        f = feature[tree_ix, node]  # [T, Ne]
+        internal = f >= 0
+        fb = binned[np.arange(ne)[None, :], np.clip(f, 0, None)]  # [T, Ne]
+        goes_left = split_left[tree_ix, node, fb]
+        child = 2 * node + 1 + (1 - goes_left.astype(np.int64))
+        node = np.where(internal, child, node)
+    return node
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def route_binned_jit(feature, split_left, binned, *, max_depth: int):
+    """Device routing, same semantics as route_binned; one fused gather
+    loop over depth, batched over trees x examples."""
+    t = feature.shape[0]
+    ne = binned.shape[0]
+    node = jnp.zeros((t, ne), dtype=jnp.int32)
+    tree_ix = jnp.arange(t)[:, None]
+    ex_ix = jnp.arange(ne)[None, :]
+
+    def body(_, node):
+        f = feature[tree_ix, node]
+        internal = f >= 0
+        fb = binned[ex_ix, jnp.clip(f, 0, None)]
+        goes_left = split_left[tree_ix, node, fb]
+        child = 2 * node + 1 + (1 - goes_left.astype(jnp.int32))
+        return jnp.where(internal, child, node)
+
+    return jax.lax.fori_loop(0, max_depth, body, node)
+
+
+def predict_class_probs(forest: Forest, binned: np.ndarray) -> np.ndarray:
+    """[Ne, C] probabilities: uniform-weight vote of per-leaf normalized
+    class counts (reference WeightedPrediction.voteOnFeature over
+    CategoricalPredictions)."""
+    leaves = route_binned(forest.feature, forest.split_left, binned, forest.max_depth)
+    counts = forest.class_counts[np.arange(forest.num_trees)[:, None], leaves]
+    probs = counts / np.maximum(counts.sum(axis=-1, keepdims=True), 1e-12)
+    return probs.mean(axis=0)
+
+
+def predict_regression(forest: Forest, binned: np.ndarray) -> np.ndarray:
+    """[Ne] regression prediction: uniform-weight mean of leaf means."""
+    leaves = route_binned(forest.feature, forest.split_left, binned, forest.max_depth)
+    stats = forest.leaf_stats[np.arange(forest.num_trees)[:, None], leaves]
+    means = stats[..., 1] / np.maximum(stats[..., 0], 1e-12)
+    return means.mean(axis=0)

@@ -53,12 +53,7 @@ def register(app: ServingApp) -> None:
 
     @app.route("POST", "/add")
     def add(a: ServingApp, req: Request):
-        n = 0
-        for line in req.body_text().splitlines():
-            line = line.strip()
-            if line:
-                a.send_input(line)
-                n += 1
-        if n == 0:
-            raise OryxServingException(400, "no data points given")
+        from oryx_tpu.serving.resources.common import send_input_lines
+
+        send_input_lines(a, req.body_text())
         return 200, None

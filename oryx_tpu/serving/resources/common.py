@@ -10,6 +10,20 @@ from __future__ import annotations
 from oryx_tpu.serving.app import OryxServingException, Request, ServingApp
 
 
+def send_input_lines(app: ServingApp, text: str, what: str = "data points") -> int:
+    """Bulk lines -> input topic; 400 when nothing usable was given. The
+    one implementation behind /ingest, /add, and /train."""
+    n = 0
+    for line in text.splitlines():
+        line = line.strip()
+        if line:
+            app.send_input(line)
+            n += 1
+    if n == 0:
+        raise OryxServingException(400, f"no {what} given")
+    return n
+
+
 def register(app: ServingApp) -> None:
     @app.route("GET", "/ready")
     def ready(a: ServingApp, req: Request):
@@ -23,13 +37,5 @@ def register(app: ServingApp) -> None:
 
     @app.route("POST", "/ingest")
     def ingest(a: ServingApp, req: Request):
-        text = req.body_text()
-        if not text.strip():
-            raise OryxServingException(400, "empty ingest body")
-        n = 0
-        for line in text.splitlines():
-            line = line.strip()
-            if line:
-                a.send_input(line)
-                n += 1
+        n = send_input_lines(a, req.body_text(), "ingest body")
         return 200, {"ingested": n}

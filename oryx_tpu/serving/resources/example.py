@@ -1,0 +1,36 @@
+"""Wordcount example REST endpoints — parity with app/example .../serving/
+{Distinct,Add}.java:
+
+  GET  /distinct            -> the whole word -> count map
+  GET  /distinct/{word}     -> one word's count (400 if absent)
+  POST /add  (or /add/{line}) -> send lines to the input topic
+"""
+
+from __future__ import annotations
+
+from oryx_tpu.serving.app import OryxServingException, Request, ServingApp
+
+
+def register(app: ServingApp) -> None:
+    @app.route("GET", "/distinct")
+    def distinct(a: ServingApp, req: Request):
+        return a.get_serving_model().get_words()
+
+    @app.route("GET", "/distinct/{word}")
+    def distinct_word(a: ServingApp, req: Request):
+        count = a.get_serving_model().get_count(req.params["word"])
+        if count is None:
+            raise OryxServingException(400, "No such word")
+        return count
+
+    @app.route("POST", "/add/{line}")
+    def add_one(a: ServingApp, req: Request):
+        a.send_input(req.params["line"])
+        return 200, None
+
+    @app.route("POST", "/add")
+    def add(a: ServingApp, req: Request):
+        for line in req.body_text().splitlines():
+            if line.strip():
+                a.send_input(line.strip())
+        return 200, None

@@ -1,0 +1,121 @@
+"""CLI tier tests (the oryx-run.sh surface): topic setup, stdin input
+pump, config overlays via --set, and a real `python -m oryx_tpu.cli
+serving` subprocess answering HTTP on a file:// broker."""
+
+import io
+import json
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from oryx_tpu import cli
+from oryx_tpu.bus.broker import get_broker, topics
+from oryx_tpu.bus.inproc import InProcBroker
+from oryx_tpu.common.ioutil import choose_free_port
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    InProcBroker.reset_all()
+    yield
+    InProcBroker.reset_all()
+
+
+def test_setup_creates_topics(capsys):
+    rc = cli.main(
+        [
+            "setup",
+            "--set", "oryx.input-topic.broker=mem://cli1",
+            "--set", "oryx.update-topic.broker=mem://cli1",
+        ]
+    )
+    assert rc == 0
+    assert topics.exists("mem://cli1", "OryxInput")
+    assert topics.exists("mem://cli1", "OryxUpdate")
+    out = capsys.readouterr().out
+    assert "OryxInput" in out and "OryxUpdate" in out
+
+
+def test_set_overlay_parses_json_types():
+    args = cli._parse_args(
+        ["setup", "--set", "oryx.serving.api.port=123", "--set", "a.b=text"]
+    )
+    cfg = cli._build_config(args)
+    assert cfg.get_int("oryx.serving.api.port") == 123
+    assert cfg.get_string("a.b") == "text"
+    with pytest.raises(SystemExit):
+        cli._build_config(cli._parse_args(["setup", "--set", "novalue"]))
+
+
+def test_input_pumps_stdin(monkeypatch):
+    cli.main(
+        ["setup", "--set", "oryx.input-topic.broker=mem://cli2",
+         "--set", "oryx.update-topic.broker=mem://cli2"]
+    )
+    monkeypatch.setattr(sys, "stdin", io.StringIO("line one\nline two\n\n"))
+    rc = cli.main(
+        ["input", "--set", "oryx.input-topic.broker=mem://cli2",
+         "--set", "oryx.update-topic.broker=mem://cli2"]
+    )
+    assert rc == 0
+    broker = get_broker("mem://cli2")
+    msgs = {m for _, _, m in broker.read("OryxInput", 0, 0, 10)}
+    msgs |= {m for _, _, m in broker.read("OryxInput", 1, 0, 10)} if (
+        broker.num_partitions("OryxInput") > 1
+    ) else set()
+    for p in range(broker.num_partitions("OryxInput")):
+        msgs |= {m for _, _, m in broker.read("OryxInput", p, 0, 10)}
+    assert {"line one", "line two"} <= msgs
+
+
+def _http(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_serving_subprocess_round_trip(tmp_path):
+    port = choose_free_port()
+    bus = f"file://{tmp_path}/bus"
+    sets = [
+        f"oryx.input-topic.broker={bus}",
+        f"oryx.update-topic.broker={bus}",
+        f"oryx.serving.api.port={port}",
+        "oryx.serving.model-manager-class="
+        "oryx_tpu.apps.example.serving.ExampleServingModelManager",
+        'oryx.serving.application-resources='
+        '["oryx_tpu.serving.resources.common","oryx_tpu.serving.resources.example"]',
+    ]
+    flags = [x for s in sets for x in ("--set", s)]
+    assert cli.main(["setup", *flags]) == 0
+    get_broker(bus).send("OryxUpdate", "MODEL", json.dumps({"cat": 2}))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "oryx_tpu.cli", "serving", *flags],
+        cwd="/root/repo",
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+    )
+    try:
+        base = f"http://127.0.0.1:{port}"
+        deadline = time.time() + 30
+        status = None
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(proc.stderr.read().decode()[-2000:])
+            try:
+                status, body = _http(f"{base}/distinct/cat")
+                if status == 200:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        assert status == 200 and json.loads(body) == 2
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

@@ -1,0 +1,145 @@
+"""Wordcount example-app test: the minimal custom-SPI path (reference
+app/example + its ITs) end-to-end through real layers — batch publishes a
+JSON MODEL, speed emits per-batch "word,count" deltas, serving applies
+both and answers /distinct over HTTP, all classes loaded reflectively
+from config like the reference's config-named plugin points."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from oryx_tpu.apps.example.batch import (
+    ExampleBatchLayerUpdate,
+    count_distinct_other_words,
+)
+from oryx_tpu.apps.example.speed import ExampleSpeedModelManager
+from oryx_tpu.bus.api import KeyMessage
+from oryx_tpu.bus.broker import get_broker, topics
+from oryx_tpu.bus.inproc import InProcBroker
+from oryx_tpu.common.config import load_config
+from oryx_tpu.common.ioutil import choose_free_port
+from oryx_tpu.layers import BatchLayer, SpeedLayer
+from oryx_tpu.serving.server import ServingLayer
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    InProcBroker.reset_all()
+    yield
+    InProcBroker.reset_all()
+
+
+def _cfg(tmp_path, port=0):
+    return load_config(overlay={
+        "oryx.id": "wc",
+        "oryx.input-topic.broker": "mem://wc",
+        "oryx.update-topic.broker": "mem://wc",
+        "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+        "oryx.serving.api.port": port,
+        "oryx.batch.update-class":
+            "oryx_tpu.apps.example.batch.ExampleBatchLayerUpdate",
+        "oryx.speed.model-manager-class":
+            "oryx_tpu.apps.example.speed.ExampleSpeedModelManager",
+        "oryx.serving.model-manager-class":
+            "oryx_tpu.apps.example.serving.ExampleServingModelManager",
+        "oryx.serving.application-resources": [
+            "oryx_tpu.serving.resources.common",
+            "oryx_tpu.serving.resources.example",
+        ],
+    })
+
+
+def test_count_distinct_other_words():
+    counts = count_distinct_other_words(["a b c", "a b", "a a"])
+    # a co-occurs with b and c; b with a and c; c with a and b
+    assert counts == {"a": 2, "b": 2, "c": 2}
+    assert count_distinct_other_words(["solo"]) == {}
+
+
+def test_speed_manager_accumulates_deltas():
+    mgr = ExampleSpeedModelManager()
+    mgr.consume_key_message("MODEL", json.dumps({"a": 5}))
+    ups = set(mgr.build_updates([KeyMessage(None, "a b")]))
+    assert ups == {"a,6", "b,1"}
+    mgr.consume_key_message("UP", "a,6")  # ignored
+    assert set(mgr.build_updates([KeyMessage(None, "a c")])) == {"a,7", "c,1"}
+
+
+def _http(method, url, body=None):
+    req = urllib.request.Request(
+        url, method=method, data=body, headers={"Accept": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_wordcount_end_to_end(tmp_path):
+    port = choose_free_port()
+    cfg = _cfg(tmp_path, port)
+    topics.maybe_create("mem://wc", "OryxInput", 1)
+    topics.maybe_create("mem://wc", "OryxUpdate", 1)
+    broker = get_broker("mem://wc")
+
+    serving = ServingLayer(cfg)  # manager loaded from config by class name
+    serving.start()
+    base = f"http://127.0.0.1:{port}"
+
+    # ingest lines via REST
+    status, _ = _http("POST", f"{base}/add", b"cat dog\ncat fish\n")
+    assert status == 200
+
+    # batch generation: loads update class reflectively, publishes MODEL
+    batch = BatchLayer(cfg)
+    assert isinstance(batch.update, ExampleBatchLayerUpdate)
+    batch.ensure_streams()
+    batch._consumer._fetch_pos = {p: 0 for p in batch._consumer._fetch_pos}
+    n = batch.run_generation(timestamp_ms=1_700_000_000_000)
+    assert n == 2
+    batch.close()
+    recs = broker.read("OryxUpdate", 0, 0, 10)
+    assert recs and recs[0][1] == "MODEL"
+    assert json.loads(recs[0][2]) == {"cat": 2, "dog": 1, "fish": 1}
+
+    # serving replays the update topic and answers /distinct
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        status, body = _http("GET", f"{base}/distinct/cat")
+        if status == 200:
+            break
+        time.sleep(0.1)
+    assert status == 200 and json.loads(body) == 2
+    status, body = _http("GET", f"{base}/distinct")
+    assert status == 200 and json.loads(body) == {"cat": 2, "dog": 1, "fish": 1}
+    status, _ = _http("GET", f"{base}/distinct/nope")
+    assert status == 400
+
+    # speed layer: consumes the MODEL, emits deltas for a new micro-batch
+    speed = SpeedLayer(cfg)
+    speed.ensure_streams()
+    speed.start()
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if speed.manager._words:
+            break
+        time.sleep(0.1)
+    assert speed.manager._words.get("cat") == 2
+    ups = speed.manager.build_updates([KeyMessage(None, "cat bird")])
+    assert set(ups) == {"cat,3", "bird,1"}
+    for u in ups:
+        broker.send("OryxUpdate", "UP", u)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        status, body = _http("GET", f"{base}/distinct/bird")
+        if status == 200:
+            break
+        time.sleep(0.1)
+    assert status == 200 and json.loads(body) == 1
+    speed.close()
+    serving.close()

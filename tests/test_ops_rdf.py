@@ -1,0 +1,143 @@
+"""RDF ops tests: binning, histogram forest growth (classification +
+regression, numeric + categorical splits), routing parity between host
+and jit paths, and node-ID wire format (the LocalitySensitiveHashTest /
+DecisionTreeTest altitude of the reference suite)."""
+
+import numpy as np
+import pytest
+
+from oryx_tpu.common.rng import RandomManager
+from oryx_tpu.ops import rdf
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    RandomManager.use_test_seed()
+    yield
+
+
+def test_node_id_round_trip():
+    ids = [rdf.heap_to_node_id(i) for i in range(31)]
+    assert ids[:7] == ["r", "r-", "r+", "r--", "r-+", "r+-", "r++"]
+    for i, s in enumerate(ids):
+        assert rdf.node_id_to_heap(s) == i
+    with pytest.raises(ValueError):
+        rdf.node_id_to_heap("x-")
+    with pytest.raises(ValueError):
+        rdf.node_id_to_heap("r0")
+
+
+def test_bin_dataset_quantiles_and_categories():
+    rng = np.random.default_rng(1)
+    x = np.stack([rng.random(500), rng.integers(0, 3, 500).astype(float)], axis=1)
+    data = rdf.bin_dataset(x, np.array([False, True]), np.array([0, 3]), 8)
+    assert data.n_bins[0] <= 8 and data.n_bins[1] == 3
+    assert data.binned[:, 0].max() < data.n_bins[0]
+    assert set(np.unique(data.binned[:, 1])) <= {0, 1, 2}
+    # NaN bins to the last bin
+    xb = rdf.bin_column(np.array([np.nan]), data.edges[0], int(data.n_bins[0]))
+    assert xb[0] == data.n_bins[0] - 1
+
+
+def _xor_data(n=3000):
+    rng = np.random.default_rng(2)
+    x0 = rng.random(n)
+    cat = rng.integers(0, 4, n)
+    y = ((x0 > 0.5) ^ (cat == 2)).astype(np.int32)
+    x = np.stack([x0, rng.random(n), cat.astype(float)], axis=1)
+    data = rdf.bin_dataset(x, np.array([False, False, True]), np.array([0, 0, 4]), 32)
+    return data, y
+
+
+def test_classification_learns_xor_of_numeric_and_categorical():
+    data, y = _xor_data()
+    forest = rdf.grow_forest(
+        data, y, num_trees=10, max_depth=6, impurity="entropy", n_classes=2
+    )
+    probs = rdf.predict_class_probs(forest, data.binned)
+    assert probs.shape == (len(y), 2)
+    assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+    acc = np.mean(probs.argmax(axis=1) == y)
+    assert acc > 0.95
+    # the irrelevant feature must matter least
+    imp = forest.feature_importances
+    assert imp[1] == min(imp) and max(imp) == 1.0
+
+
+def test_gini_also_learns():
+    data, y = _xor_data(1500)
+    forest = rdf.grow_forest(
+        data, y, num_trees=10, max_depth=6, impurity="gini", n_classes=2
+    )
+    acc = np.mean(rdf.predict_class_probs(forest, data.binned).argmax(axis=1) == y)
+    assert acc > 0.93
+
+
+def test_regression_learns_additive_function():
+    rng = np.random.default_rng(3)
+    n = 3000
+    x0, x1 = rng.random(n), rng.random(n)
+    y = (3 * x0 + np.sin(4 * x1)).astype(np.float32)
+    x = np.stack([x0, x1], axis=1)
+    data = rdf.bin_dataset(x, np.array([False, False]), np.array([0, 0]), 64)
+    forest = rdf.grow_forest(
+        data, y, num_trees=15, max_depth=8, impurity="variance", n_classes=0
+    )
+    pred = rdf.predict_regression(forest, data.binned)
+    rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+    assert rmse < 0.35 * y.std()
+
+
+def test_route_host_and_jit_agree():
+    data, y = _xor_data(800)
+    forest = rdf.grow_forest(
+        data, y, num_trees=4, max_depth=5, impurity="entropy", n_classes=2
+    )
+    host = rdf.route_binned(
+        forest.feature, forest.split_left, data.binned, forest.max_depth
+    )
+    jit = np.asarray(
+        rdf.route_binned_jit(
+            forest.feature,
+            forest.split_left,
+            data.binned,
+            max_depth=forest.max_depth,
+        )
+    )
+    np.testing.assert_array_equal(host, jit)
+    # every terminal slot is a real node: non-split (feature == -1)
+    t_ix = np.arange(forest.num_trees)[:, None]
+    assert (forest.feature[t_ix, host] == -1).all()
+
+
+def test_deterministic_under_test_seed():
+    data, y = _xor_data(500)
+    RandomManager.use_test_seed()
+    f1 = rdf.grow_forest(
+        data, y, num_trees=3, max_depth=4, impurity="entropy", n_classes=2
+    )
+    RandomManager.use_test_seed()
+    f2 = rdf.grow_forest(
+        data, y, num_trees=3, max_depth=4, impurity="entropy", n_classes=2
+    )
+    np.testing.assert_array_equal(f1.feature, f2.feature)
+    np.testing.assert_array_equal(f1.class_counts, f2.class_counts)
+
+
+def test_mesh_sharded_growth_matches_shapes():
+    from oryx_tpu.parallel.mesh import host_mesh
+
+    data, y = _xor_data(400)
+    mesh = host_mesh()
+    forest = rdf.grow_forest(
+        data,
+        y,
+        num_trees=8,
+        max_depth=4,
+        impurity="entropy",
+        n_classes=2,
+        mesh=mesh,
+    )
+    assert forest.feature.shape[0] == 8
+    acc = np.mean(rdf.predict_class_probs(forest, data.binned).argmax(axis=1) == y)
+    assert acc > 0.8

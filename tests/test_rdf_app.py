@@ -1,0 +1,203 @@
+"""RDF application-tier tests: batch build + eval for classification and
+regression (with categorical predictors), speed-tier terminal-node stats
+on the reference wire format, serving-side live leaf updates, and the
+classreg REST surface over a real HTTP server."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from oryx_tpu.apps.rdf.batch import RDFUpdate
+from oryx_tpu.apps.rdf.serving import RDFServingModelManager
+from oryx_tpu.apps.rdf.speed import RDFSpeedModelManager
+from oryx_tpu.bus.api import KeyMessage
+from oryx_tpu.bus.broker import get_broker, topics
+from oryx_tpu.bus.inproc import InProcBroker
+from oryx_tpu.common.config import load_config
+from oryx_tpu.common.ioutil import choose_free_port
+from oryx_tpu.common.rng import RandomManager
+from oryx_tpu.serving.server import ServingLayer
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    RandomManager.use_test_seed()
+    InProcBroker.reset_all()
+    yield
+    InProcBroker.reset_all()
+
+
+def _cls_cfg(port=0):
+    return load_config(overlay={
+        "oryx.id": "rdft",
+        "oryx.input-topic.broker": "mem://rdft",
+        "oryx.update-topic.broker": "mem://rdft",
+        "oryx.serving.api.port": port,
+        "oryx.serving.model-manager-class":
+            "oryx_tpu.apps.rdf.serving.RDFServingModelManager",
+        "oryx.serving.application-resources": [
+            "oryx_tpu.serving.resources.common",
+            "oryx_tpu.serving.resources.classreg",
+        ],
+        "oryx.input-schema.feature-names": ["size", "color", "label"],
+        "oryx.input-schema.numeric-features": ["size"],
+        "oryx.input-schema.target-feature": "label",
+        "oryx.rdf.num-trees": 8,
+        "oryx.rdf.hyperparams.max-depth": 5,
+        "oryx.ml.eval.test-fraction": 0.2,
+    })
+
+
+def _reg_cfg():
+    return load_config(overlay={
+        "oryx.id": "rdfr",
+        "oryx.input-schema.feature-names": ["a", "b", "y"],
+        "oryx.input-schema.numeric-features": ["a", "b", "y"],
+        "oryx.input-schema.target-feature": "y",
+        "oryx.rdf.num-trees": 8,
+        "oryx.rdf.hyperparams.max-depth": 6,
+    })
+
+
+def _cls_lines(n=600, seed=0):
+    """label = banana iff (size>0.5) xor (color==red)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        size = rng.random()
+        color = rng.choice(["red", "green", "blue"])
+        label = "banana" if (size > 0.5) ^ (color == "red") else "apple"
+        out.append(KeyMessage(None, f"{size:.4f},{color},{label}"))
+    return out
+
+
+def _reg_lines(n=800, seed=1):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        a, b = rng.random(), rng.random()
+        y = 3 * a - 2 * b
+        out.append(KeyMessage(None, f"{a:.4f},{b:.4f},{y:.4f}"))
+    return out
+
+
+def _hp(cfg):
+    return {
+        "max-split-candidates": 32,
+        "max-depth": cfg.get_int("oryx.rdf.hyperparams.max-depth", 8),
+        "impurity": "entropy",
+    }
+
+
+def test_classification_build_and_evaluate():
+    cfg = _cls_cfg()
+    upd = RDFUpdate(cfg)
+    data = _cls_lines()
+    art = upd.build_model(data, _hp(cfg))
+    assert art.content["numTrees"] == 8
+    assert set(art.content["encodings"]["1"]) == {"red", "green", "blue"}
+    acc = upd.evaluate(art, data, _cls_lines(200, seed=7))
+    assert acc > 0.9
+
+
+def test_regression_build_and_evaluate():
+    cfg = _reg_cfg()
+    upd = RDFUpdate(cfg)
+    art = upd.build_model(_reg_lines(), _hp(cfg))
+    neg_rmse = upd.evaluate(art, [], _reg_lines(200, seed=9))
+    assert -neg_rmse < 0.5  # y spans roughly [-2, 3]
+
+
+def test_speed_manager_emits_terminal_node_stats():
+    cfg = _cls_cfg()
+    art = RDFUpdate(cfg).build_model(_cls_lines(), _hp(cfg))
+    mgr = RDFSpeedModelManager(cfg)
+    assert mgr.build_updates([KeyMessage(None, "0.9,red,apple")]) == []  # no model
+    mgr.consume_key_message("MODEL", art.to_string())
+    ups = mgr.build_updates([KeyMessage(None, "0.9,red,apple")] * 5)
+    assert len(ups) == 8  # one terminal node per tree
+    for u in ups:
+        tree, node_id, counts = json.loads(u)
+        assert 0 <= tree < 8
+        assert node_id.startswith("r") and set(node_id[1:]) <= {"-", "+"}
+        assert sum(counts.values()) == 5
+    mgr.consume_key_message("UP", ups[0])  # ignored, no error
+
+
+def test_serving_applies_leaf_updates():
+    cfg = _cls_cfg()
+    art = RDFUpdate(cfg).build_model(_cls_lines(), _hp(cfg))
+    mgr = RDFServingModelManager(cfg)
+    mgr.consume_key_message("UP", json.dumps([0, "r", {"0": 1}]))  # pre-model noop
+    mgr.consume_key_message("MODEL", art.to_string())
+    model = mgr.get_model()
+    value, probs = model.predict("0.9,red,")
+    assert value == "apple" and probs is not None
+    # flood one datum's terminal nodes with banana counts via speed messages
+    banana_code = model.rdf.encodings.encode(2, "banana")
+    speed = RDFSpeedModelManager(cfg)
+    speed.consume_key_message("MODEL", art.to_string())
+    for u in speed.build_updates(
+        [KeyMessage(None, "0.9,red,banana")] * 500
+    ):
+        mgr.consume_key_message("UP", u)
+    value_after, _ = model.predict("0.9,red,")
+    assert value_after == "banana"
+    dist = model.classification_distribution("0.9,red,")
+    assert dist["banana"] > dist["apple"]
+    assert banana_code in (0, 1)
+
+
+def _http(method, url, body=None):
+    req = urllib.request.Request(
+        url, method=method, data=body, headers={"Accept": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_classreg_rest_surface():
+    port = choose_free_port()
+    cfg = _cls_cfg(port)
+    topics.maybe_create("mem://rdft", cfg.get_string("oryx.input-topic.message.topic"), 1)
+    topics.maybe_create("mem://rdft", cfg.get_string("oryx.update-topic.message.topic"), 1)
+    broker = get_broker("mem://rdft")
+    art = RDFUpdate(cfg).build_model(_cls_lines(), _hp(cfg))
+    broker.send(
+        cfg.get_string("oryx.update-topic.message.topic"), "MODEL", art.to_string()
+    )
+    with ServingLayer(cfg) as layer:
+        base = f"http://127.0.0.1:{port}"
+        for _ in range(100):
+            try:
+                if _http("GET", f"{base}/ready")[0] == 200:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.1)
+        s, body = _http("GET", f"{base}/predict/0.9,red,")
+        assert s == 200 and json.loads(body) in ("apple", "banana")
+        s, body = _http("POST", f"{base}/predict", b"0.9,red,\n0.1,red,\n")
+        assert s == 200 and len(json.loads(body)) == 2
+        s, body = _http("GET", f"{base}/classificationDistribution/0.9,red,")
+        assert s == 200
+        dist = dict((k, v) for k, v in json.loads(body))
+        assert abs(sum(dist.values()) - 1.0) < 1e-5
+        s, body = _http("GET", f"{base}/feature/importance")
+        assert s == 200 and len(json.loads(body)) == 2
+        s, body = _http("GET", f"{base}/feature/importance/0")
+        assert s == 200
+        s, body = _http("GET", f"{base}/feature/importance/9")
+        assert s == 400
+        s, _ = _http("POST", f"{base}/train/0.5,blue,apple")
+        assert s == 200
+        in_topic = cfg.get_string("oryx.input-topic.message.topic")
+        recs = broker.read(in_topic, 0, 0, 10)
+        assert any(m == "0.5,blue,apple" for _, _, m in recs)
