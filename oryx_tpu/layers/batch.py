@@ -21,6 +21,7 @@ from oryx_tpu.bus.broker import get_broker
 from oryx_tpu.common.classutil import load_instance_of
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.ioutil import delete_older_than, strip_scheme
+from oryx_tpu.common.metrics import GENERATION_BUCKETS, get_registry, maybe_profile
 from oryx_tpu.layers.datastore import load_all_data, save_generation
 
 log = logging.getLogger(__name__)
@@ -51,6 +52,19 @@ class BatchLayer:
         self._thread: threading.Thread | None = None
         self._consumer: ConsumeDataIterator | None = None
         self.generation_count = 0
+        self._profile_dir = config.get_string("oryx.monitoring.profile-dir", None)
+        reg = get_registry()
+        self._m_generations = reg.counter(
+            "oryx_batch_generations_total", "Completed batch generations"
+        )
+        self._m_records = reg.counter(
+            "oryx_batch_input_records_total", "Input records consumed by the batch layer"
+        )
+        self._m_duration = reg.histogram(
+            "oryx_batch_generation_seconds",
+            "Wall-clock per batch generation (model build)",
+            buckets=GENERATION_BUCKETS,
+        )
 
     def ensure_streams(self) -> None:
         """Open consumers/producers now (otherwise lazily on first use).
@@ -86,7 +100,10 @@ class BatchLayer:
         past_data = load_all_data(self.data_dir)
         if new_data or past_data:
             try:
-                self.update.run_update(ts, new_data, past_data, self.model_dir, self._producer)
+                with self._m_duration.time(), maybe_profile(self._profile_dir, "batch-gen"):
+                    self.update.run_update(
+                        ts, new_data, past_data, self.model_dir, self._producer
+                    )
             except Exception:
                 # a failed build must not lose the window: persist + commit
                 # still run, and the next generation retries over history
@@ -98,6 +115,8 @@ class BatchLayer:
         delete_older_than(self.data_dir, self.max_age_data)
         delete_older_than(self.model_dir, self.max_age_model)
         self.generation_count += 1
+        self._m_generations.inc()
+        self._m_records.inc(len(new_data))
         return len(new_data)
 
     def start(self) -> None:

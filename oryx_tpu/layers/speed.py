@@ -20,6 +20,7 @@ from oryx_tpu.bus.api import ConsumeDataIterator, TopicProducer
 from oryx_tpu.bus.broker import get_broker
 from oryx_tpu.common.classutil import load_instance_of
 from oryx_tpu.common.config import Config
+from oryx_tpu.common.metrics import MICROBATCH_BUCKETS, get_registry
 
 log = logging.getLogger(__name__)
 
@@ -46,6 +47,21 @@ class SpeedLayer:
         self._input_consumer: ConsumeDataIterator | None = None
         self._update_consumer: ConsumeDataIterator | None = None
         self.batch_count = 0
+        reg = get_registry()
+        self._m_batches = reg.counter(
+            "oryx_speed_batches_total", "Completed speed micro-batches"
+        )
+        self._m_records = reg.counter(
+            "oryx_speed_input_records_total", "Input records consumed by the speed layer"
+        )
+        self._m_updates = reg.counter(
+            "oryx_speed_updates_total", "Update messages published by the speed layer"
+        )
+        self._m_duration = reg.histogram(
+            "oryx_speed_batch_seconds",
+            "Wall-clock per speed micro-batch",
+            buckets=MICROBATCH_BUCKETS,
+        )
 
     def ensure_streams(self) -> None:
         """Open consumers/producers now (otherwise lazily on first use).
@@ -86,9 +102,11 @@ class SpeedLayer:
         batch = self._input_consumer.poll_available()
         if batch:
             try:
-                updates = list(self.manager.build_updates(batch))
+                with self._m_duration.time():
+                    updates = list(self.manager.build_updates(batch))
                 if updates:
                     self._producer.send_batch(updates)
+                self._m_updates.inc(len(updates))
             except Exception:
                 # rewind to where this window began (NOT the committed
                 # offsets — on a fresh group those fall back to the log end,
@@ -99,6 +117,8 @@ class SpeedLayer:
                 return len(batch)
         self._input_consumer.commit()
         self.batch_count += 1
+        self._m_batches.inc()
+        self._m_records.inc(len(batch))
         return len(batch)
 
     def start(self) -> None:

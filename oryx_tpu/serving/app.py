@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import json
 import re
+import time
+import weakref
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -19,6 +21,17 @@ from oryx_tpu.api import ServingModelManager
 from oryx_tpu.bus.api import TopicProducer
 from oryx_tpu.common.classutil import load_class
 from oryx_tpu.common.config import Config
+from oryx_tpu.common.metrics import get_registry
+
+
+@dataclass
+class RawResponse:
+    """Bypass content negotiation — body served verbatim (e.g. /metrics
+    Prometheus text, HTML consoles)."""
+
+    status: int
+    body: bytes
+    content_type: str
 
 
 class OryxServingException(Exception):
@@ -85,6 +98,22 @@ class ServingApp:
         self.input_producer = input_producer
         self.min_fraction = config.get_float("oryx.serving.min-model-load-fraction", 0.8)
         self.routes: list[_Route] = []
+        reg = get_registry()
+        self._m_requests = reg.counter(
+            "oryx_serving_requests_total", "Serving requests by method and status"
+        )
+        self._m_latency = reg.histogram(
+            "oryx_serving_request_seconds", "Serving request latency by method"
+        )
+        # label by manager class and hold the app weakly: several ServingApps
+        # can coexist in one process (tests, embedders) and the process-global
+        # registry must neither pin them alive nor conflate their models
+        ref = weakref.ref(self)
+        reg.gauge(
+            "oryx_serving_model_load_fraction", "Fraction of the model loaded"
+        ).set_function(
+            lambda: _load_fraction(ref), manager=type(model_manager).__name__
+        )
         self._load_resources()
 
     def _load_resources(self) -> None:
@@ -127,6 +156,13 @@ class ServingApp:
 
     def dispatch(self, req: Request) -> tuple[int, bytes, str]:
         """Route and render; returns (status, body_bytes, content_type)."""
+        start = time.monotonic()
+        resp = self._dispatch(req)
+        self._m_latency.observe(time.monotonic() - start, method=req.method)
+        self._m_requests.inc(method=req.method, status=str(resp[0]))
+        return resp
+
+    def _dispatch(self, req: Request) -> tuple[int, bytes, str]:
         matched_path = False
         for r in self.routes:
             m = r.pattern.match(req.path)
@@ -146,6 +182,14 @@ class ServingApp:
         if matched_path:
             return _render_error(405, "method not allowed", req)
         return _render_error(404, f"no such endpoint: {req.path}", req)
+
+
+def _load_fraction(app_ref) -> float:
+    app = app_ref()
+    if app is None:
+        raise LookupError("serving app gone")  # render() skips this series
+    model = app.model_manager.get_model()
+    return model.fraction_loaded() if model is not None else 0.0
 
 
 def _unquote(s: str) -> str:
@@ -184,6 +228,8 @@ def _to_csv_rows(value: Any) -> list[list]:
 
 
 def _render(result: Any, req: Request) -> tuple[int, bytes, str]:
+    if isinstance(result, RawResponse):
+        return result.status, result.body, result.content_type
     if result is None:
         return 204, b"", "text/plain"
     if isinstance(result, tuple) and len(result) == 2 and isinstance(result[0], int):

@@ -7,7 +7,8 @@ the server's request decoding).
 
 from __future__ import annotations
 
-from oryx_tpu.serving.app import OryxServingException, Request, ServingApp
+from oryx_tpu.common.metrics import get_registry
+from oryx_tpu.serving.app import OryxServingException, RawResponse, Request, ServingApp
 
 
 def send_input_lines(app: ServingApp, text: str, what: str = "data points") -> int:
@@ -39,3 +40,10 @@ def register(app: ServingApp) -> None:
     def ingest(a: ServingApp, req: Request):
         n = send_input_lines(a, req.body_text(), "ingest body")
         return 200, {"ingested": n}
+
+    if app.config.get_bool("oryx.monitoring.metrics", True):
+
+        @app.route("GET", "/metrics")
+        def metrics(a: ServingApp, req: Request):
+            text = get_registry().render_prometheus()
+            return RawResponse(200, text.encode("utf-8"), "text/plain; version=0.0.4")

@@ -9,6 +9,7 @@
 from __future__ import annotations
 
 from oryx_tpu.serving.app import OryxServingException, Request, ServingApp
+from oryx_tpu.serving.resources.common import send_input_lines
 
 
 def register(app: ServingApp) -> None:
@@ -30,7 +31,5 @@ def register(app: ServingApp) -> None:
 
     @app.route("POST", "/add")
     def add(a: ServingApp, req: Request):
-        for line in req.body_text().splitlines():
-            if line.strip():
-                a.send_input(line.strip())
+        send_input_lines(a, req.body_text(), "lines")
         return 200, None

@@ -4,6 +4,7 @@ serving` subprocess answering HTTP on a file:// broker."""
 
 import io
 import json
+import pathlib
 import subprocess
 import sys
 import time
@@ -63,10 +64,7 @@ def test_input_pumps_stdin(monkeypatch):
     )
     assert rc == 0
     broker = get_broker("mem://cli2")
-    msgs = {m for _, _, m in broker.read("OryxInput", 0, 0, 10)}
-    msgs |= {m for _, _, m in broker.read("OryxInput", 1, 0, 10)} if (
-        broker.num_partitions("OryxInput") > 1
-    ) else set()
+    msgs: set[str] = set()
     for p in range(broker.num_partitions("OryxInput")):
         msgs |= {m for _, _, m in broker.read("OryxInput", p, 0, 10)}
     assert {"line one", "line two"} <= msgs
@@ -97,7 +95,7 @@ def test_serving_subprocess_round_trip(tmp_path):
     get_broker(bus).send("OryxUpdate", "MODEL", json.dumps({"cat": 2}))
     proc = subprocess.Popen(
         [sys.executable, "-m", "oryx_tpu.cli", "serving", *flags],
-        cwd="/root/repo",
+        cwd=str(pathlib.Path(__file__).resolve().parent.parent),
         stdout=subprocess.DEVNULL,
         stderr=subprocess.PIPE,
     )

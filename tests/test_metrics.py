@@ -1,0 +1,144 @@
+"""Metrics registry, Prometheus rendering, and layer/serving integration.
+
+The reference has no metrics subsystem (SURVEY.md §5); these cover the new
+native one: counter/gauge/histogram semantics, exposition format, and the
+serving layer's /metrics endpoint + request instrumentation.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from oryx_tpu.common.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    maybe_profile,
+)
+
+
+def test_counter_inc_and_labels():
+    c = Counter("reqs", "requests")
+    c.inc()
+    c.inc(2.0)
+    c.inc(method="GET")
+    assert c.value() == 3.0
+    assert c.value(method="GET") == 1.0
+    text = "\n".join(c.render())
+    assert "# TYPE reqs counter" in text
+    assert 'reqs{method="GET"} 1' in text
+    assert "reqs 3" in text
+
+
+def test_gauge_set_inc_dec_and_function():
+    g = Gauge("frac", "fraction")
+    g.set(0.5)
+    g.inc(0.25)
+    g.dec(0.5)
+    assert abs(g.value() - 0.25) < 1e-9
+    g.set_function(lambda: 0.9, kind="fn")
+    assert g.value(kind="fn") == 0.9
+    text = "\n".join(g.render())
+    assert 'frac{kind="fn"} 0.9' in text
+
+
+def test_histogram_buckets_cumulative():
+    h = Histogram("lat", "latency", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.count() == 4
+    assert abs(h.sum() - 5.555) < 1e-9
+    text = "\n".join(h.render())
+    # cumulative: <=0.01 ->1, <=0.1 ->2, <=1 ->3, +Inf ->4
+    assert 'lat_bucket{le="0.01"} 1' in text
+    assert 'lat_bucket{le="0.1"} 2' in text
+    assert 'lat_bucket{le="1"} 3' in text
+    assert 'lat_bucket{le="+Inf"} 4' in text
+    assert "lat_count 4" in text
+
+
+def test_histogram_time_context():
+    h = Histogram("t", "timing", buckets=(10.0,))
+    with h.time(op="x"):
+        pass
+    assert h.count(op="x") == 1
+
+
+def test_registry_same_name_returns_same_metric_and_kind_conflict():
+    reg = MetricsRegistry()
+    c1 = reg.counter("a", "first")
+    c2 = reg.counter("a")
+    assert c1 is c2
+    try:
+        reg.gauge("a")
+        raise AssertionError("expected kind conflict")
+    except ValueError:
+        pass
+    out = reg.render_prometheus()
+    assert out.endswith("\n")
+
+
+def test_registry_thread_safety():
+    reg = MetricsRegistry()
+    c = reg.counter("n", "")
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == 8000
+
+
+def test_maybe_profile_noop_without_dir():
+    with maybe_profile(None, "gen"):
+        x = 1
+    assert x == 1
+
+
+def test_global_registry_is_singleton():
+    assert get_registry() is get_registry()
+
+
+def test_serving_metrics_endpoint(tmp_path):
+    from oryx_tpu.api import ServingModelManager
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.serving.app import Request, ServingApp
+
+    class Manager(ServingModelManager):
+        def __init__(self, config):
+            self.config = config
+
+        def consume(self, it):
+            pass
+
+        def get_model(self):
+            return None
+
+    cfg = load_config(
+        overlay={"oryx.serving.application-resources": ["oryx_tpu.serving.resources.common"]}
+    )
+    app = ServingApp(cfg, Manager(cfg))
+
+    def get(path):
+        return app.dispatch(
+            Request("GET", path, {}, {}, b"", {"accept": "application/json"})
+        )
+
+    # a request that 503s (no model) still gets counted
+    status, _, _ = get("/ready")
+    assert status == 503
+    status, body, ctype = get("/metrics")
+    assert status == 200
+    assert ctype.startswith("text/plain")
+    text = body.decode()
+    assert "oryx_serving_requests_total" in text
+    assert 'method="GET"' in text
+    assert "oryx_serving_model_load_fraction" in text
+    assert "oryx_serving_request_seconds_bucket" in text
