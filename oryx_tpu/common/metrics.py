@@ -51,8 +51,12 @@ def _escape(v: str) -> str:
 
 
 def _fmt_value(v: float) -> str:
+    if v != v:
+        return "NaN"
     if v == float("inf"):
         return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
     if v == int(v):
         return str(int(v))
     return repr(v)
@@ -137,6 +141,11 @@ class Gauge:
             if fn is not None:
                 try:
                     v = float(fn())
+                except LookupError:
+                    # bound object is gone (dead weakref) — drop the series
+                    with self._lock:
+                        self._fns.pop(key, None)
+                    continue
                 except Exception:
                     continue
             else:

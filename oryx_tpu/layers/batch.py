@@ -60,6 +60,9 @@ class BatchLayer:
         self._m_records = reg.counter(
             "oryx_batch_input_records_total", "Input records consumed by the batch layer"
         )
+        self._m_failures = reg.counter(
+            "oryx_batch_build_failures_total", "Batch generations whose model build raised"
+        )
         self._m_duration = reg.histogram(
             "oryx_batch_generation_seconds",
             "Wall-clock per batch generation (model build)",
@@ -108,6 +111,7 @@ class BatchLayer:
                 # a failed build must not lose the window: persist + commit
                 # still run, and the next generation retries over history
                 log.exception("model build failed at generation %d", ts)
+                self._m_failures.inc()
         else:
             log.info("generation %d: no data yet", ts)
         save_generation(self.data_dir, ts, new_data)

@@ -158,8 +158,11 @@ class ServingApp:
         """Route and render; returns (status, body_bytes, content_type)."""
         start = time.monotonic()
         resp = self._dispatch(req)
-        self._m_latency.observe(time.monotonic() - start, method=req.method)
-        self._m_requests.inc(method=req.method, status=str(resp[0]))
+        # bucket unknown methods: the label is client-controlled and must
+        # not grow the process-global registry without bound
+        method = req.method if req.method in _KNOWN_METHODS else "OTHER"
+        self._m_latency.observe(time.monotonic() - start, method=method)
+        self._m_requests.inc(method=method, status=str(resp[0]))
         return resp
 
     def _dispatch(self, req: Request) -> tuple[int, bytes, str]:
@@ -182,6 +185,9 @@ class ServingApp:
         if matched_path:
             return _render_error(405, "method not allowed", req)
         return _render_error(404, f"no such endpoint: {req.path}", req)
+
+
+_KNOWN_METHODS = frozenset({"GET", "HEAD", "POST", "PUT", "DELETE", "PATCH", "OPTIONS"})
 
 
 def _load_fraction(app_ref) -> float:

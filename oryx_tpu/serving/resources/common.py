@@ -11,16 +11,19 @@ from oryx_tpu.common.metrics import get_registry
 from oryx_tpu.serving.app import OryxServingException, RawResponse, Request, ServingApp
 
 
-def send_input_lines(app: ServingApp, text: str, what: str = "data points") -> int:
-    """Bulk lines -> input topic; 400 when nothing usable was given. The
-    one implementation behind /ingest, /add, and /train."""
+def send_input_lines(
+    app: ServingApp, text: str, what: str = "data points", required: bool = True
+) -> int:
+    """Bulk lines -> input topic; 400 when nothing usable was given (unless
+    required=False — the wordcount /add treats an empty flush as a no-op).
+    The one implementation behind /ingest, /add, and /train."""
     n = 0
     for line in text.splitlines():
         line = line.strip()
         if line:
             app.send_input(line)
             n += 1
-    if n == 0:
+    if n == 0 and required:
         raise OryxServingException(400, f"no {what} given")
     return n
 

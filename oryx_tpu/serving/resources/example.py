@@ -31,5 +31,6 @@ def register(app: ServingApp) -> None:
 
     @app.route("POST", "/add")
     def add(a: ServingApp, req: Request):
-        send_input_lines(a, req.body_text(), "lines")
+        # unlike /ingest, an empty flush has always been a 200 no-op here
+        send_input_lines(a, req.body_text(), "lines", required=False)
         return 200, None

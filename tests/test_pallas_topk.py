@@ -1,0 +1,93 @@
+"""Fused streaming dot+top-k Pallas kernel vs the XLA reference, run in
+the Pallas interpreter on CPU (the kernel itself targets TPU; the driver's
+bench exercises it on real hardware)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oryx_tpu.ops.als import topk_dot_batch, topk_dot_batch_xla
+from oryx_tpu.ops.pallas_topk import topk_dot_batch_pallas
+
+
+def _check(b, n_items, feats, k, block_b=8, block_i=256, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = jnp.asarray(rng.normal(size=(b, feats)), dtype=jnp.float32)
+    y = jnp.asarray(rng.normal(size=(n_items, feats)), dtype=jnp.float32)
+    v_ref, i_ref = topk_dot_batch_xla(xs, y, k=k)
+    v, i = topk_dot_batch_pallas(
+        xs, y, k=k, block_b=block_b, block_i=block_i, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(v), np.asarray(v_ref), atol=1e-4)
+    assert np.array_equal(np.asarray(i), np.asarray(i_ref))
+
+
+def test_matches_xla_basic():
+    _check(b=16, n_items=1000, feats=50, k=10)
+
+
+def test_uneven_batch_and_items():
+    # B not a multiple of block_b, I not a multiple of block_i: padding rows
+    # must never appear in results
+    _check(b=13, n_items=777, feats=33, k=5)
+
+
+def test_k_equals_one_and_larger_k():
+    _check(b=4, n_items=300, feats=8, k=1)
+    _check(b=4, n_items=300, feats=8, k=16)
+
+
+def test_single_item_block():
+    # items fit in one block: the running top-k is init + one merge
+    _check(b=8, n_items=100, feats=16, k=10, block_i=256)
+
+
+def test_fewer_items_than_k_padding_is_neg_inf():
+    rng = np.random.default_rng(3)
+    xs = jnp.asarray(rng.normal(size=(4, 16)), dtype=jnp.float32)
+    y = jnp.asarray(rng.normal(size=(6, 16)), dtype=jnp.float32)
+    # XLA's top_k rejects k > n_items outright; the kernel degrades
+    # gracefully: real items first, then -inf slots
+    v, i = topk_dot_batch_pallas(xs, y, k=10, block_b=8, block_i=256, interpret=True)
+    scores = np.asarray(xs, dtype=np.float64) @ np.asarray(y, dtype=np.float64).T
+    order = np.argsort(-scores, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(v)[:, :6],
+        np.take_along_axis(scores, order, axis=1)[:, :6],
+        atol=1e-4,
+    )
+    assert np.array_equal(np.asarray(i)[:, :6], order[:, :6])
+    assert np.all(np.isneginf(np.asarray(v)[:, 6:]))
+
+
+def test_bfloat16_inputs():
+    rng = np.random.default_rng(7)
+    xs = jnp.asarray(rng.normal(size=(8, 50)), dtype=jnp.bfloat16)
+    y = jnp.asarray(rng.normal(size=(512, 50)), dtype=jnp.bfloat16)
+    v, i = topk_dot_batch_pallas(xs, y, k=4, block_b=8, block_i=256, interpret=True)
+    v_ref, i_ref = topk_dot_batch_xla(xs, y, k=4)
+    # bf16 rounding differs between the two matmuls; compare scores loosely
+    # and require the top-1 to agree
+    np.testing.assert_allclose(np.asarray(v), np.asarray(v_ref), atol=0.05, rtol=0.05)
+    assert np.array_equal(np.asarray(i)[:, 0], np.asarray(i_ref)[:, 0])
+
+
+def test_k_over_lane_limit_rejected():
+    xs = jnp.zeros((4, 8), dtype=jnp.float32)
+    y = jnp.zeros((300, 8), dtype=jnp.float32)
+    with pytest.raises(ValueError):
+        topk_dot_batch_pallas(xs, y, k=200, interpret=True)
+
+
+def test_dispatcher_uses_xla_off_tpu():
+    # On CPU the dispatcher must route to XLA (pallas requires TPU unless
+    # interpret=True) and produce the standard result
+    rng = np.random.default_rng(11)
+    xs = jnp.asarray(rng.normal(size=(4, 8)), dtype=jnp.float32)
+    y = jnp.asarray(rng.normal(size=(100, 8)), dtype=jnp.float32)
+    v, i = topk_dot_batch(xs, y, k=3)
+    v_ref, i_ref = topk_dot_batch_xla(xs, y, k=3)
+    assert np.array_equal(np.asarray(i), np.asarray(i_ref))
