@@ -28,13 +28,45 @@ log = logging.getLogger(__name__)
 
 
 class ALSServingModel(ServingModel):
-    def __init__(self, state: ALSState):
+    def __init__(self, state: ALSState, sample_rate: float = 1.0, num_cores: int | None = None):
         self.state = state
         # (device matrix, ids, version) swapped as ONE tuple: readers always
         # see a matched pair, no lock on the read path
         self._device_view: tuple | None = None
         self._unit_view: tuple | None = None  # row-normalized Y, same keying
         self._sync_lock = threading.Lock()
+        # LSH candidate subsampling (CPU-parity approximation; the TPU path
+        # scores everything exactly): built lazily at first query
+        self.sample_rate = sample_rate
+        self._num_cores = num_cores
+        self._lsh = None
+        self._partition_view: tuple | None = None  # (partitions[N], version)
+
+    def _lsh_index(self):
+        """(lsh, host Y matrix, ids, partitions-per-row) — ONE matched
+        snapshot: matrix, id list, and partition assignment all from the
+        same store version (concurrent UP ingestion bumps the version; rows
+        from a fresher partitioning must never index an older matrix), the
+        host copy and partitioning each done once per version."""
+        from oryx_tpu.apps.als.lsh import LocalitySensitiveHash
+
+        if self._lsh is None:
+            with self._sync_lock:
+                if self._lsh is None:
+                    self._lsh = LocalitySensitiveHash(
+                        self.sample_rate, self.state.features, self._num_cores
+                    )
+        view = self._partition_view
+        version = self.state.y.get_version()
+        if view is None or view[3] != version:
+            with self._sync_lock:
+                view = self._partition_view
+                if view is None or view[3] != self.state.y.get_version():
+                    mat, ids, version = self.state.y.snapshot()
+                    mat = np.asarray(mat, dtype=np.float32)
+                    view = (mat, ids, self._lsh.indices_for(mat), version)
+                    self._partition_view = view
+        return self._lsh, view[0], view[1], view[2]
 
     def fraction_loaded(self) -> float:
         return self.state.fraction_loaded()
@@ -91,13 +123,34 @@ class ALSServingModel(ServingModel):
         rescorer=None,
         cosine: bool = False,
     ) -> list[tuple[str, float]]:
-        y, ids = self._y_unit_view() if cosine else self._y_view()
-        n = len(ids)
-        if n == 0:
-            return []
-        # over-fetch to survive exclusions/filters, then trim
-        k = min(n, how_many + len(exclude) + 8)
-        vals, idx = topk_dot(jnp.asarray(user_vector, dtype=jnp.float32), y, k=k)
+        if self.sample_rate < 1.0:
+            # LSH candidate subsampling: score only items whose partition is
+            # within the Hamming ball of the query's (the reference's
+            # candidate-partition fan-out, ALSServingModel.java:264-279).
+            # Matrix/ids/partitions are one matched snapshot from _lsh_index.
+            lsh, y_host, ids, parts = self._lsh_index()
+            if not ids:
+                return []
+            k = min(len(ids), how_many + len(exclude) + 8)
+            rows = np.nonzero(np.isin(parts, lsh.candidate_indices(user_vector)))[0]
+            if rows.size == 0:
+                return []
+            sub = y_host[rows] @ np.asarray(user_vector, dtype=np.float32)
+            if cosine:
+                norms = np.linalg.norm(y_host[rows], axis=1)
+                sub = sub / np.maximum(norms, 1e-12)
+            k = min(k, rows.size)
+            top = np.argpartition(-sub, k - 1)[:k]
+            top = top[np.argsort(-sub[top])]
+            vals, idx = sub[top], rows[top]
+        else:
+            y, ids = self._y_unit_view() if cosine else self._y_view()
+            n = len(ids)
+            if n == 0:
+                return []
+            # over-fetch to survive exclusions/filters, then trim
+            k = min(n, how_many + len(exclude) + 8)
+            vals, idx = topk_dot(jnp.asarray(user_vector, dtype=jnp.float32), y, k=k)
         out = []
         for v, j in zip(np.asarray(vals), np.asarray(idx)):
             ident = ids[int(j)]
@@ -179,6 +232,24 @@ class ALSServingModel(ServingModel):
         out.sort(key=lambda t: (-t[1], t[0]))
         return out[:how_many]
 
+    def representative_items(self, how_many: int) -> list[str]:
+        """A spread of items across the factor space. With LSH enabled this
+        is the reference's one-item-per-partition sample
+        (PopularRepresentativeItems); otherwise an even stride over the
+        store serves the same diverse-sample purpose. The LSH branch stays
+        entirely on host — no device view is materialized for it."""
+        if self.sample_rate < 1.0:
+            lsh, _, ids, parts = self._lsh_index()
+            if not ids:
+                return []
+            _, first_rows = np.unique(parts, return_index=True)
+            return [ids[int(r)] for r in first_rows[:how_many]]
+        _, ids = self._y_view()
+        if not ids:
+            return []
+        stride = max(1, len(ids) // how_many)
+        return list(ids[::stride][:how_many])
+
     def most_active_users(self, how_many: int) -> list[tuple[str, int]]:
         out = [(u, len(s)) for u, s in self.state.known_items_snapshot().items()]
         out.sort(key=lambda t: (-t[1], t[0]))
@@ -202,7 +273,7 @@ class ALSServingModelManager(AbstractServingModelManager):
         prev = self.model.state if self.model is not None else None
         state = apply_update_message(prev, key, message, with_known_items=True)
         if state is not None and state is not prev:
-            self.model = ALSServingModel(state)
+            self.model = ALSServingModel(state, sample_rate=self.als.sample_rate)
 
 
 def _load_rescorer_provider(config: Config):

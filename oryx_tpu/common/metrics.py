@@ -35,6 +35,12 @@ MICROBATCH_BUCKETS = (
 )
 
 
+class GaugeSeriesGone(Exception):
+    """Raised by a bound gauge callable to permanently remove its series
+    (e.g. the object it reports on was garbage-collected). Any other
+    exception from a callable skips the series for this scrape only."""
+
+
 def _label_key(labels: dict[str, str]) -> tuple[tuple[str, str], ...]:
     return tuple(sorted(labels.items()))
 
@@ -141,12 +147,12 @@ class Gauge:
             if fn is not None:
                 try:
                     v = float(fn())
-                except LookupError:
-                    # bound object is gone (dead weakref) — drop the series
+                except GaugeSeriesGone:
                     with self._lock:
                         self._fns.pop(key, None)
                     continue
                 except Exception:
+                    # transient callback failure: skip this scrape only
                     continue
             else:
                 v = snapshot.get(key, 0.0)

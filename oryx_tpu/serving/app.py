@@ -21,7 +21,7 @@ from oryx_tpu.api import ServingModelManager
 from oryx_tpu.bus.api import TopicProducer
 from oryx_tpu.common.classutil import load_class
 from oryx_tpu.common.config import Config
-from oryx_tpu.common.metrics import get_registry
+from oryx_tpu.common.metrics import GaugeSeriesGone, get_registry
 
 
 @dataclass
@@ -193,7 +193,7 @@ _KNOWN_METHODS = frozenset({"GET", "HEAD", "POST", "PUT", "DELETE", "PATCH", "OP
 def _load_fraction(app_ref) -> float:
     app = app_ref()
     if app is None:
-        raise LookupError("serving app gone")  # render() skips this series
+        raise GaugeSeriesGone("serving app gone")  # render() drops the series
     model = app.model_manager.get_model()
     return model.fraction_loaded() if model is not None else 0.0
 

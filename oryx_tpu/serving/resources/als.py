@@ -266,16 +266,11 @@ def register(app: ServingApp) -> None:
 
     @app.route("GET", "/popularRepresentativeItems")
     def popular_representative_items(a: ServingApp, req: Request):
-        """A spread of items across the factor space. The reference returns
-        one item per LSH partition; without LSH partitions we stride the
-        item store evenly, which serves the same 'diverse sample' purpose."""
+        """One item per LSH partition when LSH is on (reference
+        PopularRepresentativeItems), else an even stride over the store."""
         model = _model(a)
         how_many, _ = _how_many(req)
-        _, ids = model._y_view()
-        if not ids:
-            return []
-        stride = max(1, len(ids) // how_many)
-        return ids[::stride][:how_many]
+        return model.representative_items(how_many)
 
     @app.route("GET", "/user/allIDs")
     def user_all_ids(a: ServingApp, req: Request):

@@ -1,0 +1,151 @@
+"""LocalitySensitiveHash parity tests, mirroring the reference's
+LocalitySensitiveHashTest (app/oryx-app-serving src/test .../als/model/
+LocalitySensitiveHashTest.java): hash-count/bits selection for given
+(sample rate, cores), candidate-index structure, hash distribution, and
+the LSH-enabled serving top-N returning mostly the same results as exact."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from oryx_tpu.apps.als.lsh import MAX_HASHES, LocalitySensitiveHash
+from oryx_tpu.common.rng import RandomManager
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    RandomManager.use_test_seed(123)
+    yield
+    RandomManager.clear_test_seed()
+
+
+@pytest.mark.parametrize(
+    "sample_rate,cores,hashes,bits",
+    [
+        # testOneCore
+        (1.0, 1, 0, 0),
+        (0.5, 1, 1, 0),
+        (0.1, 1, 4, 0),
+        # testTwoCores
+        (1.0, 2, 1, 1),
+        (0.75, 3, 2, 1),
+        # testManyCores
+        (0.5, 3, 3, 1),
+        (0.1, 8, 7, 1),
+        (0.01, 8, 11, 1),
+        (0.001, 8, 14, 1),
+        (0.0001, 8, 16, 1),
+        (0.00001, 8, MAX_HASHES, 1),
+    ],
+)
+def test_hashes_bits_selection(sample_rate, cores, hashes, bits):
+    lsh = LocalitySensitiveHash(sample_rate, 10, cores)
+    assert lsh.num_hashes == hashes
+    assert lsh.max_bits_differing == bits
+
+
+def test_candidate_indices_no_sample():
+    lsh = LocalitySensitiveHash(1.0, 10, 8)
+    cands = lsh.candidate_indices(np.zeros(10, dtype=np.float32))
+    assert len(cands) == lsh.num_partitions
+    assert np.array_equal(np.sort(cands), np.arange(lsh.num_partitions))
+
+
+def test_candidate_indices_one_bit():
+    lsh = LocalitySensitiveHash(0.1, 10, 8)
+    assert lsh.max_bits_differing == 1
+    zero = lsh.candidate_indices(np.zeros(10, dtype=np.float32))
+    assert len(zero) == 1 + lsh.num_hashes
+    assert zero[0] == 0
+    # after the main index: each candidate flips exactly one bit
+    assert sorted(zero[1:]) == [1 << i for i in range(lsh.num_hashes)]
+
+    one = lsh.candidate_indices(np.ones(10, dtype=np.float32))
+    main = one[0]
+    assert sorted(c ^ main for c in one[1:]) == [1 << i for i in range(lsh.num_hashes)]
+
+
+def test_candidate_count_within_sample_rate_budget():
+    for rate in (0.5, 0.1, 0.01):
+        lsh = LocalitySensitiveHash(rate, 10, 1)
+        cands = lsh.candidate_indices(np.ones(10, dtype=np.float32))
+        assert len(cands) <= max(1, rate * lsh.num_partitions) + 1e-9
+
+
+def test_hash_distribution_roughly_uniform():
+    # random unit vectors should scatter across partitions (reference
+    # doTestHashDistribution checks mean hits per partition)
+    lsh = LocalitySensitiveHash(0.1, 40, 8)
+    rng = np.random.default_rng(7)
+    vecs = rng.standard_normal((2000, 40)).astype(np.float32)
+    parts = lsh.indices_for(vecs)
+    assert parts.min() >= 0 and parts.max() < lsh.num_partitions
+    # occupied partitions should be a sizable share for 2000 draws
+    assert len(np.unique(parts)) > lsh.num_partitions // 4
+
+
+def test_indices_for_matches_index_for():
+    lsh = LocalitySensitiveHash(0.1, 16, 8)
+    rng = np.random.default_rng(3)
+    vecs = rng.standard_normal((64, 16)).astype(np.float32)
+    batch = lsh.indices_for(vecs)
+    assert [lsh.index_for(v) for v in vecs] == list(batch)
+
+
+def test_candidate_partitions_contain_similar_vectors():
+    # vectors close in angle should share candidate partitions most of the
+    # time — the property the serving fan-out relies on
+    lsh = LocalitySensitiveHash(0.1, 32, 8)
+    rng = np.random.default_rng(11)
+    hits = 0
+    for _ in range(200):
+        v = rng.standard_normal(32).astype(np.float32)
+        w = v + 0.05 * rng.standard_normal(32).astype(np.float32)
+        if lsh.index_for(w) in set(lsh.candidate_indices(v)):
+            hits += 1
+    assert hits > 150
+
+
+def test_serving_topn_with_lsh_approximates_exact():
+    from oryx_tpu.apps.als.serving import ALSServingModel
+    from oryx_tpu.apps.als.state import ALSState
+
+    rng = np.random.default_rng(5)
+    features = 16
+    state = ALSState(features=features, implicit=True)
+    for i in range(500):
+        state.y.set(f"I{i}", rng.standard_normal(features).astype(np.float32))
+
+    exact = ALSServingModel(state)
+    approx = ALSServingModel(state, sample_rate=0.5, num_cores=4)
+    user = rng.standard_normal(features).astype(np.float32)
+    top_exact = [i for i, _ in exact.top_n(user, 10)]
+    top_approx = [i for i, _ in approx.top_n(user, 10)]
+    assert len(top_approx) == 10
+    # approximate recall: at least half of the true top-10 shows up
+    assert len(set(top_exact) & set(top_approx)) >= 5
+    # scores must be true dot products (no rescaling)
+    vals = dict(approx.top_n(user, 10))
+    for ident, v in vals.items():
+        np.testing.assert_allclose(
+            v, float(state.y.get(ident) @ user), rtol=1e-4, atol=1e-4
+        )
+
+
+def test_representative_items_one_per_partition():
+    from oryx_tpu.apps.als.serving import ALSServingModel
+    from oryx_tpu.apps.als.state import ALSState
+
+    rng = np.random.default_rng(9)
+    state = ALSState(features=8, implicit=True)
+    for i in range(200):
+        state.y.set(f"I{i}", rng.standard_normal(8).astype(np.float32))
+    model = ALSServingModel(state, sample_rate=0.1, num_cores=4)
+    reps = model.representative_items(50)
+    assert 0 < len(reps) <= 50
+    # all reps from distinct partitions
+    lsh, _, ids, parts = model._lsh_index()
+    part_of = {ids[i]: parts[i] for i in range(len(ids))}
+    chosen = [part_of[r] for r in reps]
+    assert len(set(chosen)) == len(chosen)
