@@ -38,6 +38,10 @@ class ALSUpdate(MLUpdate):
     def __init__(self, config: Config, mesh=None):
         super().__init__(config)
         self.als = ALSConfig.from_config(config)
+        if mesh is None:
+            from oryx_tpu.parallel.distributed import mesh_from_config
+
+            mesh = mesh_from_config(config)
         self.mesh = mesh
 
     def hyperparam_ranges(self) -> dict[str, Any]:

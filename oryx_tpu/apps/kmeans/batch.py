@@ -30,6 +30,10 @@ class KMeansUpdate(MLUpdate):
         super().__init__(config)
         self.kmeans = KMeansConfig.from_config(config)
         self.schema = InputSchema(config)
+        if mesh is None:
+            from oryx_tpu.parallel.distributed import mesh_from_config
+
+            mesh = mesh_from_config(config)
         self.mesh = mesh
 
     def hyperparam_ranges(self) -> dict[str, Any]:

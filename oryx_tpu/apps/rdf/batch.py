@@ -44,6 +44,10 @@ class RDFUpdate(MLUpdate):
         self.schema = InputSchema(config)
         if not self.schema.has_target():
             raise ValueError("RDF requires a target feature")
+        if mesh is None:
+            from oryx_tpu.parallel.distributed import mesh_from_config
+
+            mesh = mesh_from_config(config)
         self.mesh = mesh
 
     def hyperparam_ranges(self) -> dict[str, Any]:

@@ -138,13 +138,17 @@ def _run_until_interrupt(layer) -> int:
 
 def cmd_batch(config: Config) -> int:
     from oryx_tpu.layers import BatchLayer
+    from oryx_tpu.parallel.distributed import init_distributed
 
+    init_distributed(config)
     return _run_until_interrupt(BatchLayer(config))
 
 
 def cmd_speed(config: Config) -> int:
     from oryx_tpu.layers import SpeedLayer
+    from oryx_tpu.parallel.distributed import init_distributed
 
+    init_distributed(config)
     return _run_until_interrupt(SpeedLayer(config))
 
 

@@ -1,0 +1,92 @@
+"""Multi-host distributed backend: config parsing, hybrid mesh shape math,
+global mesh on the virtual 8-device CPU mesh, and single-process no-ops.
+True multi-process joins can't run in one test process; the shape logic
+that decides the pod layout is pure and covered directly."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from oryx_tpu.common.config import load_config
+from oryx_tpu.parallel.distributed import (
+    DistributedConfig,
+    barrier,
+    global_mesh,
+    host_allgather,
+    hybrid_shape,
+    init_distributed,
+    mesh_from_config,
+)
+from oryx_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, MeshSpec
+
+
+def test_distributed_config_defaults_disabled():
+    cfg = load_config()
+    dc = DistributedConfig.from_config(cfg)
+    assert dc.num_processes == 1 and dc.coordinator_address is None
+    assert not dc.enabled
+
+
+def test_distributed_config_enabled():
+    cfg = load_config(overlay={
+        "oryx.compute.distributed.coordinator-address": "10.0.0.1:8476",
+        "oryx.compute.distributed.num-processes": 4,
+        "oryx.compute.distributed.process-id": 2,
+    })
+    dc = DistributedConfig.from_config(cfg)
+    assert dc.enabled and dc.num_processes == 4 and dc.process_id == 2
+
+
+def test_init_noop_single_process():
+    assert init_distributed(load_config()) is False
+
+
+def test_init_requires_coordinator():
+    cfg = load_config(overlay={"oryx.compute.distributed.num-processes": 2})
+    with pytest.raises(ValueError):
+        init_distributed(cfg)
+
+
+def test_hybrid_shape_model_within_host():
+    # 4 hosts x 8 local devices, model=4: model stays inside a host
+    assert hybrid_shape(4, 8, MeshSpec(data=-1, model=4)) == (2, 4, 4)
+    # pure data parallel
+    assert hybrid_shape(2, 8, MeshSpec()) == (8, 1, 2)
+
+
+def test_hybrid_shape_rejects_cross_host_model_axis():
+    with pytest.raises(ValueError):
+        hybrid_shape(2, 4, MeshSpec(data=1, model=8))
+
+
+def test_hybrid_shape_rejects_nondividing():
+    with pytest.raises(ValueError):
+        hybrid_shape(3, 8, MeshSpec(data=4, model=2))
+
+
+def test_global_mesh_single_process_spans_devices():
+    mesh = global_mesh(MeshSpec(data=4, model=2))
+    assert mesh.shape[DATA_AXIS] == 4 and mesh.shape[MODEL_AXIS] == 2
+
+
+def test_mesh_from_config_uses_all_devices():
+    mesh = mesh_from_config(load_config())
+    assert mesh is not None  # conftest forces 8 virtual CPU devices
+    assert mesh.shape[DATA_AXIS] * mesh.shape[MODEL_AXIS] == len(jax.devices())
+
+
+def test_barrier_and_allgather_single_process():
+    barrier("test")  # no-op, must not raise
+    out = host_allgather(np.asarray([1, 2, 3]))
+    assert out.shape == (1, 3)
+    assert list(out[0]) == [1, 2, 3]
+
+
+def test_trainer_picks_up_mesh_automatically():
+    from oryx_tpu.apps.als.batch import ALSUpdate
+
+    upd = ALSUpdate(load_config())
+    assert upd.mesh is not None
+    assert upd.mesh.shape[DATA_AXIS] * upd.mesh.shape[MODEL_AXIS] == len(jax.devices())
