@@ -26,6 +26,9 @@ from oryx_tpu.apps.als.state import ALSState, apply_update_message
 
 log = logging.getLogger(__name__)
 
+# Max LSH partition-rebuild frequency under live update ingestion.
+_LSH_REFRESH_SEC = 1.0
+
 
 class ALSServingModel(ServingModel):
     def __init__(self, state: ALSState, sample_rate: float = 1.0, num_cores: int | None = None):
@@ -40,7 +43,8 @@ class ALSServingModel(ServingModel):
         self.sample_rate = sample_rate
         self._num_cores = num_cores
         self._lsh = None
-        self._partition_view: tuple | None = None  # (partitions[N], version)
+        self._partition_view: tuple | None = None  # (mat, ids, parts, version)
+        self._partition_built_at = 0.0
 
     def _lsh_index(self):
         """(lsh, host Y matrix, ids, partitions-per-row) — ONE matched
@@ -58,14 +62,28 @@ class ALSServingModel(ServingModel):
                     )
         view = self._partition_view
         version = self.state.y.get_version()
-        if view is None or view[3] != version:
+        # Every single UP write bumps the store version; rebuilding the
+        # O(N.F) snapshot + O(N.H.F) partitioning per write would dwarf the
+        # subsampled scoring LSH exists for. Refresh at most once a second —
+        # queries in between serve the previous consistent snapshot (the
+        # whole read path is snapshot-based anyway).
+        import time as _time
+
+        now = _time.monotonic()
+        if view is None or (
+            view[3] != version and now - self._partition_built_at >= _LSH_REFRESH_SEC
+        ):
             with self._sync_lock:
                 view = self._partition_view
-                if view is None or view[3] != self.state.y.get_version():
+                if view is None or (
+                    view[3] != self.state.y.get_version()
+                    and _time.monotonic() - self._partition_built_at >= _LSH_REFRESH_SEC
+                ):
                     mat, ids, version = self.state.y.snapshot()
                     mat = np.asarray(mat, dtype=np.float32)
                     view = (mat, ids, self._lsh.indices_for(mat), version)
                     self._partition_view = view
+                    self._partition_built_at = _time.monotonic()
         return self._lsh, view[0], view[1], view[2]
 
     def fraction_loaded(self) -> float:
@@ -135,10 +153,10 @@ class ALSServingModel(ServingModel):
             rows = np.nonzero(np.isin(parts, lsh.candidate_indices(user_vector)))[0]
             if rows.size == 0:
                 return []
-            sub = y_host[rows] @ np.asarray(user_vector, dtype=np.float32)
+            cand = y_host[rows]
+            sub = cand @ np.asarray(user_vector, dtype=np.float32)
             if cosine:
-                norms = np.linalg.norm(y_host[rows], axis=1)
-                sub = sub / np.maximum(norms, 1e-12)
+                sub = sub / np.maximum(np.linalg.norm(cand, axis=1), 1e-12)
             k = min(k, rows.size)
             top = np.argpartition(-sub, k - 1)[:k]
             top = top[np.argsort(-sub[top])]

@@ -50,3 +50,28 @@ def register(app: ServingApp) -> None:
         def metrics(a: ServingApp, req: Request):
             text = get_registry().render_prometheus()
             return RawResponse(200, text.encode("utf-8"), "text/plain; version=0.0.4")
+
+    @app.route("GET", "/console")
+    def console(a: ServingApp, req: Request):
+        """Human status page (the reference serves an HTML console per app,
+        e.g. .../als/Console.java): model state + the route table."""
+        model = a.model_manager.get_model()
+        frac = model.fraction_loaded() if model is not None else 0.0
+        manager = type(a.model_manager).__name__
+        rows = "".join(
+            f"<tr><td>{r.method}</td><td><code>{r.pattern.pattern}</code></td></tr>"
+            for r in sorted(a.routes, key=lambda r: (r.pattern.pattern, r.method))
+        )
+        html = (
+            "<!doctype html><html><head><title>Oryx TPU Serving</title>"
+            "<style>body{font-family:sans-serif;margin:2em}table{border-collapse:"
+            "collapse}td,th{border:1px solid #ccc;padding:4px 8px}</style></head>"
+            f"<body><h1>Oryx TPU serving console</h1>"
+            f"<p>Model manager: <b>{manager}</b></p>"
+            f"<p>Model loaded: <b>{frac:.0%}</b>"
+            f"{' (serving)' if frac >= a.min_fraction else ' (warming up)'}</p>"
+            f"<p><a href='/metrics'>metrics</a> &middot; <a href='/ready'>ready</a></p>"
+            f"<h2>Endpoints</h2><table><tr><th>method</th><th>path</th></tr>"
+            f"{rows}</table></body></html>"
+        )
+        return RawResponse(200, html.encode("utf-8"), "text/html; charset=utf-8")

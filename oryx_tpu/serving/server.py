@@ -87,6 +87,21 @@ class ServingLayer:
         self.app = ServingApp(self.config, self.model_manager, input_producer)
         handler = _make_handler(self.app, self._auth_header())
         self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+        cert = self.config.get_string("oryx.serving.api.ssl-cert-file", None)
+        key = self.config.get_string("oryx.serving.api.ssl-key-file", None)
+        if cert:
+            # TLS termination in-process (the reference's Tomcat keystore
+            # connector, ServingLayer.java:58-339 — PEM instead of JKS)
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(cert, key or None)
+            # defer the handshake to the per-connection handler thread —
+            # with the default handshake-on-accept, one client that opens a
+            # socket and never speaks TLS would block the accept loop
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True, do_handshake_on_connect=False
+            )
         self.port = self._httpd.server_address[1]
         self._http_thread = threading.Thread(
             target=self._httpd.serve_forever, name="oryx-serving-http", daemon=True
@@ -125,6 +140,7 @@ class ServingLayer:
 def _make_handler(app: ServingApp, auth: str | None):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        timeout = 30  # bounds slow/stalled clients (incl. deferred TLS handshakes)
 
         def log_message(self, fmt, *args):  # route to logging, not stderr
             log.debug("http: " + fmt, *args)
@@ -155,6 +171,12 @@ def _make_handler(app: ServingApp, auth: str | None):
             status, payload, ctype = app.dispatch(req)
             self.send_response(status)
             self.send_header("Content-Type", ctype)
+            # compress sizable responses for clients that accept it (the
+            # reference gzips csv/json via its Tomcat connector)
+            accept_enc = self.headers.get("Accept-Encoding", "")
+            if "gzip" in accept_enc.lower() and len(payload) >= 1024:
+                payload = gzip.compress(payload, compresslevel=5)
+                self.send_header("Content-Encoding", "gzip")
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             if method != "HEAD":

@@ -1,0 +1,134 @@
+"""Serving runtime extras: gzip response encoding, the HTML console, and
+TLS termination (parity with the reference's Tomcat connector features:
+compression, per-app console, keystore TLS)."""
+
+from __future__ import annotations
+
+import gzip
+import http.client
+import json
+import shutil
+import socket
+import ssl
+import subprocess
+import time
+import urllib.request
+
+import pytest
+
+from oryx_tpu.common.config import load_config
+from oryx_tpu.bus.broker import get_broker
+from oryx_tpu.serving.server import ServingLayer
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _config(bus: str, port: int, **extra):
+    overlay = {
+        "oryx.input-topic.broker": bus,
+        "oryx.update-topic.broker": bus,
+        "oryx.serving.api.port": port,
+        "oryx.serving.model-manager-class": "oryx_tpu.apps.example.serving.ExampleServingModelManager",
+        "oryx.serving.application-resources": [
+            "oryx_tpu.serving.resources.common",
+            "oryx_tpu.serving.resources.example",
+        ],
+    }
+    overlay.update(extra)
+    return load_config(overlay=overlay)
+
+
+def _setup_bus(bus: str):
+    broker = get_broker(bus)
+    for t in ("OryxInput", "OryxUpdate"):
+        if not broker.topic_exists(t):
+            broker.create_topic(t, 1)
+    broker.send("OryxUpdate", "MODEL", json.dumps({"big": 1, "word": 2}))
+    return broker
+
+
+def _wait_ready(port: int, scheme="http", context=None):
+    for _ in range(100):
+        try:
+            req = urllib.request.Request(f"{scheme}://127.0.0.1:{port}/ready")
+            with urllib.request.urlopen(req, timeout=2, context=context) as r:
+                if r.status == 200:
+                    return
+        except Exception:
+            pass
+        time.sleep(0.1)
+    raise TimeoutError("serving layer never became ready")
+
+
+def test_gzip_response_and_console():
+    port = _free_port()
+    _setup_bus("mem://extras1")
+    # fat model so /distinct exceeds the 1KB compression floor
+    get_broker("mem://extras1").send(
+        "OryxUpdate", "MODEL", json.dumps({f"word{i}": i for i in range(400)})
+    )
+    with ServingLayer(_config("mem://extras1", port)) as sl:
+        _wait_ready(sl.port)
+        conn = http.client.HTTPConnection("127.0.0.1", sl.port, timeout=5)
+        conn.request("GET", "/distinct", headers={"Accept-Encoding": "gzip"})
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.getheader("Content-Encoding") == "gzip"
+        data = json.loads(gzip.decompress(body))
+        assert data["word399"] == 399
+
+        # small responses are sent uncompressed
+        conn.request("GET", "/ready", headers={"Accept-Encoding": "gzip"})
+        resp = conn.getresponse()
+        assert resp.getheader("Content-Encoding") is None
+        resp.read()
+
+        # console renders HTML with the route table + load state
+        conn.request("GET", "/console")
+        resp = conn.getresponse()
+        html = resp.read().decode()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type").startswith("text/html")
+        assert "/distinct" in html and "Model loaded" in html
+        conn.close()
+
+
+@pytest.mark.skipif(shutil.which("openssl") is None, reason="openssl not available")
+def test_tls_termination(tmp_path):
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(key), "-out", str(cert), "-days", "1",
+            "-subj", "/CN=localhost",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    port = _free_port()
+    _setup_bus("mem://extras2")
+    cfg = _config(
+        "mem://extras2",
+        port,
+        **{
+            "oryx.serving.api.ssl-cert-file": str(cert),
+            "oryx.serving.api.ssl-key-file": str(key),
+        },
+    )
+    with ServingLayer(cfg) as sl:
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        _wait_ready(sl.port, scheme="https", context=ctx)
+        with urllib.request.urlopen(
+            f"https://127.0.0.1:{sl.port}/distinct", timeout=5, context=ctx
+        ) as r:
+            assert r.status == 200
+            assert json.loads(r.read())["word"] == 2
