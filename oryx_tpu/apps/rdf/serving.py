@@ -50,6 +50,51 @@ class RDFServingModel(ServingModel):
         return self.rdf.feature_importance()
 
 
+class PMMLForestServingModel(ServingModel):
+    """Serves a forest imported from reference-published PMML (common/
+    pmml.py): same query surface as RDFServingModel — predict,
+    classification distribution, live UP folding by PMML node id — so a
+    migrated deployment answers /predict immediately, no retraining. New
+    batch generations then replace it with the native vectorized forest."""
+
+    def __init__(self, forest, schema: InputSchema):
+        self.forest = forest
+        self.schema = schema
+
+    def fraction_loaded(self) -> float:
+        return 1.0
+
+    def _features(self, datum: str) -> dict:
+        from oryx_tpu.common.text import parse_input_line
+
+        tokens = parse_input_line(datum)
+        names = self.schema.feature_names
+        out = {}
+        for i, tok in enumerate(tokens):
+            if i >= len(names):
+                break
+            name = names[i]
+            if self.schema.is_active(i) and not self.schema.is_target(i) and tok != "":
+                out[name] = tok
+        return out
+
+    def predict(self, datum: str):
+        result = self.forest.predict(self._features(datum))
+        if self.forest.is_classification:
+            return result  # (label, distribution dict)
+        return result, None
+
+    def classification_distribution(self, datum: str) -> dict[str, float]:
+        if not self.forest.is_classification:
+            raise ValueError("not a classification model")
+        _, dist = self.forest.predict(self._features(datum))
+        return dist
+
+    def feature_importance(self) -> list[float]:
+        # PMML MiningModels carry no importances; report zeros
+        return [0.0] * self.schema.num_predictors
+
+
 class RDFServingModelManager(AbstractServingModelManager):
     def __init__(self, config: Config):
         super().__init__(config)
@@ -67,7 +112,14 @@ class RDFServingModelManager(AbstractServingModelManager):
             update = json.loads(message)
             tree = int(update[0])
             node_id = str(update[1])
-            if model.rdf.forest.is_classification:
+            if isinstance(model, PMMLForestServingModel):
+                if model.forest.is_classification:
+                    model.forest.update_classification_leaf(tree, node_id, update[2])
+                else:
+                    model.forest.update_regression_leaf(
+                        tree, node_id, float(update[2]), int(update[3])
+                    )
+            elif model.rdf.forest.is_classification:
                 model.rdf.update_classification_leaf(tree, node_id, update[2])
             else:
                 model.rdf.update_regression_leaf(
@@ -75,10 +127,17 @@ class RDFServingModelManager(AbstractServingModelManager):
                 )
         elif key in ("MODEL", "MODEL-REF"):
             art = read_artifact_from_update(key, message)
-            self.model = RDFServingModel(artifact_to_model(art, self.schema))
-            log.info(
-                "new model loaded: %d trees",
-                self.model.rdf.forest.num_trees,
-            )
+            if art.app == "rdf-pmml":
+                from oryx_tpu.common.pmml import PredicateForest
+
+                forest = PredicateForest.from_artifact(art)
+                self.model = PMMLForestServingModel(forest, self.schema)
+                log.info("imported PMML model loaded: %d trees", len(forest.trees))
+            else:
+                self.model = RDFServingModel(artifact_to_model(art, self.schema))
+                log.info(
+                    "new model loaded: %d trees",
+                    self.model.rdf.forest.num_trees,
+                )
         else:
             raise ValueError(f"bad key: {key}")

@@ -32,9 +32,14 @@ def _parse_args(argv):
     p = argparse.ArgumentParser(prog="oryx_tpu", description=__doc__)
     p.add_argument(
         "command",
-        choices=["batch", "speed", "serving", "setup", "tail", "input"],
+        choices=["batch", "speed", "serving", "setup", "tail", "input", "import-pmml"],
     )
     p.add_argument("--conf", help="user config file (HOCON-like key paths)")
+    p.add_argument(
+        "--pmml",
+        help="PMML file to import (import-pmml): published to the update "
+        "topic as a MODEL so running speed/serving layers pick it up",
+    )
     p.add_argument(
         "--set",
         action="append",
@@ -122,6 +127,23 @@ def cmd_input(config: Config) -> int:
     return 0
 
 
+def cmd_import_pmml(config: Config, pmml_path: str | None = None) -> int:
+    """Migrate a reference-published PMML model: parse it into a native
+    artifact and publish it as a MODEL update (the message running
+    speed/serving layers already understand)."""
+    from oryx_tpu.bus.broker import get_broker
+    from oryx_tpu.common.pmml import pmml_to_artifact
+
+    if not pmml_path:
+        raise SystemExit("import-pmml requires --pmml <file>")
+    with open(pmml_path, encoding="utf-8") as f:
+        art = pmml_to_artifact(f.read())
+    uri, topic, _ = _topic_pairs(config)[1]
+    get_broker(uri).send(topic, "MODEL", art.to_string())
+    print(f"imported {art.app} model from {pmml_path} -> {topic}", file=sys.stderr)
+    return 0
+
+
 def _run_until_interrupt(layer) -> int:
     stop = signal.getsignal(signal.SIGTERM)
     signal.signal(signal.SIGTERM, lambda *_: layer.close())
@@ -165,6 +187,8 @@ def main(argv=None) -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
     config = _build_config(args)
+    if args.command == "import-pmml":
+        return cmd_import_pmml(config, args.pmml)
     return {
         "batch": cmd_batch,
         "speed": cmd_speed,

@@ -121,23 +121,25 @@ class ModelArtifact:
         ]
         for k, v in self.extensions.items():
             lines.append(f"  <Extension name={quoteattr(k)} value={quoteattr(str(v))}/>")
-        if self.app == "kmeans" and "clusters" in self.content:
-            clusters = self.content["clusters"]
-            n_feat = len(clusters[0]["center"]) if clusters else 0
+        if self.app == "kmeans" and "centers" in self.tensors:
+            centers = self.tensors["centers"]
+            counts = self.content.get("counts", [0] * len(centers))
+            n_feat = centers.shape[1] if len(centers) else 0
             lines.append(
                 f'  <ClusteringModel functionName="clustering" modelClass="centerBased" '
-                f'numberOfClusters="{len(clusters)}">'
+                f'numberOfClusters="{len(centers)}">'
             )
             lines.append(
                 '    <ComparisonMeasure kind="distance"><squaredEuclidean/></ComparisonMeasure>'
             )
-            lines.append(f"    <MiningSchema/>")
-            for c in clusters:
-                center = " ".join(str(x) for x in c["center"])
+            lines.append("    <MiningSchema/>")
+            ids = self.content.get("clusterIDs") or [str(i) for i in range(len(centers))]
+            for i, c in enumerate(centers):
+                center = " ".join(repr(float(x)) for x in c)
                 lines.append(
-                    f'    <Cluster id={quoteattr(str(c["id"]))} '
-                    f'size={quoteattr(str(c.get("count", 0)))}>'
-                    f"<Array n=\"{n_feat}\" type=\"real\">{escape(center)}</Array></Cluster>"
+                    f"    <Cluster id={quoteattr(str(ids[i]))} "
+                    f"size={quoteattr(str(int(counts[i])))}>"
+                    f'<Array n="{n_feat}" type="real">{escape(center)}</Array></Cluster>'
                 )
             lines.append("  </ClusteringModel>")
         lines.append("</PMML>")

@@ -55,11 +55,14 @@ def register(app: ServingApp) -> None:
     def console(a: ServingApp, req: Request):
         """Human status page (the reference serves an HTML console per app,
         e.g. .../als/Console.java): model state + the route table."""
+        import html as _html
+
         model = a.model_manager.get_model()
         frac = model.fraction_loaded() if model is not None else 0.0
-        manager = type(a.model_manager).__name__
+        manager = _html.escape(type(a.model_manager).__name__)
         rows = "".join(
-            f"<tr><td>{r.method}</td><td><code>{r.pattern.pattern}</code></td></tr>"
+            f"<tr><td>{_html.escape(r.method)}</td>"
+            f"<td><code>{_html.escape(r.pattern.pattern)}</code></td></tr>"
             for r in sorted(a.routes, key=lambda r: (r.pattern.pattern, r.method))
         )
         html = (

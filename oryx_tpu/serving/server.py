@@ -174,6 +174,7 @@ def _make_handler(app: ServingApp, auth: str | None):
             # compress sizable responses for clients that accept it (the
             # reference gzips csv/json via its Tomcat connector)
             accept_enc = self.headers.get("Accept-Encoding", "")
+            self.send_header("Vary", "Accept-Encoding")
             if "gzip" in accept_enc.lower() and len(payload) >= 1024:
                 payload = gzip.compress(payload, compresslevel=5)
                 self.send_header("Content-Encoding", "gzip")

@@ -1,0 +1,279 @@
+"""PMML import: reference-style documents -> artifacts + host evaluation.
+Covers the three model families the reference publishes (ALS skeleton with
+extensions, k-means ClusteringModel, RDF MiningModel of TreeModels) and the
+export/import round-trip for the native k-means artifact."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from oryx_tpu.common.artifact import ModelArtifact
+from oryx_tpu.common.pmml import PredicateForest, pmml_to_artifact
+
+ALS_SKELETON = """<?xml version="1.0" encoding="UTF-8"?>
+<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
+  <Header><Application name="Oryx"/></Header>
+  <Extension name="X" value="als/X/"/>
+  <Extension name="Y" value="als/Y/"/>
+  <Extension name="features" value="10"/>
+  <Extension name="implicit" value="true"/>
+  <Extension name="XIDs">u1 u2 u3</Extension>
+  <Extension name="YIDs">i1 i2</Extension>
+</PMML>"""
+
+KMEANS_PMML = """<?xml version="1.0" encoding="UTF-8"?>
+<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
+  <Header/>
+  <ClusteringModel functionName="clustering" modelClass="centerBased" numberOfClusters="2">
+    <ComparisonMeasure kind="distance"><squaredEuclidean/></ComparisonMeasure>
+    <MiningSchema/>
+    <Cluster id="0" size="5"><Array n="2" type="real">1.0 2.0</Array></Cluster>
+    <Cluster id="1" size="7"><Array n="2" type="real">-1.5 0.5</Array></Cluster>
+  </ClusteringModel>
+</PMML>"""
+
+# reference-shaped forest: numeric greaterThan split (positive child) with
+# an isNotIn categorical split below, score distributions at the leaves
+RDF_PMML = """<?xml version="1.0" encoding="UTF-8"?>
+<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
+  <Header/>
+  <MiningModel functionName="classification">
+    <MiningSchema/>
+    <Segmentation multipleModelMethod="weightedMajorityVote">
+      <Segment weight="1.0">
+        <True/>
+        <TreeModel functionName="classification">
+          <MiningSchema/>
+          <Node id="r">
+            <True/>
+            <Node id="r+" score="yes" recordCount="10">
+              <SimplePredicate field="age" operator="greaterThan" value="30"/>
+              <ScoreDistribution value="yes" recordCount="8"/>
+              <ScoreDistribution value="no" recordCount="2"/>
+            </Node>
+            <Node id="r-">
+              <SimpleSetPredicate field="color" booleanOperator="isNotIn">
+                <Array n="2" type="string">red blue</Array>
+              </SimpleSetPredicate>
+              <Node id="r-+" score="no" recordCount="4">
+                <SimplePredicate field="age" operator="lessOrEqual" value="10"/>
+                <ScoreDistribution value="no" recordCount="4"/>
+              </Node>
+              <Node id="r--" score="yes" recordCount="6">
+                <True/>
+                <ScoreDistribution value="yes" recordCount="5"/>
+                <ScoreDistribution value="no" recordCount="1"/>
+              </Node>
+            </Node>
+          </Node>
+        </TreeModel>
+      </Segment>
+      <Segment weight="2.0">
+        <True/>
+        <TreeModel functionName="classification">
+          <MiningSchema/>
+          <Node id="r" score="no" recordCount="20">
+            <True/>
+            <ScoreDistribution value="no" recordCount="15"/>
+            <ScoreDistribution value="yes" recordCount="5"/>
+          </Node>
+        </TreeModel>
+      </Segment>
+    </Segmentation>
+  </MiningModel>
+</PMML>"""
+
+
+def test_als_skeleton_import():
+    art = pmml_to_artifact(ALS_SKELETON)
+    assert art.app == "als"
+    assert art.extensions["features"] == "10"
+    assert art.extensions["X"] == "als/X/"
+    assert art.extensions["XIDs"] == ["u1", "u2", "u3"]
+    assert art.extensions["YIDs"] == ["i1", "i2"]
+
+
+def test_kmeans_import():
+    art = pmml_to_artifact(KMEANS_PMML)
+    assert art.app == "kmeans"
+    np.testing.assert_allclose(
+        art.tensors["centers"], [[1.0, 2.0], [-1.5, 0.5]]
+    )
+    assert art.content["counts"] == [5, 7]
+
+
+def test_kmeans_export_import_round_trip():
+    art = ModelArtifact(
+        "kmeans", tensors={"centers": np.asarray([[0.5, -1.0], [2.0, 3.0]], np.float32)}
+    )
+    art.content["counts"] = [3, 9]
+    back = pmml_to_artifact(art.to_pmml_xml())
+    np.testing.assert_allclose(back.tensors["centers"], art.tensors["centers"])
+    assert back.content["counts"] == [3, 9]
+
+
+def test_rdf_import_and_predict():
+    art = pmml_to_artifact(RDF_PMML)
+    assert art.app == "rdf-pmml"
+    forest = PredicateForest.from_artifact(art)
+    assert forest.is_classification and len(forest.trees) == 2
+
+    # age>30: tree1 leaf r+ dist {yes:.8,no:.2}; tree2 (w=2) {no:.75,yes:.25}
+    label, dist = forest.predict({"age": 40, "color": "red"})
+    expect_yes = 1.0 * 0.8 + 2.0 * 0.25
+    expect_no = 1.0 * 0.2 + 2.0 * 0.75
+    assert label == "no"
+    np.testing.assert_allclose(dist["no"], expect_no / (expect_yes + expect_no))
+
+    # age<=10 and color not in {red, blue}: tree1 -> r-+ (no)
+    label, dist = forest.predict({"age": 5, "color": "green"})
+    assert label == "no"
+
+    # age in (10, 30], color green -> r-- leaf {yes: 5/6}
+    label, dist = forest.predict({"age": 20, "color": "green"})
+    expect_yes = 1.0 * (5 / 6) + 2.0 * 0.25
+    expect_no = 1.0 * (1 / 6) + 2.0 * 0.75
+    assert dist["yes"] == pytest.approx(expect_yes / (expect_yes + expect_no))
+
+
+def test_rdf_regression_weighted_average():
+    xml = """<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3"><Header/>
+    <MiningModel functionName="regression"><MiningSchema/>
+      <Segmentation multipleModelMethod="weightedAverage">
+        <Segment weight="1.0"><True/>
+          <TreeModel functionName="regression"><MiningSchema/>
+            <Node id="r" score="10.0"><True/></Node>
+          </TreeModel></Segment>
+        <Segment weight="3.0"><True/>
+          <TreeModel functionName="regression"><MiningSchema/>
+            <Node id="r" score="20.0"><True/></Node>
+          </TreeModel></Segment>
+      </Segmentation>
+    </MiningModel></PMML>"""
+    forest = PredicateForest.from_artifact(pmml_to_artifact(xml))
+    assert forest.predict({}) == pytest.approx((10.0 + 3 * 20.0) / 4.0)
+
+
+def test_single_tree_model_import():
+    xml = """<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3"><Header/>
+    <TreeModel functionName="classification"><MiningSchema/>
+      <Node id="r" score="a"><True/></Node>
+    </TreeModel></PMML>"""
+    art = pmml_to_artifact(xml)
+    assert art.app == "rdf-pmml" and len(art.content["trees"]) == 1
+    label, _ = PredicateForest.from_artifact(art).predict({})
+    assert label == "a"
+
+
+def test_quoted_string_array_values():
+    xml = """<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3"><Header/>
+    <TreeModel functionName="classification"><MiningSchema/>
+      <Node id="r">
+        <True/>
+        <Node id="r+" score="hit">
+          <SimpleSetPredicate field="c" booleanOperator="isIn">
+            <Array n="2" type="string">"new york" boston</Array>
+          </SimpleSetPredicate>
+        </Node>
+        <Node id="r-" score="miss"><True/></Node>
+      </Node>
+    </TreeModel></PMML>"""
+    forest = PredicateForest.from_artifact(pmml_to_artifact(xml))
+    assert forest.predict({"c": "new york"})[0] == "hit"
+    assert forest.predict({"c": "chicago"})[0] == "miss"
+
+
+def test_cli_import_pmml_feeds_running_serving_model(tmp_path):
+    """Migration path end-to-end: reference k-means PMML -> import-pmml CLI
+    -> update topic -> the k-means serving manager loads it."""
+    from oryx_tpu import cli
+    from oryx_tpu.bus.broker import get_broker
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.apps.kmeans.serving import KMeansServingModelManager
+
+    pmml_file = tmp_path / "model.pmml.xml"
+    pmml_file.write_text(KMEANS_PMML)
+    sets = [
+        "oryx.input-topic.broker=mem://pmmlcli",
+        "oryx.update-topic.broker=mem://pmmlcli",
+    ]
+    flags = [x for s in sets for x in ("--set", s)]
+    assert cli.main(["setup", *flags]) == 0
+    assert cli.main(["import-pmml", "--pmml", str(pmml_file), *flags]) == 0
+
+    broker = get_broker("mem://pmmlcli")
+    recs = broker.read("OryxUpdate", 0, 0, 10)
+    assert recs and recs[-1][1] == "MODEL"
+
+    cfg = load_config(overlay={
+        "oryx.input-topic.broker": "mem://pmmlcli",
+        "oryx.update-topic.broker": "mem://pmmlcli",
+        "oryx.input-schema.num-features": 2,
+        "oryx.input-schema.numeric-features": ["0", "1"],
+    })
+    manager = KMeansServingModelManager(cfg)
+    manager.consume_key_message("MODEL", recs[-1][2])
+    model = manager.get_model()
+    assert model is not None
+    # point near the second imported center assigns to cluster 1
+    assert model.closest_cluster(np.asarray([-1.4, 0.4]))[0] == 1
+
+
+def test_rdf_serving_manager_consumes_imported_forest():
+    """Imported PMML forest must actually serve: MODEL -> predict ->
+    live UP node update shifts the distribution (node ids are the
+    reference's own path strings)."""
+    from oryx_tpu.apps.rdf.serving import PMMLForestServingModel, RDFServingModelManager
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.common.pmml import pmml_to_artifact
+    import json
+
+    cfg = load_config(overlay={
+        "oryx.input-topic.broker": "mem://pmmlrdf",
+        "oryx.update-topic.broker": "mem://pmmlrdf",
+        "oryx.input-schema.feature-names": ["age", "color", "label"],
+        "oryx.input-schema.numeric-features": ["age"],
+        "oryx.input-schema.categorical-features": ["color", "label"],
+        "oryx.input-schema.target-feature": "label",
+    })
+    manager = RDFServingModelManager(cfg)
+    art = pmml_to_artifact(RDF_PMML)
+    manager.consume_key_message("MODEL", art.to_string())
+    model = manager.get_model()
+    assert isinstance(model, PMMLForestServingModel)
+    label, dist = model.predict("40,red,")
+    assert label == "no" and set(dist) == {"yes", "no"}
+    assert model.classification_distribution("40,red,")["no"] == pytest.approx(
+        dist["no"]
+    )
+    # live update: flood tree 0 leaf r+ with 'yes' counts -> yes share rises
+    before = dist["yes"]
+    manager.consume_key_message("UP", json.dumps([0, "r+", {"yes": 1000}]))
+    _, dist2 = model.predict("40,red,")
+    assert dist2["yes"] > before
+
+
+def test_unsupported_predicate_rejected_at_import():
+    xml = """<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3"><Header/>
+    <TreeModel functionName="classification"><MiningSchema/>
+      <Node id="r">
+        <True/>
+        <Node id="r+" score="a">
+          <CompoundPredicate booleanOperator="and">
+            <SimplePredicate field="x" operator="greaterThan" value="1"/>
+            <SimplePredicate field="x" operator="lessThan" value="5"/>
+          </CompoundPredicate>
+        </Node>
+        <Node id="r-" score="b"><True/></Node>
+      </Node>
+    </TreeModel></PMML>"""
+    with pytest.raises(ValueError, match="CompoundPredicate"):
+        pmml_to_artifact(xml)
+
+
+def test_rejects_non_pmml():
+    with pytest.raises(ValueError):
+        pmml_to_artifact("<NotPMML/>")
+    with pytest.raises(ValueError):
+        pmml_to_artifact('<PMML xmlns="http://www.dmg.org/PMML-4_3"><Header/></PMML>')
