@@ -218,5 +218,22 @@ class RDFModel:
         return [float(v) for v in self.forest.feature_importances]
 
 
+def tokens_to_features(schema: InputSchema, tokens: list[str]) -> tuple[dict, str | None]:
+    """CSV tokens -> ({feature name: raw token}, target token or None) for
+    predicate-tree evaluation of imported PMML forests. Inactive/target/
+    empty fields are omitted from the feature dict."""
+    names = schema.feature_names
+    features: dict = {}
+    target: str | None = None
+    for i, tok in enumerate(tokens):
+        if i >= len(names):
+            break
+        if schema.is_target(i):
+            target = tok if tok != "" else None
+        elif schema.is_active(i) and tok != "":
+            features[names[i]] = tok
+    return features, target
+
+
 def node_id(slot: int) -> str:
     return heap_to_node_id(slot)

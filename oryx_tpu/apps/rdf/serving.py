@@ -66,17 +66,10 @@ class PMMLForestServingModel(ServingModel):
 
     def _features(self, datum: str) -> dict:
         from oryx_tpu.common.text import parse_input_line
+        from oryx_tpu.apps.rdf.common import tokens_to_features
 
-        tokens = parse_input_line(datum)
-        names = self.schema.feature_names
-        out = {}
-        for i, tok in enumerate(tokens):
-            if i >= len(names):
-                break
-            name = names[i]
-            if self.schema.is_active(i) and not self.schema.is_target(i) and tok != "":
-                out[name] = tok
-        return out
+        features, _ = tokens_to_features(self.schema, parse_input_line(datum))
+        return features
 
     def predict(self, datum: str):
         result = self.forest.predict(self._features(datum))

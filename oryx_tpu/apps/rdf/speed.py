@@ -5,10 +5,12 @@ RDFSpeedModelManager.java:68-148): "UP" is ignored (hearing our own
 updates), MODEL(-REF) replaces the local forest, and build_updates routes
 every example down every tree — one vectorized [T,N] routing pass instead
 of the reference's per-example flatMap — groups targets by (tree,
-terminal node), and emits
+terminal node), and emits ("UP", message) pairs whose JSON payloads are
+byte-compatible with the reference wire format:
   classification: [treeID, nodeID, {targetEncoding: count}]
   regression:     [treeID, nodeID, mean, count]
-JSON messages, byte-compatible with the reference wire format.
+(imported PMML forests emit label-keyed counts instead — the key space
+their serving counterpart folds by).
 """
 
 from __future__ import annotations
@@ -34,22 +36,66 @@ class RDFSpeedModelManager(AbstractSpeedModelManager):
         self.config = config
         self.schema = InputSchema(config)
         self.model: RDFModel | None = None
+        self.pmml_forest = None  # imported reference forest (common/pmml.py)
 
     def consume_key_message(self, key: str | None, message: str) -> None:
         if key == "UP":
             return  # hearing our own updates
         if key in ("MODEL", "MODEL-REF"):
             art = read_artifact_from_update(key, message)
-            self.model = artifact_to_model(art, self.schema)
-            log.info(
-                "new model loaded: %d trees, depth %d",
-                self.model.forest.num_trees,
-                self.model.forest.max_depth,
-            )
+            if art.app == "rdf-pmml":
+                from oryx_tpu.common.pmml import PredicateForest
+
+                self.pmml_forest = PredicateForest.from_artifact(art)
+                self.model = None
+                log.info(
+                    "imported PMML model loaded: %d trees", len(self.pmml_forest.trees)
+                )
+            else:
+                self.model = artifact_to_model(art, self.schema)
+                self.pmml_forest = None
+                log.info(
+                    "new model loaded: %d trees, depth %d",
+                    self.model.forest.num_trees,
+                    self.model.forest.max_depth,
+                )
         else:
             raise ValueError(f"bad key: {key}")
 
+    def _build_updates_pmml(self, new_data):
+        """Route each example through the imported predicate forest and emit
+        label-keyed per-(tree, node) stats — the key space its serving-side
+        counterpart (PMMLForestServingModel) folds by."""
+        from oryx_tpu.apps.rdf.common import tokens_to_features
+
+        forest = self.pmml_forest
+        stats: dict[tuple[int, str], list] = {}
+        for km in new_data:
+            try:
+                tokens = parse_input_line(km.message)
+            except ValueError:
+                continue
+            features, target = tokens_to_features(self.schema, tokens)
+            if target is None:
+                continue
+            for t, nid in enumerate(forest.terminal_ids(features)):
+                if nid is not None:
+                    stats.setdefault((t, nid), []).append(target)
+        out = []
+        for (t, nid), targets in sorted(stats.items()):
+            if forest.is_classification:
+                counts: dict[str, int] = {}
+                for v in targets:
+                    counts[v] = counts.get(v, 0) + 1
+                out.append(("UP", json.dumps([t, nid, counts])))
+            else:
+                values = np.asarray([float(v) for v in targets])
+                out.append(("UP", json.dumps([t, nid, float(np.mean(values)), len(values)])))
+        return out
+
     def build_updates(self, new_data):
+        if self.pmml_forest is not None:
+            return self._build_updates_pmml(new_data)
         model = self.model
         if model is None:
             return []
@@ -78,11 +124,14 @@ class RDFSpeedModelManager(AbstractSpeedModelManager):
                 if classification:
                     codes, counts = np.unique(targets.astype(np.int64), return_counts=True)
                     payload = {str(int(c)): int(n) for c, n in zip(codes, counts)}
-                    out.append(json.dumps([t, nid, payload]))
+                    out.append(("UP", json.dumps([t, nid, payload])))
                 else:
                     out.append(
-                        json.dumps(
-                            [t, nid, float(np.mean(targets)), int(len(targets))]
+                        (
+                            "UP",
+                            json.dumps(
+                                [t, nid, float(np.mean(targets)), int(len(targets))]
+                            ),
                         )
                     )
         return out

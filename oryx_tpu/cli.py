@@ -21,6 +21,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import pathlib
 import signal
 import sys
 import time
@@ -139,7 +140,21 @@ def cmd_import_pmml(config: Config, pmml_path: str | None = None) -> int:
     with open(pmml_path, encoding="utf-8") as f:
         art = pmml_to_artifact(f.read())
     uri, topic, _ = _topic_pairs(config)[1]
-    get_broker(uri).send(topic, "MODEL", art.to_string())
+    broker = get_broker(uri)
+    serialized = art.to_string()
+    max_size = config.get_int("oryx.update-topic.message.max-size", 16 * 1024 * 1024)
+    if len(serialized.encode("utf-8")) <= max_size:
+        broker.send(topic, "MODEL", serialized)
+    else:
+        # same inline-vs-reference cutover as MLUpdate.publish_model
+        # (MLUpdate.java:212-231): oversized models go to the model store
+        # and only the path rides the topic
+        from oryx_tpu.common.ioutil import strip_scheme
+
+        model_dir = strip_scheme(config.get_string("oryx.batch.storage.model-dir"))
+        dest = pathlib.Path(model_dir) / f"imported-{int(time.time() * 1000)}"
+        art.write(dest)
+        broker.send(topic, "MODEL-REF", str(dest))
     print(f"imported {art.app} model from {pmml_path} -> {topic}", file=sys.stderr)
     return 0
 

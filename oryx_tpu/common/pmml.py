@@ -17,6 +17,7 @@ produce the native vectorized forest instead).
 
 from __future__ import annotations
 
+import threading
 import xml.etree.ElementTree as ET
 from dataclasses import dataclass, field
 
@@ -223,6 +224,10 @@ class PredicateForest:
     trees: list[dict]
     weights: list[float]
     is_classification: bool = True
+    # guards node-dict mutation (UP folding from the bus listener thread)
+    # against concurrent predict traversals from HTTP request threads —
+    # the native RDFModel keeps the same discipline with its own lock
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     @classmethod
     def from_artifact(cls, art: ModelArtifact) -> "PredicateForest":
@@ -267,8 +272,19 @@ class PredicateForest:
                     node = child["node"]
                     break
             else:
-                return node  # no child matched: treat as terminal
+                # nothing matched (e.g. a missing feature fails both the
+                # positive predicate and its complement): descend into the
+                # last child — the reference's negative/default branch
+                # (RDFUpdate.java writes positive first, negative second) —
+                # so every datum still reaches a leaf
+                node = node["children"][-1]["node"]
         return node
+
+    def terminal_ids(self, features: dict) -> list[str]:
+        """Terminal node id per tree — the speed tier's routing pass
+        (RDFSpeedModelManager groups micro-batch targets by (tree, node))."""
+        with self._lock:
+            return [self._terminal(t, features).get("id") for t in self.trees]
 
     def _find_node(self, tree_idx: int, node_id: str) -> dict | None:
         stack = [self.trees[tree_idx]]
@@ -288,14 +304,15 @@ class PredicateForest:
         node = self._find_node(tree_idx, node_id)
         if node is None:
             return
-        dist = node.setdefault("distribution", [])
-        by_value = {d["value"]: d for d in dist}
-        for value, count in counts.items():
-            entry = by_value.get(str(value))
-            if entry is None:
-                dist.append({"value": str(value), "recordCount": float(count)})
-            else:
-                entry["recordCount"] += float(count)
+        with self._lock:
+            dist = node.setdefault("distribution", [])
+            by_value = {d["value"]: d for d in dist}
+            for value, count in counts.items():
+                entry = by_value.get(str(value))
+                if entry is None:
+                    dist.append({"value": str(value), "recordCount": float(count)})
+                else:
+                    entry["recordCount"] += float(count)
 
     def update_regression_leaf(self, tree_idx: int, node_id: str, mean: float, count: int) -> None:
         """Running-mean fold of a (mean, count) summary into the node score
@@ -303,16 +320,21 @@ class PredicateForest:
         node = self._find_node(tree_idx, node_id)
         if node is None:
             return
-        old_count = float(node.get("recordCount", 0.0))
-        old_score = float(node.get("score", 0.0) or 0.0)
-        total = old_count + count
-        if total <= 0:
-            return
-        node["score"] = str((old_score * old_count + mean * count) / total)
-        node["recordCount"] = total
+        with self._lock:
+            old_count = float(node.get("recordCount", 0.0))
+            old_score = float(node.get("score", 0.0) or 0.0)
+            total = old_count + count
+            if total <= 0:
+                return
+            node["score"] = str((old_score * old_count + mean * count) / total)
+            node["recordCount"] = total
 
     def predict(self, features: dict):
         """Classification: (label, distribution dict). Regression: float."""
+        with self._lock:
+            return self._predict_locked(features)
+
+    def _predict_locked(self, features: dict):
         if self.is_classification:
             votes: dict[str, float] = {}
             for tree, w in zip(self.trees, self.weights):

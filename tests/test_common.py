@@ -211,10 +211,16 @@ def test_artifact_disk_roundtrip(tmp_path):
 
 
 def test_artifact_string_roundtrip_and_update_decode(tmp_path):
-    art = ModelArtifact("kmeans", content={"clusters": [{"id": 0, "center": [1.0, 2.0], "count": 3}]})
+    # the real kmeans artifact shape: centers tensor + counts content
+    art = ModelArtifact(
+        "kmeans",
+        content={"counts": [3]},
+        tensors={"centers": np.asarray([[1.0, 2.0]], dtype=np.float32)},
+    )
     s = art.to_string()
     back = read_artifact_from_update("MODEL", s)
-    assert back.content["clusters"][0]["center"] == [1.0, 2.0]
+    assert back.content["counts"] == [3]
+    np.testing.assert_allclose(back.tensors["centers"], [[1.0, 2.0]])
     p = art.write(tmp_path / "m2")
     back2 = read_artifact_from_update("MODEL-REF", str(p))
     assert back2.app == "kmeans"

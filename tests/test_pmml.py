@@ -272,6 +272,92 @@ def test_unsupported_predicate_rejected_at_import():
         pmml_to_artifact(xml)
 
 
+def _rdf_schema_cfg(bus: str):
+    from oryx_tpu.common.config import load_config
+
+    return load_config(overlay={
+        "oryx.input-topic.broker": bus,
+        "oryx.update-topic.broker": bus,
+        "oryx.input-schema.feature-names": ["age", "color", "label"],
+        "oryx.input-schema.numeric-features": ["age"],
+        "oryx.input-schema.categorical-features": ["color", "label"],
+        "oryx.input-schema.target-feature": "label",
+    })
+
+
+def test_speed_manager_consumes_imported_forest_and_emits_label_counts():
+    """The whole migration loop: speed tier loads the imported forest,
+    routes a micro-batch by predicate, emits label-keyed (tree, node)
+    stats, and the serving tier folds them."""
+    import json
+    from oryx_tpu.apps.rdf.speed import RDFSpeedModelManager
+    from oryx_tpu.apps.rdf.serving import RDFServingModelManager
+    from oryx_tpu.common.pmml import pmml_to_artifact
+
+    class KM:
+        def __init__(self, message):
+            self.key, self.message = None, message
+
+    art = pmml_to_artifact(RDF_PMML)
+    speed = RDFSpeedModelManager(_rdf_schema_cfg("mem://pmmlspeed"))
+    speed.consume_key_message("MODEL", art.to_string())
+    assert speed.pmml_forest is not None
+
+    updates = speed.build_updates(
+        [KM("40,red,yes"), KM("45,blue,yes"), KM("40,red,no"), KM("5,green,no")]
+    )
+    assert all(key == "UP" for key, _ in updates)
+    parsed = [json.loads(u) for _, u in updates]
+    # both age>30 examples land in tree-0 node r+; labels are strings
+    by_node = {(t, n): counts for t, n, counts in parsed}
+    assert by_node[(0, "r+")] == {"yes": 2, "no": 1}
+    assert by_node[(0, "r-+")] == {"no": 1}
+
+    serving = RDFServingModelManager(_rdf_schema_cfg("mem://pmmlspeed"))
+    serving.consume_key_message("MODEL", art.to_string())
+    before = serving.get_model().predict("40,red,")[1]["yes"]
+    for _, u in updates:
+        serving.consume_key_message("UP", u)
+    after = serving.get_model().predict("40,red,")[1]["yes"]
+    assert after != before  # folded
+
+
+def test_missing_feature_descends_default_branch():
+    """A datum whose split feature is empty must still reach a leaf (the
+    reference's evaluator always descends; last child = negative branch)."""
+    from oryx_tpu.common.pmml import PredicateForest
+
+    forest = PredicateForest.from_artifact(pmml_to_artifact(RDF_PMML))
+    # age missing: root's children (greaterThan / isNotIn with color red)
+    # -> falls to r- subtree, then age<=10 false -> default r--
+    label, dist = forest.predict({"color": "red"})
+    assert label in ("yes", "no") and dist
+
+
+def test_import_pmml_oversized_model_uses_model_ref(tmp_path):
+    from oryx_tpu import cli
+    from oryx_tpu.bus.broker import get_broker
+    from oryx_tpu.common.artifact import read_artifact_from_update
+
+    pmml_file = tmp_path / "model.pmml.xml"
+    pmml_file.write_text(KMEANS_PMML)
+    sets = [
+        "oryx.input-topic.broker=mem://pmmlref",
+        "oryx.update-topic.broker=mem://pmmlref",
+        f"oryx.batch.storage.model-dir={tmp_path}/models",
+        "oryx.update-topic.message.max-size=64",  # force the REF path
+    ]
+    flags = [x for s in sets for x in ("--set", s)]
+    assert cli.main(["setup", *flags]) == 0
+    assert cli.main(["import-pmml", "--pmml", str(pmml_file), *flags]) == 0
+    recs = get_broker("mem://pmmlref").read("OryxUpdate", 0, 0, 10)
+    key, message = recs[-1][1], recs[-1][2]
+    assert key == "MODEL-REF"
+    art = read_artifact_from_update(key, message)
+    assert art.app == "kmeans"
+    np.testing.assert_allclose(art.tensors["centers"][0], [1.0, 2.0])
+
+
 def test_rejects_non_pmml():
     with pytest.raises(ValueError):
         pmml_to_artifact("<NotPMML/>")

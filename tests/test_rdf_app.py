@@ -120,12 +120,13 @@ def test_speed_manager_emits_terminal_node_stats():
     mgr.consume_key_message("MODEL", art.to_string())
     ups = mgr.build_updates([KeyMessage(None, "0.9,red,apple")] * 5)
     assert len(ups) == 8  # one terminal node per tree
-    for u in ups:
+    for key, u in ups:
+        assert key == "UP"  # SpeedLayer publishes (key, message) pairs
         tree, node_id, counts = json.loads(u)
         assert 0 <= tree < 8
         assert node_id.startswith("r") and set(node_id[1:]) <= {"-", "+"}
         assert sum(counts.values()) == 5
-    mgr.consume_key_message("UP", ups[0])  # ignored, no error
+    mgr.consume_key_message("UP", ups[0][1])  # ignored, no error
 
 
 def test_serving_applies_leaf_updates():
@@ -141,7 +142,7 @@ def test_serving_applies_leaf_updates():
     banana_code = model.rdf.encodings.encode(2, "banana")
     speed = RDFSpeedModelManager(cfg)
     speed.consume_key_message("MODEL", art.to_string())
-    for u in speed.build_updates(
+    for _, u in speed.build_updates(
         [KeyMessage(None, "0.9,red,banana")] * 500
     ):
         mgr.consume_key_message("UP", u)
