@@ -38,5 +38,5 @@ class ExampleSpeedModelManager(AbstractSpeedModelManager):
             for word, count in counts.items():
                 new_count = count + self._words.get(word, 0)
                 self._words[word] = new_count
-                out.append(f"{word},{new_count}")
+                out.append(("UP", f"{word},{new_count}"))
         return out

@@ -62,13 +62,12 @@ class RDFSpeedModelManager(AbstractSpeedModelManager):
         else:
             raise ValueError(f"bad key: {key}")
 
-    def _build_updates_pmml(self, new_data):
+    def _build_updates_pmml(self, forest, new_data):
         """Route each example through the imported predicate forest and emit
         label-keyed per-(tree, node) stats — the key space its serving-side
         counterpart (PMMLForestServingModel) folds by."""
         from oryx_tpu.apps.rdf.common import tokens_to_features
 
-        forest = self.pmml_forest
         stats: dict[tuple[int, str], list] = {}
         for km in new_data:
             try:
@@ -89,13 +88,26 @@ class RDFSpeedModelManager(AbstractSpeedModelManager):
                     counts[v] = counts.get(v, 0) + 1
                 out.append(("UP", json.dumps([t, nid, counts])))
             else:
-                values = np.asarray([float(v) for v in targets])
-                out.append(("UP", json.dumps([t, nid, float(np.mean(values)), len(values)])))
+                # tolerate unparseable targets like the native path's
+                # NaN-drop (keep = ~np.isnan(y)) — one bad record must not
+                # poison the micro-batch retry loop
+                values = []
+                for v in targets:
+                    try:
+                        values.append(float(v))
+                    except ValueError:
+                        continue
+                if values:
+                    out.append(
+                        ("UP", json.dumps([t, nid, float(np.mean(values)), len(values)]))
+                    )
         return out
 
     def build_updates(self, new_data):
-        if self.pmml_forest is not None:
-            return self._build_updates_pmml(new_data)
+        # snapshot both models once: the update-listener thread swaps them
+        pmml_forest = self.pmml_forest
+        if pmml_forest is not None:
+            return self._build_updates_pmml(pmml_forest, new_data)
         model = self.model
         if model is None:
             return []

@@ -301,10 +301,10 @@ class PredicateForest:
         score distribution (RDFServingModelManager.java:57-84 — PMML node
         ids are the reference's own +/- path strings, so live updates keep
         working against an imported forest)."""
-        node = self._find_node(tree_idx, node_id)
-        if node is None:
-            return
         with self._lock:
+            node = self._find_node(tree_idx, node_id)
+            if node is None:
+                return
             dist = node.setdefault("distribution", [])
             by_value = {d["value"]: d for d in dist}
             for value, count in counts.items():
@@ -317,10 +317,10 @@ class PredicateForest:
     def update_regression_leaf(self, tree_idx: int, node_id: str, mean: float, count: int) -> None:
         """Running-mean fold of a (mean, count) summary into the node score
         (NumericPrediction.update semantics)."""
-        node = self._find_node(tree_idx, node_id)
-        if node is None:
-            return
         with self._lock:
+            node = self._find_node(tree_idx, node_id)
+            if node is None:
+                return
             old_count = float(node.get("recordCount", 0.0))
             old_score = float(node.get("score", 0.0) or 0.0)
             total = old_count + count

@@ -64,9 +64,9 @@ def test_speed_manager_accumulates_deltas():
     mgr = ExampleSpeedModelManager()
     mgr.consume_key_message("MODEL", json.dumps({"a": 5}))
     ups = set(mgr.build_updates([KeyMessage(None, "a b")]))
-    assert ups == {"a,6", "b,1"}
+    assert ups == {("UP", "a,6"), ("UP", "b,1")}
     mgr.consume_key_message("UP", "a,6")  # ignored
-    assert set(mgr.build_updates([KeyMessage(None, "a c")])) == {"a,7", "c,1"}
+    assert set(mgr.build_updates([KeyMessage(None, "a c")])) == {("UP", "a,7"), ("UP", "c,1")}
 
 
 def _http(method, url, body=None):
@@ -131,9 +131,9 @@ def test_wordcount_end_to_end(tmp_path):
         time.sleep(0.1)
     assert speed.manager._words.get("cat") == 2
     ups = speed.manager.build_updates([KeyMessage(None, "cat bird")])
-    assert set(ups) == {"cat,3", "bird,1"}
-    for u in ups:
-        broker.send("OryxUpdate", "UP", u)
+    assert set(ups) == {("UP", "cat,3"), ("UP", "bird,1")}
+    for key, u in ups:
+        broker.send("OryxUpdate", key, u)
     deadline = time.time() + 20
     while time.time() < deadline:
         status, body = _http("GET", f"{base}/distinct/bird")
