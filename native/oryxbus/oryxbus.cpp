@@ -13,6 +13,7 @@
 
 #include <cerrno>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <fcntl.h>
 #include <sys/file.h>
@@ -131,6 +132,119 @@ int64_t oryxbus_scan(const char* path, int64_t start_pos, int64_t* positions,
   flock(fd, LOCK_UN);
   close(fd);
   return count;
+}
+
+// ---------------------------------------------------------------------------
+// Native data loader: CSV interaction parsing.
+//
+// Parses newline-separated "user,item[,value[,timestamp]]" lines (the ALS
+// input wire format) straight into typed arrays — no Python object per
+// record. Caller allocates arrays sized for the line count. Per line:
+//   users/items: int64, valid only when the token is a CANONICAL decimal
+//     integer (no leading zeros/plus/space — "07" and "7" are distinct ids
+//     and must not merge), so ok=0 routes the batch to the string fallback
+//   value: double; empty field = NaN (the delete marker), missing = 1.0
+//   ts:    int64 from a double token (Python-side does int(float(tok)));
+//          empty/missing = 0
+//   ok:    1 parsed, 0 needs the Python fallback (JSON-array form, quotes,
+//          non-canonical ids, malformed numbers)
+// Blank lines emit no row. Returns rows written.
+
+static inline bool parse_canonical_i64(const char* s, const char* end,
+                                       int64_t* out) {
+  if (s >= end) return false;
+  bool neg = *s == '-';
+  if (neg) s++;
+  if (s >= end) return false;
+  if (*s == '0' && end - s > 1) return false;  // leading zero
+  int64_t v = 0;
+  int digits = 0;
+  for (; s < end; s++, digits++) {
+    if (*s < '0' || *s > '9') return false;
+    if (digits >= 18) return false;  // overflow guard
+    v = v * 10 + (*s - '0');
+  }
+  if (digits == 0) return false;
+  if (neg && v == 0) return false;  // "-0" is non-canonical
+  *out = neg ? -v : v;
+  return true;
+}
+
+static inline bool parse_f64(const char* s, const char* end, double* out) {
+  if (s >= end) return false;
+  char tmp[64];
+  size_t n = static_cast<size_t>(end - s);
+  if (n >= sizeof(tmp)) return false;
+  memcpy(tmp, s, n);
+  tmp[n] = '\0';
+  char* ep = nullptr;
+  *out = strtod(tmp, &ep);
+  return ep == tmp + n;
+}
+
+int64_t oryxbus_parse_interactions(const char* buf, int64_t len,
+                                   int64_t* users, int64_t* items,
+                                   double* vals, int64_t* tss, uint8_t* ok,
+                                   int64_t max_rows) {
+  int64_t row = 0;
+  const char* p = buf;
+  const char* bend = buf + len;
+  while (p < bend && row < max_rows) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', bend - p));
+    const char* lend = nl ? nl : bend;
+    // trim \r and surrounding spaces
+    const char* ls = p;
+    while (ls < lend && (*ls == ' ' || *ls == '\t')) ls++;
+    const char* le = lend;
+    while (le > ls && (le[-1] == '\r' || le[-1] == ' ' || le[-1] == '\t')) le--;
+    p = nl ? nl + 1 : bend;
+    if (ls == le) continue;  // blank line: no row
+
+    uint8_t good = 1;
+    int64_t u = 0, it = 0, t = 0;
+    double v = 1.0;
+    if (*ls == '[' || memchr(ls, '"', le - ls) != nullptr) {
+      good = 0;  // JSON-array or quoted CSV: Python fallback
+    } else {
+      const char* fields[4];
+      const char* fends[4];
+      int nf = 0;
+      const char* fs = ls;
+      for (const char* c = ls; c <= le && nf < 4; c++) {
+        if (c == le || *c == ',') {
+          fields[nf] = fs;
+          fends[nf] = c;
+          nf++;
+          fs = c + 1;
+        }
+      }
+      if (nf < 2) {
+        good = 0;
+      } else {
+        if (!parse_canonical_i64(fields[0], fends[0], &u)) good = 0;
+        if (good && !parse_canonical_i64(fields[1], fends[1], &it)) good = 0;
+        if (good && nf > 2) {
+          if (fields[2] == fends[2]) {
+            v = __builtin_nan("");  // empty strength = delete marker
+          } else if (!parse_f64(fields[2], fends[2], &v)) {
+            good = 0;
+          }
+        }
+        if (good && nf > 3 && fields[3] != fends[3]) {
+          double td;
+          if (!parse_f64(fields[3], fends[3], &td)) good = 0;
+          else t = static_cast<int64_t>(td);
+        }
+      }
+    }
+    users[row] = u;
+    items[row] = it;
+    vals[row] = v;
+    tss[row] = t;
+    ok[row] = good;
+    row++;
+  }
+  return row;
 }
 
 }  // extern "C"

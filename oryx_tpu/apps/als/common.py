@@ -52,10 +52,40 @@ class ALSConfig:
         )
 
 
+def _native_loader():
+    try:
+        from oryx_tpu.bus.native import NativeAppender
+
+        return NativeAppender.load()
+    except (FileNotFoundError, OSError, AttributeError):
+        return None
+
+
 def parse_events(data) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """KeyMessages -> (users, items, values, timestamps) arrays. Bad lines
     are skipped. Empty/absent strength = 1.0; empty-string with a 'delete'
-    convention arrives as NaN from the /pref DELETE path."""
+    convention arrives as NaN from the /pref DELETE path.
+
+    Hot path: when the native data loader (native/oryxbus) is built and
+    every line is plain CSV with canonical-integer ids, the whole batch
+    parses in C with no Python object per record (users/items come back as
+    int64 arrays; aggregate_interactions factorizes those without string
+    round-trips). Any line the loader can't take verbatim falls the whole
+    batch back to the Python parser, so semantics never fork."""
+    native = _native_loader()
+    if native is not None:
+        lines = [
+            km.message if isinstance(km, KeyMessage) else str(km) for km in data
+        ]
+        if lines:
+            u, i, v, t, ok = native.parse_interactions(
+                ("\n".join(lines)).encode("utf-8")
+            )
+            # row count must match the message count exactly (catches blank
+            # messages and embedded newlines) and every row must be clean
+            if len(ok) == len(lines) and bool(ok.all()):
+                return u, i, v, t
+
     users, items, vals, tss = [], [], [], []
     for km in data:
         line = km.message if isinstance(km, KeyMessage) else str(km)

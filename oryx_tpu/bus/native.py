@@ -56,6 +56,17 @@ class NativeAppender:
             ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.oryxbus_parse_interactions.restype = ctypes.c_int64
+        lib.oryxbus_parse_interactions.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64,
+        ]
 
     @classmethod
     def load(cls) -> "NativeAppender":
@@ -106,3 +117,30 @@ class NativeAppender:
             if max_records is not None or n < cap:
                 break
         return np.asarray(positions, dtype=np.int64), pos
+
+    def parse_interactions(
+        self, buf: bytes
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Native CSV data loader: newline-separated "user,item[,value[,ts]]"
+        bytes -> (users i64, items i64, values f64, timestamps i64, ok u8)
+        with no Python object per record. ok=0 rows need the Python parser
+        (JSON-array lines, quoted CSV, non-canonical integer ids)."""
+        cap = buf.count(b"\n") + 1
+        users = np.empty(cap, dtype=np.int64)
+        items = np.empty(cap, dtype=np.int64)
+        vals = np.empty(cap, dtype=np.float64)
+        tss = np.empty(cap, dtype=np.int64)
+        ok = np.empty(cap, dtype=np.uint8)
+        n = self._lib.oryxbus_parse_interactions(
+            buf,
+            len(buf),
+            users.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            items.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            tss.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            cap,
+        )
+        if n < 0:
+            raise OSError(-n, "oryxbus_parse_interactions failed")
+        return users[:n], items[:n], vals[:n], tss[:n], ok[:n]

@@ -139,20 +139,29 @@ def _factorize_string_ids(arr: np.ndarray) -> tuple[list[str], np.ndarray]:
     integers (the common case: MovieLens et al.) take an O(n) bincount
     factorization instead; anything else falls back to np.unique."""
     arr = np.asarray(arr)
-    if arr.dtype.kind != "U":
-        arr = arr.astype(str)
     if arr.size == 0:
         return [], np.zeros(0, dtype=np.int64)
-    try:
+    if arr.dtype.kind in "iu":
+        # already integer ids (e.g. from the native data loader, which only
+        # accepts canonical decimal tokens) — no string checks needed
         nums = arr.astype(np.int64)
-    except (ValueError, OverflowError):
-        nums = None
-    if nums is not None and np.abs(nums).max() < 10**17:
-        # canonical form check by exact digit count: rejects "07", "+7",
-        # " 7", "-0" — any string astype(int) accepts but str() won't emit
-        a = np.abs(nums)
-        canon_len = np.searchsorted(_POW10, a, side="right") + 1 + (nums < 0)
-        if bool((np.char.str_len(arr) == canon_len).all()):
+        canonical = True
+    else:
+        if arr.dtype.kind != "U":
+            arr = arr.astype(str)
+        try:
+            nums = arr.astype(np.int64)
+        except (ValueError, OverflowError):
+            nums = None
+        canonical = False
+        if nums is not None and np.abs(nums).max() < 10**17:
+            # canonical form check by exact digit count: rejects "07", "+7",
+            # " 7", "-0" — strings astype(int) accepts but str() won't emit
+            a = np.abs(nums)
+            canon_len = np.searchsorted(_POW10, a, side="right") + 1 + (nums < 0)
+            canonical = bool((np.char.str_len(arr) == canon_len).all())
+    if nums is not None and canonical:
+        if True:
             lo = int(nums.min())
             span = int(nums.max()) - lo + 1
             if span <= max(4 * len(nums), 1 << 28):
