@@ -6,44 +6,59 @@ x 1M-item model, measure requests/sec of top-10 recommend). Reference best
 case from docs/docs/performance.html: 437 qps at 50 features x 1M items
 WITH LSH (sampleRate 0.3, 32-core Xeon); vs_baseline = measured qps / 437.
 
-Each request is exact top-10 over ALL 1M items (no LSH approximation): the
-serving tier micro-batches concurrent requests into one [B,K]x[K,I] bf16
-matmul + lax.top_k on device. Timing includes the device->host result
-transfer each round. The comparison is conservative: exact retrieval vs
-the reference's approximate (LSH 0.3) best case.
+Resilience (round-1 lesson): the real-TPU transport on the bench host can
+wedge hard enough that jax.devices() hangs forever in C code — recovery is
+impossible in-process. So the orchestration here never imports jax itself:
+it probes the backend in a killable subprocess (bounded time, retried),
+runs the measured body in a subprocess, and falls back to a forced-CPU run
+if the accelerator is unusable. The ONE JSON line is printed on every path,
+carrying an "error" field when degraded.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import signal
+import subprocess
 import sys
+import tempfile
 import time
 
-import numpy as np
-
-# Serving micro-batch window (concurrent requests per dispatch). 4096 is
-# the measured throughput knee: larger windows add latency linearly with no
-# qps gain, smaller ones leave the device idle between host round-trips.
-# Round latency at 4096 is ~90ms — inside the reference's own published
-# worst-case (134ms at 250 features x 20M items, BASELINE.md).
-BATCH = 4096
+BASELINE_QPS = 437.0  # reference best case, BASELINE.md
+HERE = os.path.dirname(os.path.abspath(__file__))
 
 
-def main() -> None:
+# --------------------------------------------------------------------------
+# measured body — runs in a subprocess
+# --------------------------------------------------------------------------
+
+def _bench_body() -> None:
+    import numpy as np
     import jax
     import jax.numpy as jnp
 
     from oryx_tpu.ops.als import topk_dot_batch
 
-    n_items, features, k = 1_000_000, 50, 10
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+
+    # Serving micro-batch window (concurrent requests per dispatch). 4096 is
+    # the measured throughput knee on TPU: larger windows add latency
+    # linearly with no qps gain, smaller ones leave the device idle between
+    # host round-trips. The CPU fallback shrinks the problem so the harness
+    # still completes and emits a number.
+    batch = 4096 if on_accel else 256
+    n_items, features, k = (1_000_000, 50, 10) if on_accel else (100_000, 50, 10)
+
     rng = np.random.default_rng(42)
     y = jnp.asarray(
         rng.standard_normal((n_items, features), dtype=np.float32), dtype=jnp.bfloat16
     )
     users = jnp.asarray(
-        rng.standard_normal((BATCH, features), dtype=np.float32), dtype=jnp.bfloat16
+        rng.standard_normal((batch, features), dtype=np.float32), dtype=jnp.bfloat16
     )
     y, users = jax.block_until_ready((y, users))
 
@@ -52,25 +67,26 @@ def main() -> None:
     # streams back to the host (hides host-link latency, as a real server
     # overlapping response rendering with device compute would)
     n, t0, pending, rounds = 0, time.perf_counter(), None, 0
+    budget = 5.0 if on_accel else 3.0
     while True:
         vals, idx = topk_dot_batch(users, y, k=k)
         idx.copy_to_host_async()
         rounds += 1
         if pending is not None:
             np.asarray(pending)  # materialize like a response render
-            n += BATCH
+            n += batch
         pending = idx
         dt = time.perf_counter() - t0
-        if dt > 5.0 and rounds >= 20:
+        if dt > budget and rounds >= (20 if on_accel else 3):
             break
     np.asarray(pending)
-    n += BATCH
+    n += batch
     dt = time.perf_counter() - t0
     qps = n / dt
+    scaled = "" if on_accel else f" [CPU-FALLBACK scale: {n_items} items]"
     print(
         f"recommend top-{k}, {n_items} items x {features} features, exact, "
-        f"micro-batch {BATCH}: {n} reqs in {dt:.2f}s on "
-        f"{jax.devices()[0].platform}",
+        f"micro-batch {batch}: {n} reqs in {dt:.2f}s on {platform}{scaled}",
         file=sys.stderr,
     )
     print(
@@ -79,10 +95,411 @@ def main() -> None:
                 "metric": "als_recommend_throughput_1M_items_50f",
                 "value": round(qps, 1),
                 "unit": "qps",
-                "vs_baseline": round(qps / 437.0, 2),
+                "vs_baseline": round(qps / BASELINE_QPS, 2),
+                "platform": platform,
+                "batch": batch,
+                "n_items": n_items,
             }
         )
     )
+
+
+def _bench_http_body() -> None:
+    """End-to-end /recommend throughput through the REAL serving stack:
+    HTTP parse -> route dispatch -> readiness gate -> micro-batched device
+    top-k -> JSON render. This is the apples-to-apples number against the
+    reference's LoadBenchmark.java (437 qps best case): same endpoint
+    semantics, but exact scoring (no LSH) via one coalesced matmul+top_k.
+    """
+    import http.client
+    import threading
+
+    import numpy as np
+    import jax
+
+    from oryx_tpu.apps.als.serving import ALSServingModel, ALSServingModelManager
+    from oryx_tpu.apps.als.state import ALSState
+    from oryx_tpu.bus.broker import topics
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.serving.server import ServingLayer
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    n_items, n_users, features, k = (
+        (1_000_000, 100_000, 50, 10) if on_accel else (100_000, 10_000, 50, 10)
+    )
+    n_clients = 64
+    duration = 10.0 if on_accel else 5.0
+
+    # synthetic model, the LoadTestALSModelFactory analogue
+    rng = np.random.default_rng(42)
+    state = ALSState(features, implicit=True)
+    state.y.bulk_set(
+        [f"i{j}" for j in range(n_items)],
+        rng.standard_normal((n_items, features), dtype=np.float32),
+    )
+    state.x.bulk_set(
+        [f"u{j}" for j in range(n_users)],
+        rng.standard_normal((n_users, features), dtype=np.float32),
+    )
+    state.set_expected(state.x.ids(), state.y.ids())
+
+    cfg = load_config(
+        overlay={
+            "oryx.id": "bench",
+            "oryx.input-topic.broker": "mem://bench",
+            "oryx.update-topic.broker": "mem://bench",
+            "oryx.serving.api.port": 0,
+            "oryx.serving.api.read-only": True,
+            "oryx.serving.application-resources": [
+                "oryx_tpu.serving.resources.common",
+                "oryx_tpu.serving.resources.als",
+            ],
+        }
+    )
+    topics.maybe_create("mem://bench", "OryxUpdate", partitions=1)
+    manager = ALSServingModelManager(cfg)
+    manager.model = ALSServingModel(state, sample_rate=1.0)
+    serving = ServingLayer(cfg, model_manager=manager)
+    serving.start()
+    port = serving.port
+
+    # warm up: compile the bucketed top-k kernel before timing
+    warm = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    warm.request("GET", "/recommend/u0?howMany=10")
+    resp = warm.getresponse()
+    body = resp.read()
+    assert resp.status == 200, (resp.status, body[:200])
+    warm.close()
+
+    counts = [0] * n_clients
+    errors = [0] * n_clients
+    stop_at = [0.0]
+
+    def client(ci: int) -> None:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        lrng = np.random.default_rng(1000 + ci)
+        uids = lrng.integers(0, n_users, size=4096)
+        j = 0
+        while time.perf_counter() < stop_at[0]:
+            try:
+                conn.request(
+                    "GET", f"/recommend/u{uids[j % len(uids)]}?howMany=10"
+                )
+                r = conn.getresponse()
+                r.read()
+                if r.status == 200:
+                    counts[ci] += 1
+                else:
+                    errors[ci] += 1
+            except Exception:
+                # count it and keep offering load on a fresh connection —
+                # a dead client thread would silently shrink offered load
+                errors[ci] += 1
+                conn.close()
+                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+            j += 1
+        conn.close()
+
+    stop_at[0] = time.perf_counter() + duration
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(n_clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration + 120)
+    dt = time.perf_counter() - t0
+    total = sum(counts)
+    qps = total / dt
+    from oryx_tpu.serving.batcher import TopKBatcher
+
+    b = TopKBatcher.shared()
+    mean_batch = b.coalesced / max(1, b.dispatches)
+    serving.close()
+    scaled = "" if on_accel else f" [CPU-FALLBACK scale: {n_items} items]"
+    print(
+        f"HTTP /recommend: {total} reqs ({sum(errors)} errs) in {dt:.2f}s, "
+        f"{n_clients} clients, mean device batch {mean_batch:.1f} on "
+        f"{platform}{scaled}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "als_recommend_http_qps_1M_items_50f",
+                "value": round(qps, 1),
+                "unit": "qps",
+                "vs_baseline": round(qps / BASELINE_QPS, 2),
+                "platform": platform,
+                "n_items": n_items,
+                "clients": n_clients,
+                "mean_device_batch": round(mean_batch, 1),
+                "errors": sum(errors),
+            }
+        )
+    )
+
+
+def _bench_train_body() -> None:
+    """ALS batch model-build wall-clock at MovieLens-25M scale — the
+    BASELINE.json north-star metric (the reference publishes NO training
+    numbers; Spark-MLlib is the implied baseline). Data is synthesized to
+    the ML-25M shape (~162k users x 59k items x 25M implicit interactions,
+    Zipf-skewed item popularity, log-normal user activity) since the bench
+    host has no dataset egress. Reports end-to-end build seconds (host
+    aggregation + padding + compile + train) and held-out mean-per-user AUC
+    (which also measures the quality cost of the cap=1024 padded-list
+    truncation vs the reference's use-everything semantics).
+    """
+    import numpy as np
+    import jax
+
+    from oryx_tpu.ml.evaluate import auc_mean_per_user
+    from oryx_tpu.ops.als import aggregate_interactions, train_als
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    if on_accel:
+        n_users, n_items, nnz = 162_000, 59_000, 25_000_000
+    else:  # CPU fallback: ML-1M-ish shape so the harness still completes
+        n_users, n_items, nnz = 6_000, 3_700, 1_000_000
+    features, iterations = 50, 10
+
+    rng = np.random.default_rng(7)
+    # Zipf-ish item popularity + log-normal user activity (MovieLens shape)
+    item_w = 1.0 / np.power(np.arange(1, n_items + 1), 0.9)
+    item_w /= item_w.sum()
+    user_w = rng.lognormal(0.0, 1.1, n_users)
+    user_w /= user_w.sum()
+    users = rng.choice(n_users, size=nnz, p=user_w).astype(np.int64)
+    items = rng.choice(n_items, size=nnz, p=item_w).astype(np.int64)
+    values = rng.choice(
+        [0.5, 1, 1.5, 2, 2.5, 3, 3.5, 4, 4.5, 5], size=nnz
+    ).astype(np.float64)
+
+    # ~2% holdout for AUC
+    test_mask = rng.random(nnz) < 0.02
+    tr = ~test_mask
+
+    t0 = time.perf_counter()
+    data = aggregate_interactions(users[tr], items[tr], values[tr], implicit=True)
+    t_agg = time.perf_counter() - t0
+    model = train_als(
+        data,
+        features=features,
+        lam=0.01,
+        alpha=1.0,
+        iterations=iterations,
+        implicit=True,
+    )
+    build_s = time.perf_counter() - t0
+
+    # AUC on a user sample (full per-user python loop would dominate the
+    # bench; 2000 users gives a +/-0.005 CI on the mean)
+    uid_to_row = {u: j for j, u in enumerate(model.user_ids)}
+    iid_to_row = {i: j for j, i in enumerate(model.item_ids)}
+    tu_all, ti_all = users[test_mask], items[test_mask]
+    known: dict[int, set[int]] = {}
+    tu, ti = [], []
+    sample_users = set(
+        rng.choice(np.unique(tu_all), size=min(2000, len(np.unique(tu_all))), replace=False).tolist()
+    )
+    for u, i in zip(tu_all, ti_all):
+        if u not in sample_users:
+            continue
+        ur, ir = uid_to_row.get(str(u)), iid_to_row.get(str(i))
+        if ur is None or ir is None:
+            continue
+        tu.append(ur)
+        ti.append(ir)
+    # known (training) items for the sampled users, to exclude as negatives
+    smp = np.isin(users, np.fromiter(sample_users, dtype=np.int64)) & tr
+    for u, i in zip(users[smp], items[smp]):
+        ur, ir = uid_to_row.get(str(u)), iid_to_row.get(str(i))
+        if ur is not None and ir is not None:
+            known.setdefault(ur, set()).add(ir)
+    auc = auc_mean_per_user(
+        model.x, model.y, np.asarray(tu, dtype=np.int64), np.asarray(ti, dtype=np.int64), known
+    )
+
+    scaled = "" if on_accel else f" [CPU-FALLBACK scale: {nnz} interactions]"
+    print(
+        f"ALS build: {nnz} interactions {n_users}x{n_items} -> {features}f x "
+        f"{iterations}it in {build_s:.1f}s (agg {t_agg:.1f}s), AUC {auc:.4f} "
+        f"on {platform}{scaled}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "als_build_seconds_ml25m_shape",
+                "value": round(build_s, 1),
+                "unit": "s",
+                "platform": platform,
+                "interactions": nnz,
+                "auc": round(auc, 4),
+            }
+        )
+    )
+
+
+# --------------------------------------------------------------------------
+# orchestration — no jax import in this process, all backend touches are
+# bounded-time subprocesses
+# --------------------------------------------------------------------------
+
+def _cpu_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+# The env var alone does NOT stop this host's sitecustomize from
+# registering/initializing the real-TPU platform (see tests/conftest.py) —
+# the in-process config override must run before any backend use.
+_FORCE_CPU_PREFIX = "import jax; jax.config.update('jax_platforms', 'cpu'); "
+
+
+def _run_subprocess(code: str, env: dict, timeout: float) -> tuple[int | None, str, str]:
+    """Run python -c code with output to files (pipes can hang: a wedged
+    TPU-transport helper process inherits and holds them open past the
+    child's death). Kills the whole process group on timeout.
+
+    Returns (rc or None-on-timeout, stdout, stderr)."""
+    with tempfile.TemporaryDirectory() as td:
+        out_path, err_path = os.path.join(td, "out"), os.path.join(td, "err")
+        with open(out_path, "wb") as o, open(err_path, "wb") as e:
+            proc = subprocess.Popen(
+                [sys.executable, "-c", code],
+                env=env,
+                cwd=HERE,
+                stdout=o,
+                stderr=e,
+                start_new_session=True,
+            )
+            try:
+                rc = proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    proc.kill()
+                proc.wait()
+                rc = None
+        read = lambda p: open(p, "r", errors="replace").read()
+        return rc, read(out_path), read(err_path)
+
+
+def _probe_backend(env: dict, timeout: float) -> str | None:
+    """Return the default platform name, or None if init hangs/crashes."""
+    code = (
+        "import jax, jax.numpy as jnp; "
+        "d = jax.devices(); "
+        "jax.block_until_ready(jnp.ones((128,128)) @ jnp.ones((128,128))); "
+        "print('PLATFORM=' + d[0].platform)"
+    )
+    rc, stdout, _ = _run_subprocess(code, env, timeout)
+    if rc != 0:
+        return None
+    for line in stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1].strip()
+    return None
+
+
+def _run_bench(
+    env: dict, timeout: float, body: str = "_bench_http_body", force_cpu: bool = False
+) -> dict | None:
+    """Run a bench body in a subprocess; return its parsed JSON or None."""
+    code = (
+        (_FORCE_CPU_PREFIX if force_cpu else "")
+        + f"import sys; sys.path.insert(0, {HERE!r}); "
+        + f"import bench; bench.{body}()"
+    )
+    rc, stdout, stderr = _run_subprocess(code, env, timeout)
+    sys.stderr.write(stderr)
+    if rc is None:
+        print("bench body timed out", file=sys.stderr)
+        return None
+    if rc != 0:
+        print(f"bench body failed rc={rc}", file=sys.stderr)
+        return None
+    for line in reversed(stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main() -> None:
+    errors: list[str] = []
+    deadline = time.monotonic() + 1500  # overall wall-clock budget
+    left = lambda cap: max(30.0, min(cap, deadline - time.monotonic()))
+
+    # 1. try the default platform (real TPU on the bench host), with retries
+    #    — round 1 showed a single wedged init attempt, so retry before
+    #    giving up on the accelerator entirely.
+    default_env = dict(os.environ)
+    platform = None
+    for attempt in range(2):
+        platform = _probe_backend(default_env, timeout=left(120))
+        if platform is not None:
+            break
+        errors.append(f"backend probe attempt {attempt + 1} failed/hung")
+        time.sleep(5)
+
+    result = None
+    env_used = default_env
+    forced = False
+    if platform is not None:
+        result = _run_bench(default_env, timeout=left(420))
+        if result is None:
+            errors.append(f"bench on '{platform}' failed")
+
+    # 2. CPU fallback: always produces a number, flagged as degraded
+    if result is None:
+        errors.append("falling back to forced-CPU run")
+        env_used, forced = _cpu_env(), True
+        result = _run_bench(env_used, timeout=left(300), force_cpu=True)
+
+    # secondary: raw kernel throughput (device ceiling, no HTTP layer)
+    if result is not None:
+        kernel = _run_bench(
+            env_used, timeout=left(300), body="_bench_body", force_cpu=forced
+        )
+        if kernel is not None:
+            result["kernel_qps"] = kernel.get("value")
+
+    # training north star: ALS build at ML-25M shape (BASELINE.json)
+    if result is not None:
+        train = _run_bench(
+            env_used, timeout=left(600), body="_bench_train_body", force_cpu=forced
+        )
+        if train is not None:
+            result["als_build_seconds"] = train.get("value")
+            result["als_build_auc"] = train.get("auc")
+            result["als_build_interactions"] = train.get("interactions")
+        else:
+            errors.append("training bench failed")
+
+    if result is None:
+        result = {
+            "metric": "als_recommend_http_qps_1M_items_50f",
+            "value": 0.0,
+            "unit": "qps",
+            "vs_baseline": 0.0,
+        }
+        errors.append("cpu fallback also failed")
+
+    if errors:
+        result["error"] = "; ".join(errors)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -20,8 +20,9 @@ import jax.numpy as jnp
 
 from oryx_tpu.api import AbstractServingModelManager, ServingModel
 from oryx_tpu.common.config import Config
-from oryx_tpu.ops.als import compute_updated_xu, topk_dot
+from oryx_tpu.ops.als import compute_updated_xu
 from oryx_tpu.apps.als.common import ALSConfig
+from oryx_tpu.serving.batcher import TopKBatcher
 from oryx_tpu.apps.als.state import ALSState, apply_update_message
 
 log = logging.getLogger(__name__)
@@ -166,9 +167,12 @@ class ALSServingModel(ServingModel):
             n = len(ids)
             if n == 0:
                 return []
-            # over-fetch to survive exclusions/filters, then trim
+            # over-fetch to survive exclusions/filters, then trim.
+            # Concurrent requests coalesce into one bucketed-shape device
+            # dispatch (serving/batcher.py) — B=1 matmuls waste the MXU and
+            # a data-dependent k would recompile per exclusion-set size.
             k = min(n, how_many + len(exclude) + 8)
-            vals, idx = topk_dot(jnp.asarray(user_vector, dtype=jnp.float32), y, k=k)
+            vals, idx = TopKBatcher.shared().submit(user_vector, k, y)
         out = []
         for v, j in zip(np.asarray(vals), np.asarray(idx)):
             ident = ids[int(j)]

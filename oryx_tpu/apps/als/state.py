@@ -51,6 +51,34 @@ class FactorStore:
             self._arena[row] = v
             self.version += 1
 
+    def bulk_set(self, idents: list[str], matrix: np.ndarray) -> None:
+        """Set many vectors in one arena write — the model-load fast path
+        (a MODEL artifact or a synthetic load-test model carries the whole
+        factor table at once; per-row set() would version-bump and bounds-
+        check a million times)."""
+        m = np.asarray(matrix, dtype=np.float32)
+        if m.ndim != 2 or m.shape != (len(idents), self.features):
+            raise ValueError(f"matrix shape {m.shape} != ({len(idents)}, {self.features})")
+        with self._lock.write():
+            new = [i for i in idents if i not in self._ids]
+            need = self._n + len(new)
+            if need > len(self._arena):
+                grow = max(need, 2 * len(self._arena))
+                self._arena = np.vstack(
+                    [self._arena, np.zeros((grow - len(self._arena), self.features), dtype=np.float32)]
+                )
+            rows = np.empty(len(idents), dtype=np.int64)
+            for j, ident in enumerate(idents):
+                row = self._ids.get(ident)
+                if row is None:
+                    row = self._n
+                    self._ids[ident] = row
+                    self._rev.append(ident)
+                    self._n += 1
+                rows[j] = row
+            self._arena[rows] = m
+            self.version += 1
+
     def get(self, ident: str) -> np.ndarray | None:
         with self._lock.read():
             row = self._ids.get(ident)
@@ -139,8 +167,46 @@ class ALSState:
         self._known_lock = threading.Lock()
         self.expected_x: set[str] | None = None
         self.expected_y: set[str] | None = None
+        # loaded-fraction counters maintained incrementally: the readiness
+        # gate runs on EVERY request (app.py get_serving_model), so it must
+        # be O(1), not a scan of million-entry expected-ID sets
+        self._have_x = 0
+        self._have_y = 0
+        self._frac_lock = threading.Lock()
         self.yty = SolverCache(self.y)
         self.xtx = SolverCache(self.x)
+
+    # -- factor writes (keep the readiness counters true) -------------------
+
+    def set_x(self, ident: str, vector: np.ndarray) -> None:
+        present_before = ident in self.x
+        self.x.set(ident, vector)
+        if self.expected_x is not None:
+            with self._frac_lock:
+                if ident not in self.expected_x:
+                    self.expected_x.add(ident)
+                    self._have_x += 1
+                elif not present_before:
+                    self._have_x += 1
+
+    def set_y(self, ident: str, vector: np.ndarray) -> None:
+        present_before = ident in self.y
+        self.y.set(ident, vector)
+        if self.expected_y is not None:
+            with self._frac_lock:
+                if ident not in self.expected_y:
+                    self.expected_y.add(ident)
+                    self._have_y += 1
+                elif not present_before:
+                    self._have_y += 1
+
+    def recount(self) -> None:
+        """Recompute the loaded counters from scratch — one O(N) pass, used
+        after bulk mutations (model swap, inline-tensor ingest)."""
+        with self._frac_lock:
+            ex, ey = self.expected_x, self.expected_y
+            self._have_x = len(ex & set(self.x.ids())) if ex is not None else 0
+            self._have_y = len(ey & set(self.y.ids())) if ey is not None else 0
 
     # -- known items -------------------------------------------------------
 
@@ -168,19 +234,19 @@ class ALSState:
     def set_expected(self, x_ids, y_ids) -> None:
         self.expected_x = set(x_ids)
         self.expected_y = set(y_ids)
+        self.recount()
 
     def fraction_loaded(self) -> float:
         """Loaded fraction of the announced model's vectors
-        (ALSServingModel.getFractionLoaded, :386-400)."""
+        (ALSServingModel.getFractionLoaded, :386-400). O(1): counters are
+        maintained by set_x/set_y/recount, never scanned per request."""
         if self.expected_x is None or self.expected_y is None:
             return 0.0
         total = len(self.expected_x) + len(self.expected_y)
         if total == 0:
             return 1.0
-        have = sum(1 for i in self.expected_x if i in self.x) + sum(
-            1 for i in self.expected_y if i in self.y
-        )
-        return have / total
+        with self._frac_lock:
+            return (self._have_x + self._have_y) / total
 
     # -- model swap --------------------------------------------------------
 
@@ -191,6 +257,7 @@ class ALSState:
             self.known_items = {
                 u: s for u, s in self.known_items.items() if u in x_keep
             }
+        self.recount()
 
 
 # ---------------------------------------------------------------------------
@@ -251,12 +318,12 @@ def apply_update_message(
             state.set_expected(state.x.ids(), state.y.ids())
         if art.tensors:
             x, y = art.tensors.get("X"), art.tensors.get("Y")
-            if y is not None and len(yids) == len(y):
-                for j, iid in enumerate(yids):
-                    state.y.set(iid, y[j])
-            if x is not None and len(xids) == len(x):
-                for j, uid in enumerate(xids):
-                    state.x.set(uid, x[j])
+            if y is not None and len(yids) == len(y) and len(y) > 0:
+                state.y.bulk_set(yids, y)
+            if x is not None and len(xids) == len(x) and len(x) > 0:
+                state.x.bulk_set(xids, x)
+            if x is not None or y is not None:
+                state.recount()
             if with_known_items:
                 for u, items in art.content.get("knownItems", {}).items():
                     state.add_known_items(u, items)
@@ -267,13 +334,9 @@ def apply_update_message(
         if len(vec) != state.features:
             return state  # stale update from a different-rank model
         if kind == "X":
-            state.x.set(ident, vec)
-            if state.expected_x is not None:
-                state.expected_x.add(ident)
+            state.set_x(ident, vec)
             if with_known_items and known:
                 state.add_known_items(ident, known)
         elif kind == "Y":
-            state.y.set(ident, vec)
-            if state.expected_y is not None:
-                state.expected_y.add(ident)
+            state.set_y(ident, vec)
     return state

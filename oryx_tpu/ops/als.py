@@ -161,25 +161,24 @@ def _factorize_string_ids(arr: np.ndarray) -> tuple[list[str], np.ndarray]:
             canon_len = np.searchsorted(_POW10, a, side="right") + 1 + (nums < 0)
             canonical = bool((np.char.str_len(arr) == canon_len).all())
     if nums is not None and canonical:
-        if True:
-            lo = int(nums.min())
-            span = int(nums.max()) - lo + 1
-            if span <= max(4 * len(nums), 1 << 28):
-                present = np.zeros(span, dtype=bool)
-                present[nums - lo] = True
-                uniq = np.nonzero(present)[0] + lo
-                rank = np.cumsum(present) - 1
-                inv = rank[nums - lo]
-            else:
-                uniq, inv = np.unique(nums, return_inverse=True)
-            # remap numeric order -> lexicographic, for parity with the
-            # reference's sorted string ids (only the small unique array
-            # pays the string sort)
-            uniq_strs = uniq.astype(str)
-            lex = np.argsort(uniq_strs)
-            perm = np.empty_like(lex)
-            perm[lex] = np.arange(len(lex))
-            return uniq_strs[lex].tolist(), perm[inv.astype(np.int64)]
+        lo = int(nums.min())
+        span = int(nums.max()) - lo + 1
+        if span <= max(4 * len(nums), 1 << 28):
+            present = np.zeros(span, dtype=bool)
+            present[nums - lo] = True
+            uniq = np.nonzero(present)[0] + lo
+            rank = np.cumsum(present) - 1
+            inv = rank[nums - lo]
+        else:
+            uniq, inv = np.unique(nums, return_inverse=True)
+        # remap numeric order -> lexicographic, for parity with the
+        # reference's sorted string ids (only the small unique array
+        # pays the string sort)
+        uniq_strs = uniq.astype(str)
+        lex = np.argsort(uniq_strs)
+        perm = np.empty_like(lex)
+        perm[lex] = np.arange(len(lex))
+        return uniq_strs[lex].tolist(), perm[inv.astype(np.int64)]
     ids, inv = np.unique(arr, return_inverse=True)
     return ids.tolist(), inv.astype(np.int64)
 

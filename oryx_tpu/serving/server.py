@@ -11,7 +11,6 @@ basic auth and gzip request bodies.
 
 from __future__ import annotations
 
-import base64
 import gzip
 import logging
 import threading
@@ -24,6 +23,7 @@ from oryx_tpu.bus.broker import get_broker
 from oryx_tpu.common.classutil import load_instance_of
 from oryx_tpu.common.config import Config
 from oryx_tpu.serving.app import Request, ServingApp
+from oryx_tpu.serving.auth import Authenticator, make_authenticator
 
 log = logging.getLogger(__name__)
 
@@ -33,8 +33,6 @@ class ServingLayer:
         self.config = config
         self.port = config.get_int("oryx.serving.api.port", 8080)
         self.read_only = config.get_bool("oryx.serving.api.read-only", False)
-        self.user = config.get_string("oryx.serving.api.user-name", None)
-        self.password = config.get_string("oryx.serving.api.password", None)
         self.group = f"OryxGroup-{config.get_string('oryx.id', None) or 'serving'}-serving"
         self.update_uri = config.get_string("oryx.update-topic.broker")
         self.update_topic = config.get_string("oryx.update-topic.message.topic")
@@ -85,7 +83,7 @@ class ServingLayer:
         self._listener.start()
 
         self.app = ServingApp(self.config, self.model_manager, input_producer)
-        handler = _make_handler(self.app, self._auth_header())
+        handler = _make_handler(self.app, make_authenticator(self.config))
         self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
         cert = self.config.get_string("oryx.serving.api.ssl-cert-file", None)
         key = self.config.get_string("oryx.serving.api.ssl-key-file", None)
@@ -109,12 +107,6 @@ class ServingLayer:
         self._http_thread.start()
         log.info("serving layer listening on :%d", self.port)
 
-    def _auth_header(self) -> str | None:
-        if self.user and self.password:
-            token = base64.b64encode(f"{self.user}:{self.password}".encode()).decode()
-            return f"Basic {token}"
-        return None
-
     def await_termination(self) -> None:
         if self._http_thread:
             self._http_thread.join()
@@ -137,7 +129,7 @@ class ServingLayer:
         self.close()
 
 
-def _make_handler(app: ServingApp, auth: str | None):
+def _make_handler(app: ServingApp, auth: Authenticator | None):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
         timeout = 30  # bounds slow/stalled clients (incl. deferred TLS handshakes)
@@ -146,18 +138,28 @@ def _make_handler(app: ServingApp, auth: str | None):
             log.debug("http: " + fmt, *args)
 
         def _handle(self, method: str) -> None:
-            if auth is not None and self.headers.get("Authorization") != auth:
-                body = b'{"status":401,"error":"unauthorized"}'
-                self.send_response(401)
-                self.send_header("WWW-Authenticate", 'Basic realm="Oryx"')
-                self.send_header("Content-Length", str(len(body)))
-                self.send_header("Content-Type", "application/json")
-                self.end_headers()
-                self.wfile.write(body)
-                return
-            split = urlsplit(self.path)
+            # drain the body FIRST, even for requests that will 401 —
+            # leaving unread bytes on a keep-alive socket desyncs the next
+            # request on the connection (digest clients always see a 401
+            # on their first exchange, so this path is routine, not rare)
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
+            if auth is not None:
+                # DIGEST by default (reference InMemoryRealm parity); the
+                # check returns a fresh challenge on any failure/staleness
+                verdict = auth.check(
+                    method, self.path, self.headers.get("Authorization")
+                )
+                if verdict is not True:
+                    payload = b'{"status":401,"error":"unauthorized"}'
+                    self.send_response(401)
+                    self.send_header("WWW-Authenticate", verdict)
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+            split = urlsplit(self.path)
             if self.headers.get("Content-Encoding", "").lower() == "gzip" and body:
                 body = gzip.decompress(body)
             req = Request(

@@ -93,3 +93,64 @@ def test_corrupt_model_tensor_rejected_before_mutation():
     # state untouched: still fully loaded with the old expectations
     assert st.fraction_loaded() == 1.0
     assert st.x.get("u1") is not None
+
+
+def test_fraction_loaded_incremental_counters():
+    """fraction_loaded must be O(1) and stay true under UP ingest, bulk
+    loads, and model-swap retention (the gate runs per request)."""
+    st = ALSState(2, implicit=True)
+    assert st.fraction_loaded() == 0.0  # no model announced
+    st.set_expected(["u1", "u2"], ["i1", "i2"])
+    assert st.fraction_loaded() == 0.0
+    st.set_x("u1", np.array([1.0, 0.0], dtype=np.float32))
+    assert st.fraction_loaded() == 0.25
+    st.set_x("u1", np.array([2.0, 0.0], dtype=np.float32))  # overwrite: no double count
+    assert st.fraction_loaded() == 0.25
+    st.set_y("i1", np.array([1.0, 0.0], dtype=np.float32))
+    st.set_y("i2", np.array([0.0, 1.0], dtype=np.float32))
+    assert st.fraction_loaded() == 0.75
+    # unexpected id arriving via UP grows both have and total
+    st.set_x("u3", np.array([0.5, 0.5], dtype=np.float32))
+    assert abs(st.fraction_loaded() - 4 / 5) < 1e-9
+    st.set_x("u2", np.array([0.5, 0.5], dtype=np.float32))
+    assert st.fraction_loaded() == 1.0
+    # swap retains a subset: counters recomputed
+    st.set_expected(["u1"], ["i1"])
+    st.retain_only({"u1"}, {"i1"})
+    assert st.fraction_loaded() == 1.0
+
+
+def test_bulk_set_matches_per_row_set():
+    from oryx_tpu.apps.als.state import FactorStore
+
+    rng = np.random.default_rng(0)
+    m = rng.normal(size=(300, 4)).astype(np.float32)
+    ids = [f"r{j}" for j in range(300)]
+    a, b = FactorStore(4), FactorStore(4)
+    for j, i in enumerate(ids):
+        a.set(i, m[j])
+    b.bulk_set(ids, m)
+    ma, ia, _ = a.snapshot()
+    mb, ib, _ = b.snapshot()
+    assert ia == ib
+    np.testing.assert_array_equal(ma, mb)
+    # bulk overwrite of an existing subset
+    b.bulk_set(["r5", "r7"], np.ones((2, 4), dtype=np.float32))
+    assert b.get("r5").tolist() == [1, 1, 1, 1]
+    assert len(b) == 300
+
+
+def test_model_with_inline_tensors_counts_loaded():
+    from oryx_tpu.common.artifact import ModelArtifact
+
+    art = ModelArtifact(app="als")
+    art.set_extension("features", "2")
+    art.set_extension("implicit", "true")
+    art.set_extension("XIDs", ["u1", "u2"])
+    art.set_extension("YIDs", ["i1"])
+    art.tensors = {
+        "X": np.ones((2, 2), dtype=np.float32),
+        "Y": np.ones((1, 2), dtype=np.float32),
+    }
+    st = apply_update_message(None, "MODEL", art.to_string())
+    assert st.fraction_loaded() == 1.0

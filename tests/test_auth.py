@@ -1,0 +1,194 @@
+"""DIGEST/Basic auth for the serving layer (reference: ServingLayer's
+DIGEST InMemoryRealm protecting every endpoint)."""
+
+import hashlib
+import urllib.error
+import urllib.request
+
+import pytest
+
+from oryx_tpu.apps.example.serving import ExampleServingModelManager
+from oryx_tpu.bus.broker import topics
+from oryx_tpu.bus.inproc import InProcBroker
+from oryx_tpu.common.config import load_config
+from oryx_tpu.serving.auth import (
+    BasicAuthenticator,
+    DigestAuthenticator,
+    _parse_auth_params,
+    make_authenticator,
+)
+from oryx_tpu.serving.server import ServingLayer
+
+
+def _md5(s: str) -> str:
+    return hashlib.md5(s.encode()).hexdigest()
+
+
+def _digest_response(user, password, realm, method, uri, nonce, nc="00000001", cnonce="abc"):
+    ha1 = _md5(f"{user}:{realm}:{password}")
+    ha2 = _md5(f"{method}:{uri}")
+    resp = _md5(f"{ha1}:{nonce}:{nc}:{cnonce}:auth:{ha2}")
+    return (
+        f'Digest username="{user}", realm="{realm}", nonce="{nonce}", '
+        f'uri="{uri}", qop=auth, nc={nc}, cnonce="{cnonce}", response="{resp}"'
+    )
+
+
+def test_parse_auth_params_quoted_and_bare():
+    p = _parse_auth_params('username="bob", qop=auth, nc=00000001, uri="/a,b"')
+    assert p == {"username": "bob", "qop": "auth", "nc": "00000001", "uri": "/a,b"}
+
+
+def test_digest_roundtrip():
+    a = DigestAuthenticator("oryx", "pass")
+    challenge = a.check("GET", "/ready", None)
+    assert isinstance(challenge, str) and challenge.startswith("Digest ")
+    nonce = _parse_auth_params(challenge[len("Digest "):])["nonce"]
+    hdr = _digest_response("oryx", "pass", "Oryx", "GET", "/ready", nonce)
+    assert a.check("GET", "/ready", hdr) is True
+    # wrong password fails
+    bad = _digest_response("oryx", "nope", "Oryx", "GET", "/ready", nonce)
+    assert a.check("GET", "/ready", bad) is not True
+    # replay against a different uri fails
+    assert a.check("GET", "/other", hdr) is not True
+    # wrong method fails
+    assert a.check("POST", "/ready", hdr) is not True
+
+
+def test_digest_stale_nonce_rechallenges():
+    a = DigestAuthenticator("u", "p")
+    forged_nonce = "123.000:deadbeef"
+    hdr = _digest_response("u", "p", "Oryx", "GET", "/x", forged_nonce)
+    verdict = a.check("GET", "/x", hdr)
+    assert verdict is not True  # bad mac -> plain challenge
+
+
+def test_basic_authenticator():
+    a = BasicAuthenticator("u", "p")
+    import base64
+
+    good = "Basic " + base64.b64encode(b"u:p").decode()
+    assert a.check("GET", "/", good) is True
+    assert a.check("GET", "/", "Basic bm9wZTpub3Bl") is not True
+    assert a.check("GET", "/", None) == 'Basic realm="Oryx"'
+
+
+def test_make_authenticator_selection():
+    base = {
+        "oryx.serving.api.user-name": "u",
+        "oryx.serving.api.password": "p",
+    }
+    assert isinstance(make_authenticator(load_config(overlay=base)), DigestAuthenticator)
+    assert isinstance(
+        make_authenticator(
+            load_config(overlay={**base, "oryx.serving.api.auth-scheme": "basic"})
+        ),
+        BasicAuthenticator,
+    )
+    assert make_authenticator(load_config(overlay={})) is None
+    with pytest.raises(ValueError):
+        make_authenticator(
+            load_config(overlay={**base, "oryx.serving.api.auth-scheme": "kerberos"})
+        )
+
+
+def test_serving_layer_digest_end_to_end(tmp_path):
+    """urllib's stock digest handler must be able to talk to the server —
+    proof the challenge/response wire format is standard."""
+    InProcBroker.reset_all()
+    cfg = load_config(
+        overlay={
+            "oryx.id": "auth-test",
+            "oryx.input-topic.broker": "mem://auth",
+            "oryx.update-topic.broker": "mem://auth",
+            "oryx.serving.api.port": 0,
+            "oryx.serving.api.read-only": True,
+            "oryx.serving.api.user-name": "oryx",
+            "oryx.serving.api.password": "secret",
+            "oryx.serving.application-resources": [
+                "oryx_tpu.serving.resources.common",
+                "oryx_tpu.serving.resources.example",
+            ],
+        }
+    )
+    topics.maybe_create("mem://auth", "OryxUpdate", partitions=1)
+    serving = ServingLayer(cfg, model_manager=ExampleServingModelManager(cfg))
+    serving.start()
+    try:
+        base = f"http://127.0.0.1:{serving.port}"
+        # no credentials -> 401 with a Digest challenge
+        try:
+            urllib.request.urlopen(f"{base}/ready", timeout=10)
+            raise AssertionError("expected 401")
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+            assert e.headers.get("WWW-Authenticate", "").startswith("Digest ")
+        # stock digest client succeeds
+        mgr = urllib.request.HTTPPasswordMgrWithDefaultRealm()
+        mgr.add_password(None, base, "oryx", "secret")
+        opener = urllib.request.build_opener(
+            urllib.request.HTTPDigestAuthHandler(mgr)
+        )
+        with opener.open(f"{base}/ready", timeout=10) as resp:
+            assert resp.status == 200
+        # wrong password still locked out
+        mgr2 = urllib.request.HTTPPasswordMgrWithDefaultRealm()
+        mgr2.add_password(None, base, "oryx", "wrong")
+        opener2 = urllib.request.build_opener(
+            urllib.request.HTTPDigestAuthHandler(mgr2)
+        )
+        try:
+            opener2.open(f"{base}/ready", timeout=10)
+            raise AssertionError("expected auth failure")
+        except (urllib.error.HTTPError, ValueError):
+            pass  # urllib raises ValueError on repeated digest 401s
+    finally:
+        serving.close()
+        InProcBroker.reset_all()
+
+
+def test_digest_401_drains_body_on_keepalive(tmp_path):
+    """A body-carrying POST that gets a 401 challenge must leave the
+    keep-alive connection in sync for the authenticated retry — the normal
+    digest-client flow (401 -> retry on the same socket)."""
+    import http.client
+
+    InProcBroker.reset_all()
+    cfg = load_config(
+        overlay={
+            "oryx.id": "auth-ka",
+            "oryx.input-topic.broker": "mem://authka",
+            "oryx.update-topic.broker": "mem://authka",
+            "oryx.serving.api.port": 0,
+            "oryx.serving.api.user-name": "oryx",
+            "oryx.serving.api.password": "secret",
+            "oryx.serving.application-resources": [
+                "oryx_tpu.serving.resources.common",
+                "oryx_tpu.serving.resources.example",
+            ],
+        }
+    )
+    topics.maybe_create("mem://authka", "OryxInput", partitions=1)
+    topics.maybe_create("mem://authka", "OryxUpdate", partitions=1)
+    serving = ServingLayer(cfg, model_manager=ExampleServingModelManager(cfg))
+    serving.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", serving.port, timeout=10)
+        payload = b"a b c\n" * 100
+        conn.request("POST", "/ingest", body=payload)
+        r = conn.getresponse()
+        assert r.status == 401
+        challenge = r.headers["WWW-Authenticate"]
+        r.read()
+        nonce = _parse_auth_params(challenge[len("Digest "):])["nonce"]
+        hdr = _digest_response("oryx", "secret", "Oryx", "POST", "/ingest", nonce)
+        # SAME connection: if the 401 path left the body unread, this
+        # request line would be parsed out of the stale body bytes
+        conn.request("POST", "/ingest", body=payload, headers={"Authorization": hdr})
+        r2 = conn.getresponse()
+        assert r2.status == 200, r2.read()
+        r2.read()
+        conn.close()
+    finally:
+        serving.close()
+        InProcBroker.reset_all()
