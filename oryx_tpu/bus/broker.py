@@ -8,6 +8,7 @@ offset store folded into the broker (the ZooKeeper analogue).
 
 from __future__ import annotations
 
+import threading
 import zlib
 from abc import ABC, abstractmethod
 from typing import Mapping
@@ -73,13 +74,26 @@ class Broker(ABC):
         pass
 
 
+_kafka_brokers: dict[str, "Broker"] = {}
+_kafka_lock = threading.Lock()
+
+
 def get_broker(uri: str) -> Broker:
-    """Resolve a broker URI: mem://<name>, file://<dir> / file:/<dir>, or a
-    bare path."""
+    """Resolve a broker URI: mem://<name>, file://<dir> / file:/<dir>, a
+    bare path, or kafka://host:port[,host:port...] (a real cluster)."""
     if uri.startswith("mem://"):
         from oryx_tpu.bus.inproc import InProcBroker
 
         return InProcBroker.named(uri[len("mem://") :] or "default")
+    if uri.startswith("kafka://"):
+        from oryx_tpu.bus.kafka import KafkaBroker, parse_bootstrap
+
+        # one client (connection pool) per cluster URI
+        with _kafka_lock:
+            b = _kafka_brokers.get(uri)
+            if b is None:
+                b = _kafka_brokers[uri] = KafkaBroker(parse_bootstrap(uri))
+            return b
     if uri.startswith("file:") or uri.startswith("/") or uri.startswith("."):
         from oryx_tpu.common.ioutil import strip_scheme
         from oryx_tpu.bus.filelog import FileLogBroker

@@ -159,6 +159,23 @@ def cmd_import_pmml(config: Config, pmml_path: str | None = None) -> int:
     return 0
 
 
+def _apply_platform_env() -> None:
+    """Make JAX_PLATFORMS authoritative for framework processes.
+
+    Site customizations that pre-register an accelerator PJRT plugin can
+    hijack backend resolution so the env var alone is ignored; re-applying
+    it through jax.config before any backend is touched restores the
+    documented semantics (operators rely on JAX_PLATFORMS=cpu to run a
+    layer off-accelerator, e.g. a serving replica on a CPU-only host)."""
+    import os
+
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
+
+
 def _run_until_interrupt(layer) -> int:
     stop = signal.getsignal(signal.SIGTERM)
     signal.signal(signal.SIGTERM, lambda *_: layer.close())
@@ -201,6 +218,7 @@ def main(argv=None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    _apply_platform_env()
     config = _build_config(args)
     if args.command == "import-pmml":
         return cmd_import_pmml(config, args.pmml)

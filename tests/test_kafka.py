@@ -1,0 +1,296 @@
+"""kafka:// backend: wire codec units + full bus contract over real TCP.
+
+Mirrors the reference's strategy of testing against a real in-process
+broker (LocalKafkaBroker) instead of mocks: every test here goes through
+actual sockets speaking the Kafka wire protocol. Set ORYX_KAFKA_BROKER
+(host:port) to additionally run the contract against an external cluster.
+"""
+
+import os
+import struct
+
+import pytest
+
+from oryx_tpu.bus.api import ConsumeDataIterator, KeyMessage, TopicProducer
+from oryx_tpu.bus.broker import get_broker, partition_for
+from oryx_tpu.bus.kafka import KafkaBroker, parse_bootstrap
+from oryx_tpu.bus.kafkawire import (
+    crc32c,
+    decode_record_batches,
+    encode_record_batch,
+)
+from tests.kafka_testbroker import LocalKafkaTestBroker
+
+
+# -- codec units ------------------------------------------------------------
+
+def test_crc32c_check_value():
+    from oryx_tpu.bus.kafkawire import _crc32c_py
+
+    # the canonical CRC-32C check vector, for whichever impl is active AND
+    # the pure-python slicing-by-8 fallback explicitly
+    for fn in (crc32c, _crc32c_py):
+        assert fn(b"123456789") == 0xE3069283
+        assert fn(b"") == 0
+    # both impls agree across lengths that hit the 8-byte and tail loops
+    import os
+
+    for n in (1, 7, 8, 9, 255, 1024, 4097):
+        blob = os.urandom(n)
+        assert crc32c(blob) == _crc32c_py(blob)
+
+
+def test_record_batch_roundtrip():
+    recs = [(b"k1", b"v1"), (None, b"v2"), (b"k3", None), (b"", b"")]
+    batch = encode_record_batch(recs, base_timestamp_ms=1234)
+    # header spot checks against the spec layout
+    assert struct.unpack_from(">q", batch, 0)[0] == 0  # baseOffset
+    assert batch[16] == 2  # magic v2
+    out = decode_record_batches(batch)
+    assert out == [(0, b"k1", b"v1"), (1, None, b"v2"), (2, b"k3", None), (3, b"", b"")]
+
+
+def test_record_batch_decode_tolerates_partial_tail():
+    batch = encode_record_batch([(b"a", b"b")], 0)
+    # a second batch truncated mid-header, as a broker may return
+    data = batch + batch[: len(batch) // 2]
+    assert decode_record_batches(data) == [(0, b"a", b"b")]
+
+
+def test_decode_after_base_offset_rewrite():
+    """The broker assigns offsets by rewriting baseOffset; decode must
+    yield absolute offsets."""
+    batch = encode_record_batch([(b"a", b"1"), (b"b", b"2")], 0)
+    rewritten = struct.pack(">q", 100) + batch[8:]
+    assert [o for o, _, _ in decode_record_batches(rewritten)] == [100, 101]
+
+
+def test_parse_bootstrap():
+    assert parse_bootstrap("kafka://h1:9092") == [("h1", 9092)]
+    assert parse_bootstrap("kafka://h1:9092,h2:9093") == [("h1", 9092), ("h2", 9093)]
+    assert parse_bootstrap("kafka://justhost") == [("justhost", 9092)]
+
+
+# -- contract over TCP ------------------------------------------------------
+
+@pytest.fixture
+def kafka():
+    with LocalKafkaTestBroker() as server:
+        broker = KafkaBroker([(server.host, server.port)])
+        yield broker
+        broker.close()
+
+
+def test_admin_roundtrip(kafka):
+    assert not kafka.topic_exists("T")
+    kafka.create_topic("T", partitions=3)
+    assert kafka.topic_exists("T")
+    assert kafka.num_partitions("T") == 3
+    with pytest.raises(ValueError):
+        kafka.create_topic("T")
+    kafka.delete_topic("T")
+    assert not kafka.topic_exists("T")
+
+
+def test_produce_fetch_keyed_partitioning(kafka):
+    kafka.create_topic("T", partitions=4)
+    for i in range(40):
+        kafka.send("T", f"k{i}", f"m{i}")
+    # every record lands on its crc32-keyed partition
+    seen = {}
+    for p in range(4):
+        for off, key, msg in kafka.read("T", p, 0, 1000):
+            assert partition_for(key, 4) == p
+            seen[key] = (p, off, msg)
+    assert len(seen) == 40
+    assert seen["k7"][2] == "m7"
+    # offsets are per-partition contiguous from 0
+    ends = kafka.end_offsets("T")
+    assert sum(ends) == 40
+    for p in range(4):
+        offs = [o for o, _, _ in kafka.read("T", p, 0, 1000)]
+        assert offs == list(range(ends[p]))
+
+
+def test_read_from_mid_offset_and_max_records(kafka):
+    kafka.create_topic("T", partitions=1)
+    kafka.send_batch("T", [(None, f"m{i}") for i in range(10)])
+    recs = kafka.read("T", 0, 4, 3)
+    assert [(o, m) for o, _, m in recs] == [(4, "m4"), (5, "m5"), (6, "m6")]
+    assert kafka.read("T", 0, 10, 5) == []  # at end: empty, not error
+
+
+def test_group_offsets(kafka):
+    kafka.create_topic("T", partitions=2)
+    assert kafka.get_offsets("g1", "T") == {}
+    kafka.commit_offsets("g1", "T", {0: 5, 1: 7})
+    assert kafka.get_offsets("g1", "T") == {0: 5, 1: 7}
+    kafka.commit_offsets("g1", "T", {0: 6})
+    assert kafka.get_offsets("g1", "T")[0] == 6
+    assert kafka.get_offsets("g2", "T") == {}  # groups isolated
+
+
+def test_consume_iterator_over_kafka(kafka):
+    kafka.create_topic("T", partitions=2)
+    prod = TopicProducer(kafka, "T")
+    for i in range(6):
+        prod.send(f"k{i}", f"m{i}")
+    with ConsumeDataIterator(kafka, "T", group="g", start="earliest") as it:
+        got = {next(it).message for _ in range(6)}
+        assert got == {f"m{i}" for i in range(6)}
+        it.commit()
+    # committed resume: only new messages are seen
+    prod.send("k9", "m9")
+    with ConsumeDataIterator(kafka, "T", group="g", start="committed") as it2:
+        assert next(it2) == KeyMessage("k9", "m9")
+
+
+def test_get_broker_resolves_and_caches_kafka_uri():
+    with LocalKafkaTestBroker() as server:
+        a = get_broker(server.uri)
+        b = get_broker(server.uri)
+        assert a is b
+        assert isinstance(a, KafkaBroker)
+        a.create_topic("X", partitions=1)
+        a.send("X", None, "hello")
+        assert a.read("X", 0, 0, 10)[0][2] == "hello"
+
+
+def test_large_message_roundtrip(kafka):
+    """An oversized MODEL payload (multi-MB) survives produce/fetch."""
+    kafka.create_topic("T", partitions=1, max_message_bytes=32 << 20)
+    big = "x" * (5 << 20)
+    kafka.send("T", "MODEL", big)
+    recs = kafka.read("T", 0, 0, 1)
+    assert recs[0][1] == "MODEL" and recs[0][2] == big
+
+
+def test_unicode_and_empty_payloads(kafka):
+    kafka.create_topic("T", partitions=1)
+    kafka.send("T", "clé", "värde-☃")
+    kafka.send("T", None, "")
+    recs = kafka.read("T", 0, 0, 10)
+    assert recs[0][1] == "clé" and recs[0][2] == "värde-☃"
+    assert recs[1][1] is None and recs[1][2] == ""
+
+
+# -- the full lambda slice over kafka:// ------------------------------------
+
+def test_e2e_batch_to_serving_over_kafka(tmp_path):
+    """Batch layer trains and publishes over a kafka:// update topic; the
+    serving layer replays it and answers /recommend — the deployment
+    topology of the reference with a real wire protocol in between."""
+    import json
+    import time
+    import urllib.request
+
+    import numpy as np
+
+    from oryx_tpu.apps.als.batch import ALSUpdate
+    from oryx_tpu.apps.als.serving import ALSServingModelManager
+    from oryx_tpu.bus.broker import topics
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.common.rng import RandomManager
+    from oryx_tpu.layers import BatchLayer
+    from oryx_tpu.serving.server import ServingLayer
+
+    RandomManager.use_test_seed(11)
+    with LocalKafkaTestBroker() as server:
+        uri = server.uri
+        cfg = load_config(
+            overlay={
+                "oryx.id": "kafka-e2e",
+                "oryx.input-topic.broker": uri,
+                "oryx.update-topic.broker": uri,
+                "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+                "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+                "oryx.serving.api.port": 0,
+                "oryx.serving.application-resources": [
+                    "oryx_tpu.serving.resources.common",
+                    "oryx_tpu.serving.resources.als",
+                ],
+                "oryx.als.hyperparams.features": 4,
+                "oryx.als.hyperparams.iterations": 4,
+                "oryx.ml.eval.test-fraction": 0.1,
+                "oryx.serving.min-model-load-fraction": 0.8,
+            }
+        )
+        topics.maybe_create(uri, "OryxInput", partitions=2)
+        topics.maybe_create(uri, "OryxUpdate", partitions=1)
+        broker = get_broker(uri)
+
+        rng = np.random.default_rng(0)
+        prod = TopicProducer(broker, "OryxInput")
+        for u in range(24):
+            for i in rng.choice(16, 4, replace=False):
+                prod.send(f"u{u}", f"u{u},i{i},1,{1000 + int(i)}")
+
+        batch = BatchLayer(cfg, update=ALSUpdate(cfg))
+        batch.ensure_streams()
+        batch._consumer.seek({p: 0 for p in batch._consumer.positions()})
+        n = batch.run_generation(timestamp_ms=1_700_000_000_000)
+        assert n == 24 * 4
+        batch.close()
+
+        serving = ServingLayer(cfg, model_manager=ALSServingModelManager(cfg))
+        serving.start()
+        try:
+            base = f"http://127.0.0.1:{serving.port}"
+            deadline = time.time() + 30
+            status = None
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(f"{base}/ready", timeout=5) as resp:
+                        status = resp.status
+                        break
+                except urllib.error.HTTPError as e:
+                    status = e.code
+                    if status != 503:
+                        break
+                time.sleep(0.2)
+            assert status == 200, f"serving never ready over kafka ({status})"
+            with urllib.request.urlopen(f"{base}/recommend/u5?howMany=3", timeout=10) as resp:
+                recs = json.loads(resp.read())
+            assert len(recs) == 3
+        finally:
+            serving.close()
+
+
+# -- external cluster (opt-in) ----------------------------------------------
+
+@pytest.mark.skipif(
+    not os.environ.get("ORYX_KAFKA_BROKER"),
+    reason="set ORYX_KAFKA_BROKER=host:port to test against a real cluster",
+)
+def test_contract_against_external_cluster():
+    import uuid
+
+    broker = KafkaBroker(parse_bootstrap(f"kafka://{os.environ['ORYX_KAFKA_BROKER']}"))
+    topic = f"oryx-test-{uuid.uuid4().hex[:12]}"
+    broker.create_topic(topic, partitions=2)
+    try:
+        broker.send(topic, "k", "v")
+        assert any(
+            broker.read(topic, p, 0, 10) for p in range(2)
+        )
+        broker.commit_offsets("oryx-test-g", topic, {0: 1})
+        assert broker.get_offsets("oryx-test-g", topic)[0] == 1
+    finally:
+        broker.delete_topic(topic)
+        broker.close()
+
+
+def test_truncated_log_reset(tmp_path):
+    """A consumer starting at offset 0 on a retention-truncated partition
+    must resume from the earliest retained offset, not stall forever."""
+    with LocalKafkaTestBroker() as server:
+        broker = KafkaBroker([(server.host, server.port)])
+        broker.create_topic("T", partitions=1)
+        # two separate batches so truncation can drop the first whole batch
+        broker.send_batch("T", [(None, f"a{i}") for i in range(5)])
+        broker.send_batch("T", [(None, f"b{i}") for i in range(5)])
+        server.truncate("T", 0, 5)
+        recs = broker.read("T", 0, 0, 100)
+        assert [m for _, _, m in recs] == [f"b{i}" for i in range(5)]
+        assert recs[0][0] == 5  # real offsets, post-truncation
+        broker.close()

@@ -1,0 +1,199 @@
+"""Three-OS-process lambda deployment over a file:// broker — the real
+deployment topology (the reference runs batch/speed/serving as separate
+JVMs wired only by Kafka; AbstractLambdaIT boots real services the same
+way). Includes a serving-process kill -9 + restart asserting model recovery
+via earliest-replay of the update topic (ModelManagerListener.java:118-132).
+"""
+
+import json
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from oryx_tpu.bus.broker import get_broker
+from oryx_tpu.common.ioutil import choose_free_port
+
+REPO = str(pathlib.Path(__file__).resolve().parent.parent)
+
+
+def _http(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _spawn(cmd_flags):
+    return subprocess.Popen(
+        [sys.executable, "-m", "oryx_tpu.cli", *cmd_flags],
+        cwd=REPO,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+        start_new_session=True,
+    )
+
+
+def _dead(proc, name):
+    if proc.poll() is not None:
+        raise AssertionError(
+            f"{name} process died rc={proc.returncode}: "
+            + proc.stderr.read().decode()[-2000:]
+        )
+
+
+@pytest.mark.slow
+def test_three_process_lambda_with_serving_crash_recovery(tmp_path):
+    bus = f"file://{tmp_path}/bus"
+    port = choose_free_port()
+    sets = [
+        "oryx.id=mp",
+        f"oryx.input-topic.broker={bus}",
+        f"oryx.update-topic.broker={bus}",
+        f"oryx.batch.storage.data-dir={tmp_path}/data",
+        f"oryx.batch.storage.model-dir={tmp_path}/model",
+        f"oryx.serving.api.port={port}",
+        "oryx.batch.streaming.generation-interval-sec=2",
+        "oryx.speed.streaming.generation-interval-sec=1",
+        "oryx.batch.update-class=oryx_tpu.apps.als.batch.ALSUpdate",
+        "oryx.speed.model-manager-class=oryx_tpu.apps.als.speed.ALSSpeedModelManager",
+        "oryx.serving.model-manager-class=oryx_tpu.apps.als.serving.ALSServingModelManager",
+        'oryx.serving.application-resources='
+        '["oryx_tpu.serving.resources.common","oryx_tpu.serving.resources.als"]',
+        "oryx.als.hyperparams.features=4",
+        "oryx.als.hyperparams.iterations=4",
+        "oryx.ml.eval.test-fraction=0.1",
+        "oryx.speed.min-model-load-fraction=0.8",
+        "oryx.serving.min-model-load-fraction=0.8",
+    ]
+    flags = [x for s in sets for x in ("--set", s)]
+
+    setup = subprocess.run(
+        [sys.executable, "-m", "oryx_tpu.cli", "setup", *flags],
+        cwd=REPO, capture_output=True, timeout=60,
+    )
+    assert setup.returncode == 0, setup.stderr.decode()
+
+    broker = get_broker(bus)
+    procs: dict[str, subprocess.Popen] = {}
+    try:
+        # ---- 1. batch + speed + serving as real processes ----
+        procs["batch"] = _spawn(["batch", *flags])
+        procs["speed"] = _spawn(["speed", *flags])
+        procs["serving"] = _spawn(["serving", *flags])
+
+        # wait until the batch consumer group pinned its start position —
+        # input sent before that would be after its "latest" start point
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            _dead(procs["batch"], "batch")
+            if broker.get_offsets("OryxGroup-mp-batch", "OryxInput"):
+                break
+            time.sleep(0.3)
+        else:
+            raise AssertionError("batch layer never pinned start offsets")
+
+        # ---- 2. feed interactions through the input topic ----
+        rng = np.random.default_rng(1)
+        lines = []
+        for u in range(30):
+            for i in rng.choice(20, 5, replace=False):
+                lines.append(f"u{u},i{i},1,{1000 + int(i)}")
+        pump = subprocess.run(
+            [sys.executable, "-m", "oryx_tpu.cli", "input", *flags],
+            cwd=REPO, input="\n".join(lines).encode(),
+            capture_output=True, timeout=60,
+        )
+        assert pump.returncode == 0, pump.stderr.decode()
+
+        # ---- 3. serving becomes ready from the batch-published model ----
+        base = f"http://127.0.0.1:{port}"
+        deadline = time.time() + 90
+        status = None
+        while time.time() < deadline:
+            for name in ("batch", "speed", "serving"):
+                _dead(procs[name], name)
+            try:
+                status, _ = _http(f"{base}/ready")
+                if status == 200:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert status == 200, "serving never became ready"
+
+        status, body = _http(f"{base}/recommend/u5?howMany=3")
+        assert status == 200, body
+        first_recs = json.loads(body)
+        assert len(first_recs) == 3
+
+        # ---- 4. speed layer folds a brand-new user in ----
+        status, _ = _http_post(f"{base}/pref/brandnew/i3", b"5.0")
+        assert status == 200
+        status, _ = _http_post(f"{base}/pref/brandnew/i7", b"5.0")
+        assert status == 200
+        deadline = time.time() + 60
+        got = None
+        while time.time() < deadline:
+            _dead(procs["speed"], "speed")
+            status, body = _http(f"{base}/recommend/brandnew?howMany=3")
+            if status == 200:
+                got = json.loads(body)
+                break
+            time.sleep(0.5)
+        assert got is not None, "speed fold-in never reached serving"
+
+        # ---- 5. kill -9 serving mid-stream; restart; model recovers ----
+        proc = procs.pop("serving")
+        import os
+
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+        procs["serving"] = _spawn(["serving", *flags])
+        deadline = time.time() + 90
+        status = None
+        while time.time() < deadline:
+            _dead(procs["serving"], "serving")
+            try:
+                status, _ = _http(f"{base}/ready")
+                if status == 200:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert status == 200, "restarted serving never recovered the model"
+        # recovered model answers queries again, incl. the folded-in user
+        status, body = _http(f"{base}/recommend/u5?howMany=3")
+        assert status == 200 and len(json.loads(body)) == 3
+        status, body = _http(f"{base}/recommend/brandnew?howMany=3")
+        assert status == 200, "earliest-replay lost the speed-layer update"
+    finally:
+        import os
+
+        for name, proc in procs.items():
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        for name, proc in procs.items():
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                os.killpg(proc.pid, signal.SIGKILL)
+                proc.wait(timeout=5)
+
+
+def _http_post(url, body, timeout=10):
+    req = urllib.request.Request(url, method="POST", data=body)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
