@@ -1,0 +1,306 @@
+"""Asyncio HTTP frontend for the serving layer.
+
+The reference serving layer runs a 400-thread Tomcat with HTTP/1.1-NIO2 +
+HTTP/2 connectors (framework/oryx-lambda-serving .../ServingLayer.java:
+58-339). A thread-per-connection stdlib server is the Python analogue of
+old blocking Tomcat; this module is the NIO analogue: one event loop owns
+every connection (accept/read/write never hold a thread each), and only
+the blocking part of a request — ``ServingApp.dispatch``, which may park
+on the device micro-batcher — occupies a worker-pool thread. Connection
+count therefore scales independently of thread count, and the worker pool
+bounds in-flight dispatches the way Tomcat's executor bounds request
+threads.
+
+Selected by ``oryx.serving.api.server = "async"`` (the default;
+``"threaded"`` keeps the stdlib ThreadingHTTPServer path). Both frontends
+share auth, gzip, and dispatch semantics; tests run the same suite against
+each.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gzip
+import logging
+import ssl
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from urllib.parse import parse_qs, urlsplit
+
+from oryx_tpu.serving.app import Request, ServingApp
+from oryx_tpu.serving.auth import Authenticator
+
+log = logging.getLogger(__name__)
+
+MAX_HEADER_BYTES = 64 * 1024
+MAX_BODY_BYTES = 256 * 1024 * 1024
+READ_TIMEOUT = 30.0
+
+_COMMON_STATUS = {
+    200: b"200 OK",
+    204: b"204 No Content",
+    400: b"400 Bad Request",
+    401: b"401 Unauthorized",
+    404: b"404 Not Found",
+    405: b"405 Method Not Allowed",
+    500: b"500 Internal Server Error",
+    503: b"503 Service Unavailable",
+}
+
+
+class AsyncHTTPServer:
+    """Event-loop HTTP/1.1 server wrapping a ServingApp.
+
+    Runs its asyncio loop on a dedicated thread so it presents the same
+    synchronous start()/close() surface as the threaded frontend.
+    """
+
+    def __init__(
+        self,
+        app: ServingApp,
+        auth: Authenticator | None,
+        port: int,
+        ssl_context: ssl.SSLContext | None = None,
+        workers: int = 128,
+    ):
+        self.app = app
+        self.auth = auth
+        self.port = port
+        self._ssl = ssl_context
+        self._pool = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="oryx-serving-worker"
+        )
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self._thread: threading.Thread | None = None
+        self._started = threading.Event()
+        self._start_error: BaseException | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run_loop, name="oryx-serving-aio", daemon=True
+        )
+        self._thread.start()
+        self._started.wait(timeout=30)
+        if self._start_error is not None:
+            raise self._start_error
+        if self._server is None:
+            raise RuntimeError("async serving frontend failed to start")
+
+    def close(self) -> None:
+        loop = self._loop
+        if loop is not None and loop.is_running():
+            fut = asyncio.run_coroutine_threadsafe(self._shutdown(), loop)
+            try:
+                fut.result(timeout=10)
+            except Exception:  # pragma: no cover - defensive
+                pass
+            loop.call_soon_threadsafe(loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._pool.shutdown(wait=False)
+
+    async def _shutdown(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    def _run_loop(self) -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+        try:
+            self._server = loop.run_until_complete(
+                asyncio.start_server(
+                    self._handle_conn,
+                    "0.0.0.0",
+                    self.port,
+                    ssl=self._ssl,
+                    backlog=1024,
+                )
+            )
+            self.port = self._server.sockets[0].getsockname()[1]
+        except BaseException as e:  # surface bind errors to start()
+            self._start_error = e
+            self._started.set()
+            loop.close()
+            return
+        self._started.set()
+        try:
+            loop.run_forever()
+        finally:
+            loop.run_until_complete(loop.shutdown_asyncgens())
+            loop.close()
+
+    # -- per-connection protocol ------------------------------------------
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                try:
+                    head = await asyncio.wait_for(
+                        reader.readuntil(b"\r\n\r\n"), timeout=READ_TIMEOUT
+                    )
+                except (
+                    asyncio.IncompleteReadError,
+                    asyncio.TimeoutError,
+                    ConnectionError,
+                ):
+                    return
+                except asyncio.LimitOverrunError:
+                    await self._simple_response(writer, 400, b"headers too large")
+                    return
+                if len(head) > MAX_HEADER_BYTES:
+                    await self._simple_response(writer, 400, b"headers too large")
+                    return
+
+                lines = head.split(b"\r\n")
+                try:
+                    method_b, target_b, version_b = lines[0].split(b" ", 2)
+                    method = method_b.decode("ascii")
+                    target = target_b.decode("ascii")
+                except (ValueError, UnicodeDecodeError):
+                    await self._simple_response(writer, 400, b"bad request line")
+                    return
+                headers: dict[str, str] = {}
+                for ln in lines[1:]:
+                    if not ln:
+                        continue
+                    i = ln.find(b":")
+                    if i <= 0:
+                        continue
+                    headers[ln[:i].decode("latin-1").lower()] = (
+                        ln[i + 1 :].strip().decode("latin-1")
+                    )
+
+                if "chunked" in headers.get("transfer-encoding", "").lower():
+                    await self._simple_response(
+                        writer, 400, b"chunked bodies not supported"
+                    )
+                    return
+                try:
+                    length = int(headers.get("content-length") or 0)
+                except ValueError:
+                    await self._simple_response(writer, 400, b"bad content-length")
+                    return
+                if length > MAX_BODY_BYTES:
+                    await self._simple_response(writer, 400, b"body too large")
+                    return
+                body = b""
+                if length:
+                    try:
+                        body = await asyncio.wait_for(
+                            reader.readexactly(length), timeout=READ_TIMEOUT
+                        )
+                    except (
+                        asyncio.IncompleteReadError,
+                        asyncio.TimeoutError,
+                        ConnectionError,
+                    ):
+                        return
+
+                keep_alive = (
+                    headers.get("connection", "").lower() != "close"
+                    and version_b != b"HTTP/1.0"
+                )
+                await self._handle_request(writer, method, target, headers, body)
+                if not keep_alive:
+                    return
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _handle_request(
+        self,
+        writer: asyncio.StreamWriter,
+        method: str,
+        target: str,
+        headers: dict[str, str],
+        body: bytes,
+    ) -> None:
+        if self.auth is not None:
+            verdict = self.auth.check(method, target, headers.get("authorization"))
+            if verdict is not True:
+                payload = b'{"status":401,"error":"unauthorized"}'
+                await self._write_response(
+                    writer,
+                    401,
+                    payload,
+                    "application/json",
+                    method,
+                    extra=(("WWW-Authenticate", verdict),),
+                )
+                return
+
+        split = urlsplit(target)
+        if headers.get("content-encoding", "").lower() == "gzip" and body:
+            try:
+                body = gzip.decompress(body)
+            except OSError:
+                await self._simple_response(writer, 400, b"bad gzip body")
+                return
+        req = Request(
+            method=method,
+            path=split.path,
+            params={},
+            query=parse_qs(split.query),
+            body=body,
+            headers=headers,
+        )
+        loop = asyncio.get_running_loop()
+        try:
+            status, payload, ctype = await loop.run_in_executor(
+                self._pool, self.app.dispatch, req
+            )
+        except Exception:  # pragma: no cover - dispatch renders its own 500s
+            log.exception("dispatch failed")
+            status, payload, ctype = 500, b"internal error", "text/plain"
+
+        gzip_ok = "gzip" in headers.get("accept-encoding", "").lower()
+        await self._write_response(
+            writer, status, payload, ctype, method, gzip_ok=gzip_ok
+        )
+
+    async def _write_response(
+        self,
+        writer: asyncio.StreamWriter,
+        status: int,
+        payload: bytes,
+        ctype: str,
+        method: str,
+        gzip_ok: bool = False,
+        extra: tuple[tuple[str, str], ...] = (),
+    ) -> None:
+        status_line = _COMMON_STATUS.get(status) or f"{status} Status".encode()
+        parts = [
+            b"HTTP/1.1 ",
+            status_line,
+            b"\r\nContent-Type: ",
+            ctype.encode("latin-1"),
+            b"\r\nVary: Accept-Encoding",
+        ]
+        if gzip_ok and len(payload) >= 1024:
+            payload = gzip.compress(payload, compresslevel=5)
+            parts.append(b"\r\nContent-Encoding: gzip")
+        for k, v in extra:
+            parts.append(f"\r\n{k}: {v}".encode("latin-1"))
+        parts.append(f"\r\nContent-Length: {len(payload)}\r\n\r\n".encode("ascii"))
+        if method != "HEAD":
+            parts.append(payload)
+        writer.write(b"".join(parts))
+        try:
+            await writer.drain()
+        except ConnectionError:
+            pass
+
+    async def _simple_response(
+        self, writer: asyncio.StreamWriter, status: int, msg: bytes
+    ) -> None:
+        await self._write_response(writer, status, msg, "text/plain", "GET")

@@ -51,6 +51,7 @@ class ServingLayer:
         self._listener: threading.Thread | None = None
         self._httpd: ThreadingHTTPServer | None = None
         self._http_thread: threading.Thread | None = None
+        self._aio_server = None
         self.app: ServingApp | None = None
 
     def start(self) -> None:
@@ -83,10 +84,10 @@ class ServingLayer:
         self._listener.start()
 
         self.app = ServingApp(self.config, self.model_manager, input_producer)
-        handler = _make_handler(self.app, make_authenticator(self.config))
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+        auth = make_authenticator(self.config)
         cert = self.config.get_string("oryx.serving.api.ssl-cert-file", None)
         key = self.config.get_string("oryx.serving.api.ssl-key-file", None)
+        ctx = None
         if cert:
             # TLS termination in-process (the reference's Tomcat keystore
             # connector, ServingLayer.java:58-339 — PEM instead of JKS)
@@ -94,24 +95,46 @@ class ServingLayer:
 
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(cert, key or None)
-            # defer the handshake to the per-connection handler thread —
-            # with the default handshake-on-accept, one client that opens a
-            # socket and never speaks TLS would block the accept loop
-            self._httpd.socket = ctx.wrap_socket(
-                self._httpd.socket, server_side=True, do_handshake_on_connect=False
+
+        frontend = self.config.get_string("oryx.serving.api.server", "async")
+        if frontend == "async":
+            from oryx_tpu.serving.aserver import AsyncHTTPServer
+
+            self._aio_server = AsyncHTTPServer(
+                self.app,
+                auth,
+                self.port,
+                ssl_context=ctx,
+                workers=self.config.get_int("oryx.serving.api.workers", 128),
             )
-        self.port = self._httpd.server_address[1]
-        self._http_thread = threading.Thread(
-            target=self._httpd.serve_forever, name="oryx-serving-http", daemon=True
-        )
-        self._http_thread.start()
-        log.info("serving layer listening on :%d", self.port)
+            self._aio_server.start()
+            self.port = self._aio_server.port
+        else:
+            handler = _make_handler(self.app, auth)
+            self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+            if ctx is not None:
+                # defer the handshake to the per-connection handler thread —
+                # with the default handshake-on-accept, one client that opens
+                # a socket and never speaks TLS would block the accept loop
+                self._httpd.socket = ctx.wrap_socket(
+                    self._httpd.socket, server_side=True, do_handshake_on_connect=False
+                )
+            self.port = self._httpd.server_address[1]
+            self._http_thread = threading.Thread(
+                target=self._httpd.serve_forever, name="oryx-serving-http", daemon=True
+            )
+            self._http_thread.start()
+        log.info("serving layer listening on :%d (%s)", self.port, frontend)
 
     def await_termination(self) -> None:
+        if self._aio_server and self._aio_server._thread:
+            self._aio_server._thread.join()
         if self._http_thread:
             self._http_thread.join()
 
     def close(self) -> None:
+        if self._aio_server:
+            self._aio_server.close()
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()

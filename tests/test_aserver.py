@@ -1,0 +1,207 @@
+"""Async HTTP frontend: parity with the threaded frontend plus protocol
+edge cases (keep-alive, bad requests, concurrent clients, auth 401s).
+
+The reference runs one serving stack under Tomcat NIO; here the same
+ServingApp runs under either frontend, so the same requests must behave
+identically under both (oryx.serving.api.server = async | threaded).
+"""
+
+from __future__ import annotations
+
+import gzip
+import http.client
+import json
+import socket
+import threading
+
+import pytest
+
+from oryx_tpu.bus.broker import get_broker
+from oryx_tpu.common.config import load_config
+from oryx_tpu.serving.server import ServingLayer
+
+FRONTENDS = ("async", "threaded")
+
+
+def _config(bus: str, frontend: str, **extra):
+    overlay = {
+        "oryx.input-topic.broker": bus,
+        "oryx.update-topic.broker": bus,
+        "oryx.serving.api.port": 0,
+        "oryx.serving.api.server": frontend,
+        "oryx.serving.model-manager-class": "oryx_tpu.apps.example.serving.ExampleServingModelManager",
+        "oryx.serving.application-resources": [
+            "oryx_tpu.serving.resources.common",
+            "oryx_tpu.serving.resources.example",
+        ],
+    }
+    overlay.update(extra)
+    return load_config(overlay=overlay)
+
+
+def _setup_bus(bus: str):
+    broker = get_broker(bus)
+    for t in ("OryxInput", "OryxUpdate"):
+        if not broker.topic_exists(t):
+            broker.create_topic(t, 1)
+    broker.send("OryxUpdate", "MODEL", json.dumps({"big": 1, "word": 2}))
+    return broker
+
+
+def _wait_ready(port: int) -> None:
+    for _ in range(100):
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+            conn.request("GET", "/ready")
+            if conn.getresponse().status == 200:
+                conn.close()
+                return
+        except Exception:
+            pass
+        import time
+
+        time.sleep(0.1)
+    raise TimeoutError("serving layer never became ready")
+
+
+@pytest.mark.parametrize("frontend", FRONTENDS)
+def test_frontend_parity(frontend):
+    """GET/POST/HEAD/404/keep-alive behave identically on both frontends."""
+    bus = f"mem://aserver-{frontend}"
+    _setup_bus(bus)
+    with ServingLayer(_config(bus, frontend)) as sl:
+        _wait_ready(sl.port)
+        conn = http.client.HTTPConnection("127.0.0.1", sl.port, timeout=5)
+
+        # several requests on ONE keep-alive connection
+        for _ in range(3):
+            conn.request("GET", "/distinct")
+            r = conn.getresponse()
+            assert r.status == 200
+            assert json.loads(r.read())["word"] == 2
+
+        # HEAD: headers only
+        conn.request("HEAD", "/ready")
+        r = conn.getresponse()
+        assert r.status == 200
+        assert r.read() == b""
+
+        # 404 with JSON error body
+        conn.request("GET", "/no-such-endpoint")
+        r = conn.getresponse()
+        assert r.status == 404
+        assert json.loads(r.read())["status"] == 404
+
+        # POST /add ingests a line
+        conn.request("POST", "/add", body=b"hello world")
+        r = conn.getresponse()
+        assert r.status in (200, 204)
+        r.read()
+
+        # gzipped request body is transparently decompressed
+        conn.request(
+            "POST",
+            "/add",
+            body=gzip.compress(b"more words"),
+            headers={"Content-Encoding": "gzip"},
+        )
+        r = conn.getresponse()
+        assert r.status in (200, 204)
+        r.read()
+        conn.close()
+
+
+@pytest.mark.parametrize("frontend", FRONTENDS)
+def test_frontend_auth_challenge(frontend):
+    """Unauthenticated requests get a 401 digest challenge; authenticated
+    clients succeed (urllib's digest handler drives the RFC exchange)."""
+    import urllib.request
+
+    bus = f"mem://aserver-auth-{frontend}"
+    _setup_bus(bus)
+    cfg = _config(
+        bus,
+        frontend,
+        **{
+            "oryx.serving.api.user-name": "oryx",
+            "oryx.serving.api.password": "secret",
+        },
+    )
+    with ServingLayer(cfg) as sl:
+        url = f"http://127.0.0.1:{sl.port}/ready"
+        conn = http.client.HTTPConnection("127.0.0.1", sl.port, timeout=5)
+        conn.request("GET", "/ready")
+        r = conn.getresponse()
+        assert r.status == 401
+        challenge = r.getheader("WWW-Authenticate")
+        assert challenge and challenge.startswith("Digest")
+        r.read()
+        conn.close()
+
+        mgr = urllib.request.HTTPPasswordMgrWithDefaultRealm()
+        mgr.add_password(None, url, "oryx", "secret")
+        opener = urllib.request.build_opener(
+            urllib.request.HTTPDigestAuthHandler(mgr)
+        )
+        with opener.open(url, timeout=5) as resp:
+            assert resp.status == 200
+
+
+def test_async_concurrent_clients():
+    """32 threads hammer one async server; every response is correct."""
+    bus = "mem://aserver-conc"
+    _setup_bus(bus)
+    with ServingLayer(_config(bus, "async")) as sl:
+        _wait_ready(sl.port)
+        errs: list[str] = []
+
+        def worker():
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", sl.port, timeout=10)
+                for _ in range(20):
+                    conn.request("GET", "/distinct/word")
+                    r = conn.getresponse()
+                    body = r.read()
+                    if r.status != 200 or json.loads(body) != 2:
+                        errs.append(f"bad response {r.status} {body[:80]!r}")
+                conn.close()
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        threads = [threading.Thread(target=worker) for _ in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs, errs[:5]
+
+
+def test_async_malformed_requests():
+    """Garbage on the socket gets a 400 (or a clean close), never a hang."""
+    bus = "mem://aserver-bad"
+    _setup_bus(bus)
+    with ServingLayer(_config(bus, "async")) as sl:
+        _wait_ready(sl.port)
+
+        # bad request line
+        s = socket.create_connection(("127.0.0.1", sl.port), timeout=5)
+        s.sendall(b"NONSENSE\r\n\r\n")
+        data = s.recv(4096)
+        assert data == b"" or b"400" in data.split(b"\r\n")[0]
+        s.close()
+
+        # huge declared content-length is rejected, not buffered
+        s = socket.create_connection(("127.0.0.1", sl.port), timeout=5)
+        s.sendall(
+            b"POST /add HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: 99999999999\r\n\r\n"
+        )
+        data = s.recv(4096)
+        assert b"400" in data.split(b"\r\n")[0]
+        s.close()
+
+        # server is still healthy afterwards
+        conn = http.client.HTTPConnection("127.0.0.1", sl.port, timeout=5)
+        conn.request("GET", "/ready")
+        assert conn.getresponse().status == 200
+        conn.close()
