@@ -93,10 +93,11 @@ class ALSServingModel(ServingModel):
     # -- device scoring view ----------------------------------------------
 
     def _y_view_full(self) -> tuple:
-        """(device Y matrix, row ids, version) resynced lazily on version
-        drift — a double-buffered atomic tuple swap instead of the
-        reference's fine-grained read locks on the hot path. Staleness probe
-        is a cheap version read; the full arena copies only on drift."""
+        """(device Y matrix, row ids, version, host Y matrix) resynced
+        lazily on version drift — a double-buffered atomic tuple swap
+        instead of the reference's fine-grained read locks on the hot path.
+        Staleness probe is a cheap version read; the full arena copies only
+        on drift."""
         view = self._device_view
         version = self.state.y.get_version()
         if view is not None and view[2] == version:
@@ -106,7 +107,14 @@ class ALSServingModel(ServingModel):
             if view is not None and view[2] == self.state.y.get_version():
                 return view
             mat, ids, version = self.state.y.snapshot()
-            view = (jnp.asarray(mat), ids, version)
+            # bf16 scoring view: halves the HBM traffic of the memory-bound
+            # top-k scan. Scores accumulate in f32 on the MXU; at 1M x 50f
+            # the bf16 ranking matched f32 index-for-index (pallas_topk.py).
+            # The f32 host matrix rides along for the exact candidate
+            # re-rank — row-aligned with the device view by construction,
+            # read lock-free on the request path.
+            mat = np.asarray(mat, dtype=np.float32)
+            view = (jnp.asarray(mat, dtype=jnp.bfloat16), ids, version, mat)
             self._device_view = view
         return view
 
@@ -117,20 +125,21 @@ class ALSServingModel(ServingModel):
     def _y_unit_view(self):
         """Row-normalized Y for cosine queries, cached per store version so
         the O(N.K) normalization runs once per model drift, not per request.
-        y/ids/version come from ONE view tuple — re-reading the version
-        separately could cache a stale matrix under a newer stamp."""
-        y, ids, version = self._y_view_full()
+        y/ids/version/host matrix come from ONE view tuple — re-reading the
+        version separately could cache a stale matrix under a newer stamp."""
+        y, ids, version, host_mat = self._y_view_full()
         view = self._unit_view
         if view is not None and view[2] == version:
-            return view[0], view[1]
+            return view[0], view[1], view[3]
         with self._sync_lock:
             view = self._unit_view
             if view is not None and view[2] == version:
-                return view[0], view[1]
-            norms = jnp.maximum(jnp.linalg.norm(y, axis=1, keepdims=True), 1e-12)
-            view = (y / norms, ids, version)
+                return view[0], view[1], view[3]
+            yf = y.astype(jnp.float32)
+            norms = jnp.maximum(jnp.linalg.norm(yf, axis=1, keepdims=True), 1e-12)
+            view = ((yf / norms).astype(y.dtype), ids, version, host_mat)
             self._unit_view = view
-        return view[0], view[1]
+        return view[0], view[1], view[3]
 
     # -- queries -----------------------------------------------------------
 
@@ -163,7 +172,10 @@ class ALSServingModel(ServingModel):
             top = top[np.argsort(-sub[top])]
             vals, idx = sub[top], rows[top]
         else:
-            y, ids = self._y_unit_view() if cosine else self._y_view()
+            if cosine:
+                y, ids, host_mat = self._y_unit_view()
+            else:
+                y, ids, _v, host_mat = self._y_view_full()
             n = len(ids)
             if n == 0:
                 return []
@@ -173,6 +185,12 @@ class ALSServingModel(ServingModel):
             # a data-dependent k would recompile per exclusion-set size.
             k = min(n, how_many + len(exclude) + 8)
             vals, idx = TopKBatcher.shared().submit(user_vector, k, y)
+            # The device scan selects candidates in bf16 (half the HBM
+            # traffic of the memory-bound sweep); near-ties inside the
+            # candidate set are then re-ranked EXACTLY by one vectorized
+            # f32 gather against the row-aligned host matrix — k*features
+            # flops, noise next to the 1M-row scan it corrects.
+            vals, idx = _rerank_exact(user_vector, vals, idx, host_mat, cosine)
         out = []
         for v, j in zip(np.asarray(vals), np.asarray(idx)):
             ident = ids[int(j)]
@@ -276,6 +294,20 @@ class ALSServingModel(ServingModel):
         out = [(u, len(s)) for u, s in self.state.known_items_snapshot().items()]
         out.sort(key=lambda t: (-t[1], t[0]))
         return out[:how_many]
+
+
+def _rerank_exact(user_vector, vals, idx, host_mat: np.ndarray, cosine: bool):
+    """Recompute candidate scores with one vectorized f32 gather against
+    the host matrix row-aligned with the device view, and re-sort. Lock-free
+    and O(k*features) — no per-row store reads on the request path."""
+    idx = np.asarray(idx)
+    uv = np.asarray(user_vector, dtype=np.float32)
+    rows = host_mat[idx]
+    vals = rows @ uv
+    if cosine:
+        vals = vals / np.maximum(np.linalg.norm(rows, axis=1), 1e-12)
+    order = np.argsort(-vals, kind="stable")
+    return vals[order], idx[order]
 
 
 class ALSServingModelManager(AbstractServingModelManager):

@@ -674,11 +674,16 @@ _pallas_failed_shapes: set = set()
 
 def topk_dot_batch(xs, y, *, k: int):
     """Batched top-k scoring with automatic kernel selection: the fused
-    streaming Pallas kernel on TPU (measured ~4x over matmul+top_k at
-    1M items x 50 features, and it never materializes the [B,I] scores),
-    plain XLA elsewhere. A kernel failure only disables that exact
-    (shapes, k) signature — standard serving shapes keep the fast path."""
+    streaming Pallas kernel on TPU (measured 1.98x over matmul+top_k at
+    4096 queries x 1M items x 50 features bf16 on v5e, with exact index
+    agreement, and it never materializes the [B,I] scores), plain XLA
+    elsewhere. A kernel failure only disables that exact (shapes, k)
+    signature — standard serving shapes keep the fast path."""
     n_items = y.shape[0]
+    if xs.dtype != y.dtype:
+        # mixed-precision queries score in the matrix's dtype (the bf16
+        # serving view); accumulation is f32 either way
+        xs = jnp.asarray(xs, dtype=y.dtype)
     sig = (xs.shape, y.shape, xs.dtype, y.dtype, k)
     if (
         k <= 16

@@ -81,7 +81,7 @@ def topk_dot_batch_pallas(
     *,
     k: int,
     block_b: int = 128,
-    block_i: int = 8192,
+    block_i: int = 4096,
     interpret: bool = False,
 ):
     """Top-k of xs @ y.T per row without materializing the score matrix.
@@ -89,6 +89,11 @@ def topk_dot_batch_pallas(
     xs: [B, K] queries; y: [I, K] item factors; returns ([B, k] f32 scores,
     [B, k] int32 indices), identical ordering to jax.lax.top_k. k <= 128.
     interpret=True runs the kernel in the Pallas interpreter (CPU tests).
+
+    block_i=4096 keeps the f32 working set (double-buffered Y block +
+    score block + the two merge candidate arrays) inside the 16 MB scoped
+    VMEM limit on v5e; 8192 overflows it. Measured on v5e at 4096 x 1M x
+    50f bf16 k=10: 94 ms vs 187 ms for the XLA matmul+top_k (1.98x).
     """
     if k > _LANE:
         raise ValueError(f"k must be <= {_LANE}, got {k}")

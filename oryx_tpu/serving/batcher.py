@@ -123,27 +123,44 @@ class TopKBatcher:
             self._thread.start()
 
     def _run(self) -> None:
+        # Depth-1 pipeline: launch batch N+1's device work (with async
+        # device->host copies) BEFORE materializing batch N's results. A
+        # blocking fetch without a prior copy_to_host_async costs a full
+        # synchronous transport round trip — measured 2600 ms (!) for a
+        # B=1 dispatch on the tunneled TPU vs 38 ms pipelined — so the
+        # overlap is not an optimization, it is the difference between a
+        # usable and an unusable serving tier on remote-attached devices.
+        inflight: list[tuple[list[_Pending], int, object, object]] = []
         while True:
             with self._cond:
-                while not self._queue and not self._closed:
+                while not self._queue and not self._closed and not inflight:
                     self._cond.wait()
-                if self._closed and not self._queue:
+                if self._closed and not self._queue and not inflight:
                     return
                 batch, self._queue = self._queue[: self.max_batch], self._queue[self.max_batch:]
             try:
-                self._dispatch(batch)
-            except Exception as e:  # pragma: no cover - defensive
-                log.exception("batcher dispatch failed")
+                launched = self._launch(batch) if batch else []
+            except Exception as e:  # pragma: no cover - defensive: a failure
+                # before the per-group guard (grouping, imports) must fail
+                # the whole batch, not kill the thread with futures pending
+                log.exception("batcher launch failed")
                 for p in batch:
                     if not p.future.done():
                         p.future.set_exception(e)
+                launched = []
+            for item in inflight:
+                self._resolve(item)
+            inflight = launched
 
-    def _dispatch(self, batch: list[_Pending]) -> None:
+    def _launch(
+        self, batch: list[_Pending]
+    ) -> list[tuple[list[_Pending], int, object, object]]:
+        """Issue one device dispatch per (matrix, k-bucket) group and start
+        the async result copies; returns the in-flight group handles."""
         import jax.numpy as jnp
 
         from oryx_tpu.ops.als import topk_dot_batch
 
-        # group by (target matrix identity, k bucket): one device call each
         groups: dict[tuple[int, int], list[_Pending]] = {}
         for p in batch:
             n = p.y.shape[0]
@@ -153,6 +170,7 @@ class TopKBatcher:
         self.dispatches += len(groups)
         self.coalesced += len(batch)
 
+        launched = []
         for (_, kb), group in groups.items():
             # failures stay inside their group: a bad shape / OOM against
             # one target matrix must not fail requests scoring another
@@ -164,13 +182,29 @@ class TopKBatcher:
                 for i, p in enumerate(group):
                     xs[i] = p.vec
                 vals, idx = topk_dot_batch(jnp.asarray(xs), y, k=kb)
-                vals = np.asarray(vals)
-                idx = np.asarray(idx)
-                for i, p in enumerate(group):
-                    k_eff = min(p.k, kb)
-                    p.future.set_result((vals[i, :k_eff], idx[i, :k_eff]))
+                try:
+                    vals.copy_to_host_async()
+                    idx.copy_to_host_async()
+                except AttributeError:  # non-jax array (tests with stubs)
+                    pass
+                launched.append((group, kb, vals, idx))
             except Exception as e:
                 log.exception("batcher group dispatch failed (k=%d)", kb)
                 for p in group:
                     if not p.future.done():
                         p.future.set_exception(e)
+        return launched
+
+    def _resolve(self, item: tuple[list[_Pending], int, object, object]) -> None:
+        group, kb, vals_dev, idx_dev = item
+        try:
+            vals = np.asarray(vals_dev)
+            idx = np.asarray(idx_dev)
+            for i, p in enumerate(group):
+                k_eff = min(p.k, kb)
+                p.future.set_result((vals[i, :k_eff], idx[i, :k_eff]))
+        except Exception as e:
+            log.exception("batcher group resolve failed (k=%d)", kb)
+            for p in group:
+                if not p.future.done():
+                    p.future.set_exception(e)

@@ -83,7 +83,8 @@ def test_dispatch_groups_by_matrix_and_bucket(y):
         k = 3 if i < 3 else 20
         vec = rng.normal(size=8).astype(np.float32)
         reqs.append(_Pending(vec, k, tgt, Future()))
-    b._dispatch(reqs)
+    for item in b._launch(reqs):
+        b._resolve(item)
     assert b.dispatches == 4  # 2 matrices x 2 k-buckets
     assert b.coalesced == 6
     for p in reqs:

@@ -61,7 +61,11 @@ def _make_config(tmp_path, port):
         "oryx.als.hyperparams.lambda": 0.01,
         "oryx.ml.eval.test-fraction": 0.1,
         "oryx.speed.min-model-load-fraction": 0.8,
-        "oryx.serving.min-model-load-fraction": 0.8,
+        # 1.0: the genre-ranking assertions below query top-5 content; at
+        # the default 0.8 the gate opens while the UP flood is still
+        # replaying and WHICH 20% of rows are missing is thread timing —
+        # a latent flake, not a model-quality signal
+        "oryx.serving.min-model-load-fraction": 1.0,
     })
 
 
