@@ -98,6 +98,10 @@ class ServingApp:
         self.input_producer = input_producer
         self.min_fraction = config.get_float("oryx.serving.min-model-load-fraction", 0.8)
         self.routes: list[_Route] = []
+        # app modules append (title, fn(app) -> rows) callbacks here; the
+        # generic /console renders each as its own table — the equivalent
+        # of the reference's per-app Console subclasses (e.g. als/Console.java)
+        self.console_sections: list[tuple[str, Callable[["ServingApp"], list[tuple[str, Any]]]]] = []
         reg = get_registry()
         self._m_requests = reg.counter(
             "oryx_serving_requests_total", "Serving requests by method and status"
@@ -147,10 +151,15 @@ class ServingApp:
 
     def send_input(self, line: str) -> None:
         """POST a raw input line to the input topic, keyed by its hash
-        (AbstractOryxResource.sendInput)."""
+        (AbstractOryxResource.sendInput). crc32, not hash(): the builtin is
+        salted per process (PYTHONHASHSEED), which would make partition
+        assignment — and thus cross-partition read interleaving — vary
+        between processes; the reference's hashCode partitioner is stable."""
         if self.input_producer is None:
             raise OryxServingException(405, "serving layer is read-only")
-        self.input_producer.send(str(abs(hash(line)) % (1 << 31)), line)
+        import zlib
+
+        self.input_producer.send(str(zlib.crc32(line.encode("utf-8"))), line)
 
     # -- dispatch ----------------------------------------------------------
 

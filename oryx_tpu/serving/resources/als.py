@@ -304,3 +304,19 @@ def register(app: ServingApp) -> None:
         a.send_input(join_csv([user, item, ""]))
         model.state.remove_known_item(user, item)
         return 200, None
+
+    def _als_console(a: ServingApp) -> list[tuple[str, object]]:
+        model = _model(a)  # 503s before the model is queryable
+        st = model.state
+        known = st.known_items_snapshot()
+        return [
+            ("users (X rows)", len(st.x)),
+            ("items (Y rows)", len(st.y)),
+            ("features", st.features),
+            ("feedback", "implicit" if st.implicit else "explicit"),
+            ("users with known items", len(known)),
+            ("known-item pairs", sum(len(s) for s in known.values())),
+            ("LSH sample rate", model.sample_rate),
+        ]
+
+    app.console_sections.append(("ALS model", _als_console))

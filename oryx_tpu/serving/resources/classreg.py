@@ -74,3 +74,21 @@ def register(app: ServingApp) -> None:
 
         send_input_lines(a, req.body_text(), "training examples")
         return 200, None
+
+    def _classreg_console(a: ServingApp) -> list[tuple[str, object]]:
+        model = a.get_serving_model()
+        imp = model.feature_importance()
+        schema = model.schema  # property on RDFServingModel, attr on PMML model
+        names = [
+            schema.feature_names[schema.predictor_to_feature_index(i)]
+            for i in range(len(imp))
+        ]
+        top = sorted(zip(names, imp), key=lambda t: -t[1])[:5]
+        rows: list[tuple[str, object]] = [
+            ("target", schema.target_feature),
+            ("type", "classification" if schema.is_classification() else "regression"),
+        ]
+        rows += [(f"importance: {n}", f"{v:.4f}") for n, v in top]
+        return rows
+
+    app.console_sections.append(("Forest model", _classreg_console))

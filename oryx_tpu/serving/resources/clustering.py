@@ -57,3 +57,20 @@ def register(app: ServingApp) -> None:
 
         send_input_lines(a, req.body_text())
         return 200, None
+
+    def _clustering_console(a: ServingApp) -> list[tuple[str, object]]:
+        model = a.get_serving_model()
+        counts = getattr(model, "counts", None)
+        rows: list[tuple[str, object]] = [("clusters", model.num_clusters)]
+        if counts is not None:
+            import numpy as _np
+
+            c = _np.asarray(counts)
+            rows += [
+                ("points assigned", int(c.sum())),
+                ("largest cluster", int(c.max()) if c.size else 0),
+                ("smallest cluster", int(c.min()) if c.size else 0),
+            ]
+        return rows
+
+    app.console_sections.append(("Clustering model", _clustering_console))

@@ -54,12 +54,31 @@ def register(app: ServingApp) -> None:
     @app.route("GET", "/console")
     def console(a: ServingApp, req: Request):
         """Human status page (the reference serves an HTML console per app,
-        e.g. .../als/Console.java): model state + the route table."""
+        e.g. .../als/Console.java): model state, app-specific sections
+        registered via app.console_sections, and the route table."""
         import html as _html
 
         model = a.model_manager.get_model()
         frac = model.fraction_loaded() if model is not None else 0.0
         manager = _html.escape(type(a.model_manager).__name__)
+
+        def table(pairs) -> str:
+            return "<table>" + "".join(
+                f"<tr><td>{_html.escape(str(k))}</td>"
+                f"<td>{_html.escape(str(v))}</td></tr>"
+                for k, v in pairs
+            ) + "</table>"
+
+        sections = []
+        for title, fn in a.console_sections:
+            try:
+                pairs = fn(a)
+            except OryxServingException:
+                pairs = [("status", "model not yet available")]
+            except Exception as e:  # noqa: BLE001 - console must render
+                pairs = [("error", f"{type(e).__name__}: {e}")]
+            sections.append(f"<h2>{_html.escape(title)}</h2>{table(pairs)}")
+
         rows = "".join(
             f"<tr><td>{_html.escape(r.method)}</td>"
             f"<td><code>{_html.escape(r.pattern.pattern)}</code></td></tr>"
@@ -74,6 +93,7 @@ def register(app: ServingApp) -> None:
             f"<p>Model loaded: <b>{frac:.0%}</b>"
             f"{' (serving)' if frac >= a.min_fraction else ' (warming up)'}</p>"
             f"<p><a href='/metrics'>metrics</a> &middot; <a href='/ready'>ready</a></p>"
+            f"{''.join(sections)}"
             f"<h2>Endpoints</h2><table><tr><th>method</th><th>path</th></tr>"
             f"{rows}</table></body></html>"
         )

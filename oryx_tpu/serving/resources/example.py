@@ -34,3 +34,10 @@ def register(app: ServingApp) -> None:
         # unlike /ingest, an empty flush has always been a 200 no-op here
         send_input_lines(a, req.body_text(), "lines", required=False)
         return 200, None
+
+    def _example_console(a: ServingApp) -> list[tuple[str, object]]:
+        model = a.get_serving_model()
+        words = model.get_words()
+        return [("distinct words", len(words))]
+
+    app.console_sections.append(("Word count model", _example_console))

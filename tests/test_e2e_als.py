@@ -76,7 +76,12 @@ def _genre_events(n_users=40, n_items=32, per_user=6, groups=4, seed=3):
         g = u % groups
         items = rng.choice(np.arange(g, n_items, groups), per_user, replace=False)
         for ts, i in enumerate(items):
-            lines.append(f"u{u},i{i},{1 + int(rng.poisson(1))},{1000 + ts}")
+            # timestamps unique per event: the time-based train/test split
+            # breaks timestamp ties by arrival order, and arrival order
+            # through the partitioned input topic depends on the line-hash
+            # partitioner (PYTHONHASHSEED) — tied stamps made the split,
+            # and hence the model, vary run to run
+            lines.append(f"u{u},i{i},{1 + int(rng.poisson(1))},{1000 + ts * 1000 + u}")
     return lines
 
 
@@ -124,6 +129,10 @@ def test_full_lambda_slice(tmp_path):
             break
         time.sleep(0.1)
     assert status == 200, "serving never became ready"
+
+    # per-app console section (the reference's als/Console.java analogue)
+    status, resp = _http("GET", f"{base}/console")
+    assert status == 200 and "ALS model" in resp and "features" in resp
 
     # ---- query the REST surface ----
     status, resp = _http("GET", f"{base}/recommend/u5?howMany=5")

@@ -162,3 +162,25 @@ def test_clustering_rest_surface():
         in_topic = cfg.get_string("oryx.input-topic.message.topic")
         recs = broker.read(in_topic, 0, 0, 10)
         assert any(m == "3.0,4.0" for _, _, m in recs)
+
+
+def test_clustering_console_section():
+    port = choose_free_port()
+    cfg = _cfg(port)
+    topics.maybe_create("mem://kmt", cfg.get_string("oryx.input-topic.message.topic"), 1)
+    topics.maybe_create("mem://kmt", cfg.get_string("oryx.update-topic.message.topic"), 1)
+    broker = get_broker("mem://kmt")
+    art = KMeansUpdate(cfg).build_model(_blob_lines(), {"k": 2})
+    broker.send(cfg.get_string("oryx.update-topic.message.topic"), "MODEL", art.to_string())
+    with ServingLayer(cfg):
+        base = f"http://127.0.0.1:{port}"
+        for _ in range(100):
+            try:
+                if _http("GET", f"{base}/ready")[0] == 200:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.1)
+        s, html = _http("GET", f"{base}/console")
+        assert s == 200
+        assert "Clustering model" in html and "clusters" in html

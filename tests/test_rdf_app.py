@@ -202,3 +202,28 @@ def test_classreg_rest_surface():
         in_topic = cfg.get_string("oryx.input-topic.message.topic")
         recs = broker.read(in_topic, 0, 0, 10)
         assert any(m == "0.5,blue,apple" for _, _, m in recs)
+
+
+def test_classreg_console_section():
+    port = choose_free_port()
+    cfg = _cls_cfg(port)
+    topics.maybe_create("mem://rdft", cfg.get_string("oryx.input-topic.message.topic"), 1)
+    topics.maybe_create("mem://rdft", cfg.get_string("oryx.update-topic.message.topic"), 1)
+    broker = get_broker("mem://rdft")
+    art = RDFUpdate(cfg).build_model(_cls_lines(), _hp(cfg))
+    broker.send(cfg.get_string("oryx.update-topic.message.topic"), "MODEL", art.to_string())
+    with ServingLayer(cfg):
+        base = f"http://127.0.0.1:{port}"
+        for _ in range(100):
+            try:
+                if _http("GET", f"{base}/ready")[0] == 200:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.1)
+        s, html = _http("GET", f"{base}/console")
+        assert s == 200
+        # section CONTENT, not just chrome: the target feature name, the
+        # model type row, and at least one per-feature importance row
+        assert "label" in html and "classification" in html
+        assert "importance: " in html and "error" not in html
