@@ -16,6 +16,9 @@ import numpy as np
 _LIB_NAMES = ("liboryxbus.so",)
 
 
+_build_attempted = False
+
+
 def _find_lib() -> str | None:
     env = os.environ.get("ORYXBUS_LIB")
     if env and Path(env).exists():
@@ -30,7 +33,68 @@ def _find_lib() -> str | None:
             p = d / n
             if p.exists():
                 return str(p)
-    return None
+    return _maybe_build()
+
+
+def _maybe_build() -> str | None:
+    """Compile the library in place on first use when a toolchain exists —
+    a fresh checkout should get the native fast paths without a manual
+    build step. One attempt per process; failure leaves the Python
+    fallbacks in charge."""
+    global _build_attempted
+    if _build_attempted:
+        return None
+    _build_attempted = True
+    src_dir = Path(__file__).resolve().parent.parent.parent / "native" / "oryxbus"
+    src = src_dir / "oryxbus.cpp"
+    if not src.exists():
+        return None
+    import shutil
+    import subprocess
+    import tempfile
+
+    out = src_dir / "liboryxbus.so"
+    # build to a temp name then atomic-rename: concurrent processes (the
+    # multi-process e2e spawns several at once) must never dlopen a
+    # half-written .so. The Makefile stays the single source of truth for
+    # flags; `SO=` points its output at the temp name.
+    tmp = None
+    try:
+        make = shutil.which("make")
+        gxx = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
+        if make is None and gxx is None:
+            return None
+        with tempfile.NamedTemporaryFile(
+            dir=src_dir, suffix=".so.tmp", delete=False
+        ) as tf:
+            tmp = tf.name
+        # the reservation file must not exist when make runs — an empty
+        # up-to-date target would make it a no-op; the unique NAME is the
+        # concurrency guard, not the inode
+        os.unlink(tmp)
+        if make is not None and (src_dir / "Makefile").exists():
+            cmd = [make, "-C", str(src_dir), f"SO={os.path.basename(tmp)}"]
+        else:
+            cmd = [gxx, "-O2", "-Wall", "-fPIC", "-std=c++17", "-shared",
+                   "-o", tmp, str(src)]
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+        if (
+            proc.returncode != 0
+            or not os.path.exists(tmp)
+            or not os.path.getsize(tmp)
+        ):
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            return None
+        os.replace(tmp, out)
+        return str(out)
+    except Exception:  # noqa: BLE001 - any build problem means "no native"
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return None
 
 
 class NativeAppender:
