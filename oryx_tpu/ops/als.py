@@ -569,6 +569,15 @@ def train_als_tp(
     i_idx, i_val, i_mask = (_row_pad(a, n_i_pad) for a in i_lists)
 
     key = seed_key if seed_key is not None else RandomManager.get_key()
+    if jax.process_count() > 1 and seed_key is None:
+        # every host must init the SAME y0: its sharding replicates along
+        # the cross-host data axis, and per-process urandom-seeded keys
+        # would stitch divergent replicas into a silently corrupt model
+        from jax.experimental import multihost_utils
+
+        key = jax.random.wrap_key_data(
+            multihost_utils.broadcast_one_to_all(jax.random.key_data(key))
+        )
     y0 = (
         jax.random.normal(key, (n_i_pad, features), dtype=jnp.float32) * 0.1
         + 1.0 / math.sqrt(features)
@@ -577,13 +586,30 @@ def train_als_tp(
 
     row_d = NamedSharding(mesh, P(DATA_AXIS, None))
     row_m = NamedSharding(mesh, P(MODEL_AXIS, None))
-    put = lambda a, s: jax.device_put(jnp.asarray(a), s)
+    multihost = jax.process_count() > 1
+
+    def put(a, s):
+        # single-process: plain device_put. Multi-host: every process holds
+        # the same full host array (the bus delivers the same generation to
+        # each), so each process hands jax just its addressable shards.
+        if not multihost:
+            return jax.device_put(jnp.asarray(a), s)
+        a = np.asarray(a)
+        return jax.make_array_from_callback(a.shape, s, lambda idx: a[idx])
+
     step = als_train_tp_jit(mesh, implicit=implicit, iterations=iterations, block=blk)
     x, y = step(
         put(u_idx, row_d), put(u_val, row_d), put(u_mask, row_d),
         put(i_idx, row_m), put(i_val, row_m), put(i_mask, row_m),
         put(y0, row_m), jnp.float32(lam), jnp.float32(alpha),
     )
+    if multihost:
+        # factor tables come back to every host (each publishes/serves the
+        # whole model, like every reference layer holds the full model)
+        from jax.experimental import multihost_utils
+
+        x = multihost_utils.process_allgather(x, tiled=True)
+        y = multihost_utils.process_allgather(y, tiled=True)
     return ALSModelArrays(
         np.asarray(x)[:n_u], np.asarray(y)[:n_i], data.user_ids, data.item_ids
     )

@@ -116,9 +116,19 @@ def global_mesh(spec: MeshSpec | None = None) -> Mesh:
     per_host_data, model, hosts = hybrid_shape(
         jax.process_count(), jax.local_device_count(), spec
     )
-    dev = mesh_utils.create_hybrid_device_mesh(
-        (per_host_data, model), dcn_mesh_shape=(hosts, 1)
-    )
+    try:
+        dev = mesh_utils.create_hybrid_device_mesh(
+            (per_host_data, model), dcn_mesh_shape=(hosts, 1)
+        )
+    except ValueError:
+        # slice_index metadata is TPU-only; jax's documented fallback for
+        # platforms without it groups devices by process instead, keeping
+        # the topology-aware ordering inside each host
+        dev = mesh_utils.create_hybrid_device_mesh(
+            (per_host_data, model),
+            dcn_mesh_shape=(hosts, 1),
+            process_is_granule=True,
+        )
     return Mesh(dev, (DATA_AXIS, MODEL_AXIS))
 
 
