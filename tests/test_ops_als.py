@@ -261,3 +261,67 @@ def test_topk_dot_with_exclusion():
     excl = jnp.asarray([True, False, False, False, False])
     vals, idx = topk_dot(xu, y, k=3, exclude_mask=excl)
     assert idx.tolist() == [1, 2, 3]
+
+
+def test_bucketed_half_step_matches_flat():
+    """The bucketed solver partitions the same padded lists by row width;
+    its scattered result must equal the flat solver's row for row."""
+    import jax.numpy as jnp
+
+    from oryx_tpu.ops.als import (
+        _half_step,
+        _half_step_buckets,
+        _row_pad,
+        build_bucketed_lists,
+        build_padded_lists,
+        gram,
+    )
+
+    rng = np.random.default_rng(1)
+    n_u, n_i, nnz = 3000, 1500, 120_000
+    iw = 1.0 / np.power(np.arange(1, n_i + 1), 0.9)
+    iw /= iw.sum()
+    uw = rng.lognormal(0, 1.4, n_u)
+    uw /= uw.sum()
+    data = aggregate_interactions(
+        rng.choice(n_u, size=nnz, p=uw),
+        rng.choice(n_i, size=nnz, p=iw),
+        rng.random(nnz) + 0.1,
+        implicit=True,
+    )
+    k = 8
+    y = jnp.asarray(rng.standard_normal((data.n_items, k)), dtype=jnp.float32)
+    idx, val, mask = build_padded_lists(data.users, data.items, data.values, data.n_users)
+    npad = -(-data.n_users // 64) * 64
+    idx, val, mask = (_row_pad(a, npad) for a in (idx, val, mask))
+    flat = _half_step(
+        y, gram(y), jnp.asarray(idx), jnp.asarray(val), jnp.asarray(mask),
+        jnp.float32(0.01), jnp.float32(1.0), True, 64,
+    )
+    buckets, blocks = build_bucketed_lists(
+        data.users, data.items, data.values, data.n_users, min_rows=64
+    )
+    assert len(buckets) >= 2, "skewed data should produce multiple width buckets"
+    bucketed = _half_step_buckets(
+        y, gram(y),
+        tuple(tuple(jnp.asarray(a) for a in b) for b in buckets),
+        jnp.float32(0.01), jnp.float32(1.0), True, tuple(blocks), data.n_users,
+    )
+    np.testing.assert_allclose(
+        np.asarray(flat)[: data.n_users], np.asarray(bucketed), rtol=3e-4, atol=2e-5
+    )
+
+
+def test_bucketed_truncation_keeps_largest_values():
+    """Rows beyond the cap keep their largest-|value| interactions — the
+    same policy as the flat builder."""
+    from oryx_tpu.ops.als import build_bucketed_lists
+
+    n_other = 40
+    entity = np.zeros(n_other, dtype=np.int64)
+    other = np.arange(n_other, dtype=np.int64)
+    values = np.arange(1, n_other + 1, dtype=np.float64)  # biggest = other 39
+    buckets, _ = build_bucketed_lists(entity, other, values, 1, cap=16, min_rows=1)
+    (rows, idx, val, mask), = buckets
+    kept = set(idx[0][mask[0] > 0].tolist())
+    assert kept == set(range(n_other - 16, n_other))
