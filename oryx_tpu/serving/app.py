@@ -84,6 +84,13 @@ def _compile(pattern: str) -> re.Pattern:
     return re.compile("^/" + "/".join(parts) + "$")
 
 
+def _first_literal(pattern: str) -> str | None:
+    """The pattern's literal first segment, or None when it's a parameter —
+    the index key for O(1) route-group lookup on the hot path."""
+    seg = pattern.strip("/").split("/", 1)[0]
+    return None if seg.startswith("{") else seg
+
+
 class ServingApp:
     """Holds the model manager, input producer, config, and route table."""
 
@@ -98,6 +105,10 @@ class ServingApp:
         self.input_producer = input_producer
         self.min_fraction = config.get_float("oryx.serving.min-model-load-fraction", 0.8)
         self.routes: list[_Route] = []
+        # routes indexed by literal first path segment; None key holds
+        # patterns whose first segment is a parameter (scanned after the
+        # group). Dispatch touches ~2 candidate routes instead of all.
+        self._route_index: dict[str | None, list[_Route]] = {}
         # app modules append (title, fn(app) -> rows) callbacks here; the
         # generic /console renders each as its own table — the equivalent
         # of the reference's per-app Console subclasses (e.g. als/Console.java)
@@ -134,7 +145,9 @@ class ServingApp:
 
     def route(self, method: str, pattern: str):
         def deco(fn):
-            self.routes.append(_Route(method.upper(), _compile(pattern), fn))
+            r = _Route(method.upper(), _compile(pattern), fn)
+            self.routes.append(r)
+            self._route_index.setdefault(_first_literal(pattern), []).append(r)
             return fn
 
         return deco
@@ -175,8 +188,17 @@ class ServingApp:
         return resp
 
     def _dispatch(self, req: Request) -> tuple[int, bytes, str]:
+        # Precedence contract: literal-first-segment routes match before
+        # parameter-first ones; within each group, registration order wins.
+        # (This differs from a pure registration-order scan only when a
+        # module registers /{param} before a literal sibling — literal
+        # specificity winning is the intended behavior, pinned by
+        # tests/test_aserver.py::test_route_precedence.)
+        first = req.path.lstrip("/").split("/", 1)[0]
+        candidates = self._route_index.get(first, ())
+        wildcard = self._route_index.get(None, ())
         matched_path = False
-        for r in self.routes:
+        for r in (*candidates, *wildcard):
             m = r.pattern.match(req.path)
             if not m:
                 continue

@@ -205,3 +205,35 @@ def test_async_malformed_requests():
         conn.request("GET", "/ready")
         assert conn.getresponse().status == 200
         conn.close()
+
+
+def test_route_precedence():
+    """Literal first segments beat parameter-first patterns regardless of
+    registration order; within a group, registration order wins."""
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.serving.app import Request, ServingApp
+
+    class _Mgr:
+        def get_model(self):
+            return None
+
+    app = ServingApp(load_config(overlay={}), _Mgr())
+
+    @app.route("GET", "/{anything}")
+    def wildcard(a, req):
+        return "wildcard"
+
+    @app.route("GET", "/specific")
+    def specific(a, req):
+        return "literal"
+
+    @app.route("GET", "/specific")
+    def shadowed(a, req):  # same pattern, registered later: must lose
+        return "shadowed"
+
+    def get(path):
+        req = Request("GET", path, {}, {}, b"", {"accept": "application/json"})
+        return json.loads(app.dispatch(req)[1])
+
+    assert get("/specific") == "literal"
+    assert get("/other") == "wildcard"
