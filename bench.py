@@ -365,6 +365,10 @@ def _bench_train_body() -> None:
         alpha=1.0,
         iterations=iterations,
         implicit=True,
+        # MXU-native einsum inputs; quality-neutral (AUC 0.947 bf16 vs
+        # 0.939 f32 on this generator at the 1M fallback scale) and the
+        # held-out AUC below keeps that claim measured every run
+        compute_dtype="bfloat16",
     )
     build_s = time.perf_counter() - t0
 

@@ -104,6 +104,7 @@ class ALSUpdate(MLUpdate):
             iterations=self.als.iterations,
             implicit=self.als.implicit,
             mesh=self.mesh,
+            compute_dtype=self.als.compute_dtype,
         )
         art = ModelArtifact(
             "als",

@@ -33,6 +33,7 @@ class ALSConfig:
     alpha: object
     iterations: int
     sample_rate: float
+    compute_dtype: str
 
     @staticmethod
     def from_config(config: Config) -> "ALSConfig":
@@ -49,7 +50,17 @@ class ALSConfig:
             alpha=g("hyperparams.alpha", 1.0),
             iterations=int(g("hyperparams.iterations", 10)),
             sample_rate=float(g("sample-rate", 1.0)),
+            compute_dtype=_valid_compute_dtype(str(g("compute-dtype", "float32"))),
         )
+
+
+def _valid_compute_dtype(value: str) -> str:
+    """Fail at config load, not mid-generation inside the jitted trainer."""
+    if value not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"oryx.als.compute-dtype must be 'float32' or 'bfloat16', got {value!r}"
+        )
+    return value
 
 
 def _native_loader():

@@ -221,42 +221,62 @@ def build_padded_lists(
 # the jitted trainer
 # ---------------------------------------------------------------------------
 
-def _half_step(factors, gram_f, idx, val, mask, lam, alpha, implicit: bool, block: int):
+def _half_step(
+    factors, gram_f, idx, val, mask, lam, alpha, implicit: bool, block: int,
+    compute_dtype=jnp.float32,
+):
     """One ALS half-iteration: solve every row's normal equations.
 
     factors: [M,K] fixed side; idx/val/mask: [N,P] padded lists over the
     solving side. Processes rows in `block`-sized chunks via lax.map so the
     [B,P,K] gather never materializes for the whole axis at once.
+
+    compute_dtype=bfloat16 feeds the dominant einsum bf16 inputs with f32
+    accumulation (MXU-native single pass instead of multi-pass f32); the
+    [K,K] systems and the Cholesky solves stay f32 either way.
     """
     n, p = idx.shape
     k = factors.shape[1]
     eye = jnp.eye(k, dtype=jnp.float32)
     nb = n // block
+    # bf16 inputs accumulate exactly in f32 on the MXU; f32 inputs keep
+    # the multi-pass HIGHEST path (plain f32 einsum on TPU rounds inputs
+    # to bf16 anyway, which would silently degrade the default)
+    prec = (
+        jax.lax.Precision.DEFAULT
+        if compute_dtype == jnp.bfloat16
+        else jax.lax.Precision.HIGHEST
+    )
 
     def one_block(args):
         bidx, bval, bmask = args
-        yu = factors[bidx].astype(jnp.float32)  # [B,P,K] gather
+        yu = factors[bidx].astype(compute_dtype)  # [B,P,K] gather
         if implicit:
             # Hu et al.: A = Y'Y + Yu' diag(alpha.r) Yu + lam.I
             #            b = Yu' ((1 + alpha.r) . p),  p = 1 for observed
-            w = alpha * bval * bmask
+            w = (alpha * bval * bmask).astype(compute_dtype)
             a = (
                 gram_f[None]
                 + jnp.einsum("bpk,bp,bpl->bkl", yu, w, yu,
-                             precision=jax.lax.Precision.HIGHEST)
+                             precision=prec,
+                             preferred_element_type=jnp.float32)
                 + lam * eye[None]
             )
             pref = (bval > 0).astype(jnp.float32) * bmask
-            b = jnp.einsum("bpk,bp->bk", yu, (1.0 + w) * pref,
-                           precision=jax.lax.Precision.HIGHEST)
+            b = jnp.einsum("bpk,bp->bk", yu,
+                           ((1.0 + alpha * bval * bmask) * pref).astype(compute_dtype),
+                           precision=prec,
+                           preferred_element_type=jnp.float32)
         else:
             # ALS-WR: A = Yu'Yu + lam.n_u.I ; b = Yu' r
-            a = jnp.einsum("bpk,bp,bpl->bkl", yu, bmask, yu,
-                           precision=jax.lax.Precision.HIGHEST)
+            a = jnp.einsum("bpk,bp,bpl->bkl", yu, bmask.astype(compute_dtype), yu,
+                           precision=prec,
+                           preferred_element_type=jnp.float32)
             n_u = bmask.sum(axis=1)
             a = a + (lam * jnp.maximum(n_u, 1.0))[:, None, None] * eye[None]
-            b = jnp.einsum("bpk,bp->bk", yu, bval * bmask,
-                           precision=jax.lax.Precision.HIGHEST)
+            b = jnp.einsum("bpk,bp->bk", yu, (bval * bmask).astype(compute_dtype),
+                           precision=prec,
+                           preferred_element_type=jnp.float32)
         chol = jnp.linalg.cholesky(a)
         y = jax.scipy.linalg.solve_triangular(chol, b[..., None], lower=True)
         x = jax.scipy.linalg.solve_triangular(
@@ -278,20 +298,28 @@ def _half_step(factors, gram_f, idx, val, mask, lam, alpha, implicit: bool, bloc
 
 @partial(
     jax.jit,
-    static_argnames=("implicit", "iterations", "block"),
+    static_argnames=("implicit", "iterations", "block", "compute_dtype"),
 )
 def als_train_jit(
     u_idx, u_val, u_mask, i_idx, i_val, i_mask, y0, lam, alpha,
     *, implicit: bool, iterations: int, block: int,
+    compute_dtype: str = "float32",
 ):
     """Full ALS training loop as one compiled program (lax.scan over
     iterations). All shapes static; shard u_* over users and i_* over items
     on the mesh "data" axis and XLA threads the collectives through."""
+    cdt = jnp.dtype(compute_dtype)
 
     def body(carry, _):
         _, y = carry
-        x = _half_step(y, gram(y), u_idx, u_val, u_mask, lam, alpha, implicit, block)
-        y_new = _half_step(x, gram(x), i_idx, i_val, i_mask, lam, alpha, implicit, block)
+        x = _half_step(
+            y, gram(y), u_idx, u_val, u_mask, lam, alpha, implicit, block,
+            compute_dtype=cdt,
+        )
+        y_new = _half_step(
+            x, gram(x), i_idx, i_val, i_mask, lam, alpha, implicit, block,
+            compute_dtype=cdt,
+        )
         # x rides in the carry, NOT a per-step scan output: stacking it
         # would multiply peak factor memory by the iteration count
         return (x, y_new), None
@@ -320,12 +348,15 @@ def train_als(
     cap: int = 1024,
     block: int = 1024,
     seed_key=None,
+    compute_dtype: str = "float32",
 ) -> ALSModelArrays:
     """Train ALS factor matrices. If a mesh is given, the padded lists and
     factor tables are sharded over its "data" axis and the whole scan runs
     SPMD; a mesh with a non-trivial "model" axis dispatches to the
     tensor-parallel trainer (X sharded by user, Y by item — see
-    train_als_tp); single-device otherwise."""
+    train_als_tp); single-device otherwise. compute_dtype="bfloat16" feeds
+    the normal-equation einsums bf16 inputs with f32 accumulation (the
+    MXU-native fast path; solves stay f32)."""
     if mesh is not None:
         from oryx_tpu.parallel.mesh import MODEL_AXIS
 
@@ -333,7 +364,7 @@ def train_als(
             return train_als_tp(
                 data, mesh, features=features, lam=lam, alpha=alpha,
                 iterations=iterations, implicit=implicit, cap=cap,
-                block=block, seed_key=seed_key,
+                block=block, seed_key=seed_key, compute_dtype=compute_dtype,
             )
     n_u, n_i = data.n_users, data.n_items
     if n_u == 0 or n_i == 0 or len(data.values) == 0:
@@ -368,6 +399,7 @@ def train_als(
             y0, jnp.float32(lam), jnp.float32(alpha),
             implicit=implicit, iterations=iterations,
             blocks_u=tuple(blocks_u), blocks_i=tuple(blocks_i), n_u=n_u_pad,
+            compute_dtype=compute_dtype,
         )
         return ALSModelArrays(
             np.asarray(x)[:n_u], np.asarray(y)[:n_i], data.user_ids, data.item_ids
@@ -411,6 +443,7 @@ def train_als(
         implicit=implicit,
         iterations=iterations,
         block=blk,
+        compute_dtype=compute_dtype,
     )
     return ALSModelArrays(
         np.asarray(x)[:n_u], np.asarray(y)[:n_i], data.user_ids, data.item_ids
@@ -511,37 +544,47 @@ def build_bucketed_lists(
 
 
 def _half_step_buckets(
-    factors, gram_f, buckets, lam, alpha, implicit: bool, blocks, n_out: int
+    factors, gram_f, buckets, lam, alpha, implicit: bool, blocks, n_out: int,
+    compute_dtype=jnp.float32,
 ):
     """Bucketed half-iteration: solve each width class with its own padded
     shape, scatter results into the [n_out, K] factor table."""
     k = factors.shape[1]
     x = jnp.zeros((n_out, k), dtype=jnp.float32)
     for (rows, idx, val, mask), blk in zip(buckets, blocks):
-        sol = _half_step(factors, gram_f, idx, val, mask, lam, alpha, implicit, blk)
+        sol = _half_step(
+            factors, gram_f, idx, val, mask, lam, alpha, implicit, blk,
+            compute_dtype=compute_dtype,
+        )
         x = x.at[rows].set(sol, mode="drop")  # padding rows carry id n_out
     return x
 
 
 @partial(
     jax.jit,
-    static_argnames=("implicit", "iterations", "blocks_u", "blocks_i", "n_u"),
+    static_argnames=(
+        "implicit", "iterations", "blocks_u", "blocks_i", "n_u", "compute_dtype"
+    ),
 )
 def als_train_bucketed_jit(
     u_buckets, i_buckets, y0, lam, alpha,
     *, implicit: bool, iterations: int, blocks_u, blocks_i, n_u: int,
+    compute_dtype: str = "float32",
 ):
     """Bucketed ALS training loop (single-device / data-replicated). Same
     math as als_train_jit — the buckets partition exactly the same padded
     lists — with work proportional to real row lengths."""
+    cdt = jnp.dtype(compute_dtype)
 
     def body(carry, _):
         _x_prev, y = carry
         x = _half_step_buckets(
-            y, gram(y), u_buckets, lam, alpha, implicit, blocks_u, n_u
+            y, gram(y), u_buckets, lam, alpha, implicit, blocks_u, n_u,
+            compute_dtype=cdt,
         )
         y_new = _half_step_buckets(
-            x, gram(x), i_buckets, lam, alpha, implicit, blocks_i, y.shape[0]
+            x, gram(x), i_buckets, lam, alpha, implicit, blocks_i, y.shape[0],
+            compute_dtype=cdt,
         )
         return (x, y_new), None
 
@@ -572,7 +615,7 @@ def als_train_bucketed_jit(
 
 def _half_step_tp(
     factors_local, gram_full, base, idx, val, mask, lam, alpha,
-    implicit: bool, block: int, other_axis: str,
+    implicit: bool, block: int, other_axis: str, compute_dtype=jnp.float32,
 ):
     """One TP half-iteration inside shard_map.
 
@@ -585,30 +628,36 @@ def _half_step_tp(
     m_local, k = factors_local.shape
     eye = jnp.eye(k, dtype=jnp.float32)
     nb = n // block
+    prec = (
+        jax.lax.Precision.DEFAULT
+        if compute_dtype == jnp.bfloat16
+        else jax.lax.Precision.HIGHEST
+    )
 
     def one_block(args):
         bidx, bval, bmask = args
         rel = bidx - base
         inblk = ((rel >= 0) & (rel < m_local)).astype(jnp.float32) * bmask
-        yu = factors_local[jnp.clip(rel, 0, m_local - 1)].astype(jnp.float32)
+        yu = factors_local[jnp.clip(rel, 0, m_local - 1)].astype(compute_dtype)
         if implicit:
             w = alpha * bval * inblk
             a_part = jnp.einsum(
-                "bpk,bp,bpl->bkl", yu, w, yu, precision=jax.lax.Precision.HIGHEST
+                "bpk,bp,bpl->bkl", yu, w.astype(compute_dtype), yu,
+                precision=prec, preferred_element_type=jnp.float32,
             )
             pref = (bval > 0).astype(jnp.float32) * inblk
             b_part = jnp.einsum(
-                "bpk,bp->bk", yu, (1.0 + w) * pref,
-                precision=jax.lax.Precision.HIGHEST,
+                "bpk,bp->bk", yu, ((1.0 + w) * pref).astype(compute_dtype),
+                precision=prec, preferred_element_type=jnp.float32,
             )
         else:
             a_part = jnp.einsum(
-                "bpk,bp,bpl->bkl", yu, inblk, yu,
-                precision=jax.lax.Precision.HIGHEST,
+                "bpk,bp,bpl->bkl", yu, inblk.astype(compute_dtype), yu,
+                precision=prec, preferred_element_type=jnp.float32,
             )
             b_part = jnp.einsum(
-                "bpk,bp->bk", yu, bval * inblk,
-                precision=jax.lax.Precision.HIGHEST,
+                "bpk,bp->bk", yu, (bval * inblk).astype(compute_dtype),
+                precision=prec, preferred_element_type=jnp.float32,
             )
         # combine partial normal equations across the fixed side's shards
         a_part = jax.lax.psum(a_part, other_axis)
@@ -638,7 +687,10 @@ def _half_step_tp(
 
 
 @lru_cache(maxsize=16)
-def als_train_tp_jit(mesh, *, implicit: bool, iterations: int, block: int):
+def als_train_tp_jit(
+    mesh, *, implicit: bool, iterations: int, block: int,
+    compute_dtype: str = "float32",
+):
     """Build the jitted tensor-parallel training step over `mesh` (cached
     per (mesh, statics) — the batch layer retrains every generation and
     must hit the jit cache, not recompile).
@@ -649,6 +701,8 @@ def als_train_tp_jit(mesh, *, implicit: bool, iterations: int, block: int):
     """
     from jax.sharding import PartitionSpec as P
     from oryx_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    cdt = jnp.dtype(compute_dtype)
 
     def body(u_idx, u_val, u_mask, i_idx, i_val, i_mask, y0, lam, alpha):
         m_i_local = y0.shape[0]  # N_i / tp
@@ -661,12 +715,12 @@ def als_train_tp_jit(mesh, *, implicit: bool, iterations: int, block: int):
             gram_y = jax.lax.psum(gram(y_local), MODEL_AXIS)
             x_local = _half_step_tp(
                 y_local, gram_y, y_base, u_idx, u_val, u_mask,
-                lam, alpha, implicit, block, MODEL_AXIS,
+                lam, alpha, implicit, block, MODEL_AXIS, compute_dtype=cdt,
             )
             gram_x = jax.lax.psum(gram(x_local), DATA_AXIS)
             y_local = _half_step_tp(
                 x_local, gram_x, x_base, i_idx, i_val, i_mask,
-                lam, alpha, implicit, block, DATA_AXIS,
+                lam, alpha, implicit, block, DATA_AXIS, compute_dtype=cdt,
             )
             return (x_local, y_local), None
 
@@ -702,6 +756,7 @@ def train_als_tp(
     cap: int = 1024,
     block: int = 1024,
     seed_key=None,
+    compute_dtype: str = "float32",
 ) -> ALSModelArrays:
     """Tensor-parallel train_als: X sharded by user over "data", Y by item
     over "model"; neither factor table is ever whole on one device."""
@@ -755,7 +810,10 @@ def train_als_tp(
         a = np.asarray(a)
         return jax.make_array_from_callback(a.shape, s, lambda idx: a[idx])
 
-    step = als_train_tp_jit(mesh, implicit=implicit, iterations=iterations, block=blk)
+    step = als_train_tp_jit(
+        mesh, implicit=implicit, iterations=iterations, block=blk,
+        compute_dtype=compute_dtype,
+    )
     x, y = step(
         put(u_idx, row_d), put(u_val, row_d), put(u_mask, row_d),
         put(i_idx, row_m), put(i_val, row_m), put(i_mask, row_m),

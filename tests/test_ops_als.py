@@ -325,3 +325,45 @@ def test_bucketed_truncation_keeps_largest_values():
     (rows, idx, val, mask), = buckets
     kept = set(idx[0][mask[0] > 0].tolist())
     assert kept == set(range(n_other - 16, n_other))
+
+
+def test_bfloat16_compute_dtype_quality():
+    """bf16 einsum inputs (f32 accumulation) must not degrade ranking
+    quality: on planted-genre data both dtypes separate in-genre items."""
+    import jax
+    import jax.numpy as jnp
+
+    from oryx_tpu.ops.als import train_als
+
+    rng = np.random.default_rng(5)
+    n_u, n_i, nnz, G = 600, 400, 40_000, 8
+    ug = rng.integers(0, G, n_u)
+    ig = rng.integers(0, G, n_i)
+    users = rng.integers(0, n_u, nnz)
+    items = rng.integers(0, n_i, nnz)
+    ing = rng.random(nnz) < 0.85
+    for g in range(G):
+        rows = np.nonzero(ing & (ug[users] == g))[0]
+        pool = np.nonzero(ig == g)[0]
+        if rows.size and pool.size:
+            items[rows] = rng.choice(pool, size=rows.size)
+    data = aggregate_interactions(users, items, rng.random(nnz) + 0.5, implicit=True)
+
+    def genre_score(dt):
+        m = train_als(
+            data, features=16, iterations=5, implicit=True,
+            seed_key=jax.random.PRNGKey(0), compute_dtype=dt,
+        )
+        # mean margin: in-genre items should outscore out-genre ones
+        iid_genre = np.array([ig[int(i)] for i in m.item_ids])
+        margins = []
+        for j, u in enumerate(m.user_ids[:100]):
+            s = m.y @ m.x[j]
+            g = ug[int(u)]
+            margins.append(s[iid_genre == g].mean() - s[iid_genre != g].mean())
+        return float(np.mean(margins))
+
+    f32 = genre_score("float32")
+    bf16 = genre_score("bfloat16")
+    assert f32 > 0.05 and bf16 > 0.05  # both models learned the structure
+    assert bf16 > 0.8 * f32  # bf16 within tolerance of full precision
