@@ -33,9 +33,34 @@ def _parse_args(argv):
     p = argparse.ArgumentParser(prog="oryx_tpu", description=__doc__)
     p.add_argument(
         "command",
-        choices=["batch", "speed", "serving", "setup", "tail", "input", "import-pmml"],
+        choices=[
+            "batch", "speed", "serving", "setup", "tail", "input",
+            "import-pmml", "loadtest",
+        ],
     )
     p.add_argument("--conf", help="user config file (HOCON-like key paths)")
+    p.add_argument(
+        "--url",
+        help="loadtest: base URL of a running serving layer "
+        "(default http://localhost:<oryx.serving.api.port>)",
+    )
+    p.add_argument(
+        "--paths",
+        help="loadtest: file of request paths to replay round-robin, one "
+        "per line (default: stdin; lines like /recommend/u1?howMany=10)",
+    )
+    p.add_argument(
+        "--rate", type=float, default=0.0,
+        help="loadtest: target requests/sec, 0 = as fast as possible",
+    )
+    p.add_argument(
+        "--duration", type=float, default=30.0,
+        help="loadtest: seconds to run (default 30)",
+    )
+    p.add_argument(
+        "--workers", type=int, default=32,
+        help="loadtest: concurrent client connections (default 32)",
+    )
     p.add_argument(
         "--pmml",
         help="PMML file to import (import-pmml): published to the update "
@@ -212,6 +237,113 @@ def cmd_serving(config: Config) -> int:
     return _run_until_interrupt(ServingLayer(config))
 
 
+def cmd_loadtest(config: Config, args) -> int:
+    """Replay request paths against a running serving layer at a target
+    rate and report throughput + latency percentiles — the operational
+    face of the reference's test-tree traffic tools (TrafficUtil +
+    LoadBenchmark, app/oryx-app-serving/src/test/.../als/LoadBenchmark.java:
+    50-100). Open-loop pacing when --rate is set: request start times are
+    scheduled, so queueing delay shows up as latency instead of silently
+    shrinking offered load (closed-loop clients do the latter)."""
+    import http.client
+    import threading
+    from urllib.parse import urlsplit
+
+    base = args.url or f"http://localhost:{config.get_int('oryx.serving.api.port', 8080)}"
+    if "//" not in base:
+        base = "http://" + base  # bare host:port
+    split = urlsplit(base)
+    if split.scheme not in ("http", "https"):
+        raise SystemExit(f"loadtest: unsupported URL scheme {split.scheme!r}")
+    tls = split.scheme == "https"
+    host = split.hostname or "localhost"
+    port = split.port or (443 if tls else 80)
+    prefix = split.path.rstrip("/")
+    if args.paths:
+        lines = [ln.strip() for ln in open(args.paths) if ln.strip()]
+    else:
+        lines = [ln.strip() for ln in sys.stdin if ln.strip()]
+    if not lines:
+        raise SystemExit("loadtest: no request paths given")
+
+    n_workers = max(1, args.workers)
+    lat_ms: list[list[float]] = [[] for _ in range(n_workers)]
+    errors = [0] * n_workers
+    t_start = time.perf_counter()
+    stop_at = t_start + args.duration
+    # open-loop schedule: worker w fires request j at its (j*n+w)/rate slot
+    rate = args.rate
+
+    def connect():
+        if tls:
+            return http.client.HTTPSConnection(host, port, timeout=60)
+        return http.client.HTTPConnection(host, port, timeout=60)
+
+    def worker(w: int) -> None:
+        conn = connect()
+        j = 0
+        while True:
+            now = time.perf_counter()
+            if now >= stop_at:
+                break
+            due = now
+            if rate > 0:
+                due = t_start + (j * n_workers + w) / rate
+                if due >= stop_at:
+                    break
+                if due > now:
+                    time.sleep(due - now)
+            path = prefix + lines[(j * n_workers + w) % len(lines)]
+            # latency counts from the SCHEDULED slot: when the server (or
+            # this worker) falls behind, the slip shows up in the
+            # percentiles instead of silently shrinking offered load
+            t0 = min(due, time.perf_counter()) if rate > 0 else time.perf_counter()
+            try:
+                conn.request("GET", path)
+                r = conn.getresponse()
+                r.read()
+                if r.status == 200:
+                    lat_ms[w].append((time.perf_counter() - t0) * 1000)
+                else:
+                    errors[w] += 1
+            except Exception:
+                errors[w] += 1
+                conn.close()
+                conn = connect()
+            j += 1
+        conn.close()
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(n_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t_start
+    lats = sorted(x for ws in lat_ms for x in ws)
+    n_ok, n_err = len(lats), sum(errors)
+    if not lats:
+        print(json.dumps({"requests": 0, "errors": n_err, "seconds": round(dt, 2)}))
+        return 1
+    pct = lambda p: round(lats[min(len(lats) - 1, int(p / 100 * len(lats)))], 2)
+    print(
+        json.dumps(
+            {
+                "requests": n_ok,
+                "errors": n_err,
+                "seconds": round(dt, 2),
+                "qps": round(n_ok / dt, 1),
+                "latency_ms": {
+                    "p50": pct(50), "p90": pct(90), "p99": pct(99),
+                    "max": round(lats[-1], 2),
+                },
+                "target_rate": rate or "unlimited",
+                "workers": n_workers,
+            }
+        )
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     args = _parse_args(argv if argv is not None else sys.argv[1:])
     logging.basicConfig(
@@ -222,6 +354,8 @@ def main(argv=None) -> int:
     config = _build_config(args)
     if args.command == "import-pmml":
         return cmd_import_pmml(config, args.pmml)
+    if args.command == "loadtest":
+        return cmd_loadtest(config, args)
     return {
         "batch": cmd_batch,
         "speed": cmd_speed,

@@ -254,17 +254,17 @@ def _half_step(
         if implicit:
             # Hu et al.: A = Y'Y + Yu' diag(alpha.r) Yu + lam.I
             #            b = Yu' ((1 + alpha.r) . p),  p = 1 for observed
-            w = (alpha * bval * bmask).astype(compute_dtype)
+            w = alpha * bval * bmask
             a = (
                 gram_f[None]
-                + jnp.einsum("bpk,bp,bpl->bkl", yu, w, yu,
+                + jnp.einsum("bpk,bp,bpl->bkl", yu, w.astype(compute_dtype), yu,
                              precision=prec,
                              preferred_element_type=jnp.float32)
                 + lam * eye[None]
             )
             pref = (bval > 0).astype(jnp.float32) * bmask
             b = jnp.einsum("bpk,bp->bk", yu,
-                           ((1.0 + alpha * bval * bmask) * pref).astype(compute_dtype),
+                           ((1.0 + w) * pref).astype(compute_dtype),
                            precision=prec,
                            preferred_element_type=jnp.float32)
         else:

@@ -117,3 +117,54 @@ def test_serving_subprocess_round_trip(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_loadtest_command(tmp_path):
+    """The loadtest subcommand replays paths against a live serving layer
+    and reports qps + latency percentiles as one JSON line."""
+    import io
+    import json as _json
+    from contextlib import redirect_stdout
+
+    from oryx_tpu.bus.broker import get_broker
+    from oryx_tpu.cli import main as cli_main
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.serving.server import ServingLayer
+
+    bus = "mem://clilt"
+    broker = get_broker(bus)
+    for t in ("OryxInput", "OryxUpdate"):
+        if not broker.topic_exists(t):
+            broker.create_topic(t, 1)
+    broker.send("OryxUpdate", "MODEL", _json.dumps({"word": 7}))
+    cfg = load_config(overlay={
+        "oryx.input-topic.broker": bus,
+        "oryx.update-topic.broker": bus,
+        "oryx.serving.api.port": 0,
+        "oryx.serving.model-manager-class": "oryx_tpu.apps.example.serving.ExampleServingModelManager",
+        "oryx.serving.application-resources": [
+            "oryx_tpu.serving.resources.common",
+            "oryx_tpu.serving.resources.example",
+        ],
+    })
+    paths = tmp_path / "paths.txt"
+    paths.write_text("/distinct/word\n/ready\n")
+    with ServingLayer(cfg) as sl:
+        time.sleep(0.3)
+        out = io.StringIO()
+        with redirect_stdout(out):
+            rc = cli_main([
+                "loadtest",
+                "--url", f"http://127.0.0.1:{sl.port}",
+                "--paths", str(paths),
+                "--rate", "200",
+                "--duration", "2",
+                "--workers", "4",
+            ])
+    assert rc == 0
+    report = _json.loads(out.getvalue().strip().splitlines()[-1])
+    assert report["errors"] == 0
+    assert report["requests"] > 100  # ~400 scheduled at 200 rps x 2s
+    assert report["latency_ms"]["p50"] > 0
+    # pacing must not EXCEED the target (a loaded host may undershoot)
+    assert report["qps"] <= 260
