@@ -13,6 +13,7 @@ train/test by time instead of randomly (ALSUpdate.java:325-342).
 from __future__ import annotations
 
 import logging
+import pathlib
 import time
 from typing import Any, Sequence
 
@@ -96,8 +97,7 @@ class ALSUpdate(MLUpdate):
 
     def build_model(self, train: Sequence[KeyMessage], hyperparams: dict[str, Any]) -> ModelArtifact:
         agg = self._aggregate(train)
-        m = train_als(
-            agg,
+        kwargs = dict(
             features=int(hyperparams["features"]),
             lam=float(hyperparams["lambda"]),
             alpha=float(hyperparams["alpha"]),
@@ -106,6 +106,30 @@ class ALSUpdate(MLUpdate):
             mesh=self.mesh,
             compute_dtype=self.als.compute_dtype,
         )
+        model_dir = self.config.get_string("oryx.batch.storage.model-dir", None)
+        if self.als.checkpoint_interval > 0 and model_dir:
+            # long builds survive preemption: resume from the last
+            # checkpointed sweep instead of restarting the generation.
+            # One subdir per hyperparam combo — candidates may build in
+            # parallel (oryx.ml.eval.parallelism) and must not share a
+            # checkpoint file
+            import hashlib
+            import json as _json
+
+            from oryx_tpu.common.ioutil import strip_scheme
+            from oryx_tpu.ops.als import train_als_checkpointed
+
+            combo = hashlib.sha1(
+                _json.dumps(hyperparams, sort_keys=True, default=str).encode()
+            ).hexdigest()[:12]
+            m = train_als_checkpointed(
+                agg,
+                pathlib.Path(strip_scheme(model_dir)) / ".als-checkpoint" / combo,
+                self.als.checkpoint_interval,
+                **kwargs,
+            )
+        else:
+            m = train_als(agg, **kwargs)
         art = ModelArtifact(
             "als",
             extensions={

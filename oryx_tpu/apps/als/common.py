@@ -34,6 +34,7 @@ class ALSConfig:
     iterations: int
     sample_rate: float
     compute_dtype: str
+    checkpoint_interval: int
 
     @staticmethod
     def from_config(config: Config) -> "ALSConfig":
@@ -51,6 +52,7 @@ class ALSConfig:
             iterations=int(g("hyperparams.iterations", 10)),
             sample_rate=float(g("sample-rate", 1.0)),
             compute_dtype=_valid_compute_dtype(str(g("compute-dtype", "float32"))),
+            checkpoint_interval=int(g("checkpoint-interval", 0)),
         )
 
 

@@ -349,6 +349,7 @@ def train_als(
     block: int = 1024,
     seed_key=None,
     compute_dtype: str = "float32",
+    resume_y: np.ndarray | None = None,
 ) -> ALSModelArrays:
     """Train ALS factor matrices. If a mesh is given, the padded lists and
     factor tables are sharded over its "data" axis and the whole scan runs
@@ -356,7 +357,9 @@ def train_als(
     tensor-parallel trainer (X sharded by user, Y by item — see
     train_als_tp); single-device otherwise. compute_dtype="bfloat16" feeds
     the normal-equation einsums bf16 inputs with f32 accumulation (the
-    MXU-native fast path; solves stay f32)."""
+    MXU-native fast path; solves stay f32). resume_y replaces the random
+    item-factor init with a [n_items, features] matrix (mid-build
+    checkpoint resume: the per-sweep carry is fully determined by Y)."""
     if mesh is not None:
         from oryx_tpu.parallel.mesh import MODEL_AXIS
 
@@ -365,6 +368,7 @@ def train_als(
                 data, mesh, features=features, lam=lam, alpha=alpha,
                 iterations=iterations, implicit=implicit, cap=cap,
                 block=block, seed_key=seed_key, compute_dtype=compute_dtype,
+                resume_y=resume_y,
             )
     n_u, n_i = data.n_users, data.n_items
     if n_u == 0 or n_i == 0 or len(data.values) == 0:
@@ -377,22 +381,33 @@ def train_als(
         # Row counts round to a 1024 unit so retrains on slowly growing
         # data keep hitting the jit cache.
         unit = 1024
-        u_buckets, blocks_u = build_bucketed_lists(
-            data.users, data.items, data.values, n_u, cap, block=block, unit=unit
+        u_buckets, blocks_u = _cached_lists(
+            "u_buckets", data, (cap, block, unit),
+            lambda: build_bucketed_lists(
+                data.users, data.items, data.values, n_u, cap,
+                block=block, unit=unit,
+            ),
         )
-        i_buckets, blocks_i = build_bucketed_lists(
-            data.items, data.users, data.values, n_i, cap, block=block, unit=unit
+        i_buckets, blocks_i = _cached_lists(
+            "i_buckets", data, (cap, block, unit),
+            lambda: build_bucketed_lists(
+                data.items, data.users, data.values, n_i, cap,
+                block=block, unit=unit,
+            ),
         )
         n_u_pad = -(-n_u // unit) * unit
         n_i_pad = -(-n_i // unit) * unit
-        key = seed_key if seed_key is not None else RandomManager.get_key()
-        # padding rows must be ZERO or phantom items inflate gram(Y) in
-        # the first half-iteration
-        y0 = (
-            jax.random.normal(key, (n_i_pad, features), dtype=jnp.float32) * 0.1
-            + 1.0 / math.sqrt(features)
-        )
-        y0 = y0 * (jnp.arange(n_i_pad) < n_i)[:, None]
+        if resume_y is not None:
+            y0 = jnp.asarray(_row_pad(np.asarray(resume_y, dtype=np.float32), n_i_pad))
+        else:
+            key = seed_key if seed_key is not None else RandomManager.get_key()
+            # padding rows must be ZERO or phantom items inflate gram(Y)
+            # in the first half-iteration
+            y0 = (
+                jax.random.normal(key, (n_i_pad, features), dtype=jnp.float32) * 0.1
+                + 1.0 / math.sqrt(features)
+            )
+            y0 = y0 * (jnp.arange(n_i_pad) < n_i)[:, None]
         x, y = als_train_bucketed_jit(
             tuple(tuple(jnp.asarray(a) for a in b) for b in u_buckets),
             tuple(tuple(jnp.asarray(a) for a in b) for b in i_buckets),
@@ -410,8 +425,14 @@ def train_als(
     # layouts both divide evenly
     from oryx_tpu.parallel.mesh import DATA_AXIS, shard_array
 
-    u_lists = build_padded_lists(data.users, data.items, data.values, n_u, cap)
-    i_lists = build_padded_lists(data.items, data.users, data.values, n_i, cap)
+    u_lists = _cached_lists(
+        "u_lists", data, (cap,),
+        lambda: build_padded_lists(data.users, data.items, data.values, n_u, cap),
+    )
+    i_lists = _cached_lists(
+        "i_lists", data, (cap,),
+        lambda: build_padded_lists(data.items, data.users, data.values, n_i, cap),
+    )
 
     mesh_n = mesh.shape[DATA_AXIS]
     blk = min(block, 1 << max(0, max(n_u, n_i) - 1).bit_length())
@@ -421,15 +442,18 @@ def train_als(
     u_idx, u_val, u_mask = (_row_pad(a, n_u_pad) for a in u_lists)
     i_idx, i_val, i_mask = (_row_pad(a, n_i_pad) for a in i_lists)
 
-    key = seed_key if seed_key is not None else RandomManager.get_key()
-    # small random factors around 1/sqrt(K), the usual ALS init scale;
-    # padding rows must be ZERO or phantom items inflate gram(Y) in the
-    # first half-iteration
-    y0 = (
-        jax.random.normal(key, (n_i_pad, features), dtype=jnp.float32) * 0.1
-        + 1.0 / math.sqrt(features)
-    )
-    y0 = y0 * (jnp.arange(n_i_pad) < n_i)[:, None]
+    if resume_y is not None:
+        y0 = jnp.asarray(_row_pad(np.asarray(resume_y, dtype=np.float32), n_i_pad))
+    else:
+        key = seed_key if seed_key is not None else RandomManager.get_key()
+        # small random factors around 1/sqrt(K), the usual ALS init scale;
+        # padding rows must be ZERO or phantom items inflate gram(Y) in
+        # the first half-iteration
+        y0 = (
+            jax.random.normal(key, (n_i_pad, features), dtype=jnp.float32) * 0.1
+            + 1.0 / math.sqrt(features)
+        )
+        y0 = y0 * (jnp.arange(n_i_pad) < n_i)[:, None]
 
     args = [
         shard_array(np.asarray(a), mesh)
@@ -450,6 +474,106 @@ def train_als(
     )
 
 
+def train_als_checkpointed(
+    data: InteractionData,
+    checkpoint_dir,
+    checkpoint_every: int,
+    features: int = 10,
+    lam: float = 0.001,
+    alpha: float = 1.0,
+    iterations: int = 10,
+    implicit: bool = True,
+    mesh=None,
+    cap: int = 1024,
+    block: int = 1024,
+    seed_key=None,
+    compute_dtype: str = "float32",
+) -> ALSModelArrays:
+    """train_als with mid-build checkpoints every `checkpoint_every`
+    sweeps: a preempted/killed build resumes from the last checkpoint
+    instead of restarting, and the resumed run equals the uninterrupted
+    one exactly (the per-sweep carry is fully determined by Y, which is
+    what gets saved). The spirit of the reference's ALS
+    checkpointInterval(5) (ALSUpdate.java:144 breaks RDD lineage every 5
+    iterations), re-aimed at the failure mode long TPU builds actually
+    have. Checkpoints are atomic (tmp + rename), fingerprinted against
+    the exact training configuration, and removed on success.
+    """
+    import json as _json
+    import os
+    from pathlib import Path
+
+    if iterations < 1:
+        raise ValueError("iterations must be >= 1")
+    ck_dir = Path(checkpoint_dir)
+    ck_dir.mkdir(parents=True, exist_ok=True)
+    ck = ck_dir / "als-train.ckpt.npz"
+    import zlib
+
+    # sampled content hash: time-decayed re-aggregation after a crash can
+    # produce the same SHAPES with different values; a stale checkpoint
+    # must not be accepted against different data
+    sample = slice(None, None, max(1, len(data.values) // 262_144))
+    data_crc = zlib.crc32(np.ascontiguousarray(data.values[sample]).tobytes())
+    data_crc = zlib.crc32(np.ascontiguousarray(data.users[sample]).tobytes(), data_crc)
+    data_crc = zlib.crc32(np.ascontiguousarray(data.items[sample]).tobytes(), data_crc)
+    fingerprint = _json.dumps(
+        {
+            "n_users": data.n_users,
+            "n_items": data.n_items,
+            "nnz": int(len(data.values)),
+            "data_crc": data_crc,
+            "features": features,
+            "lam": float(lam),
+            "alpha": float(alpha),
+            "implicit": implicit,
+            "compute_dtype": compute_dtype,
+            "iterations": iterations,
+        },
+        sort_keys=True,
+    )
+
+    done = 0
+    resume_y = None
+    if ck.exists():
+        try:
+            with np.load(ck, allow_pickle=False) as z:
+                if str(z["fingerprint"]) == fingerprint:
+                    done = int(z["done"])
+                    resume_y = z["y"]
+                    log.info("resuming ALS build from checkpoint: %d/%d sweeps done",
+                             done, iterations)
+        except Exception:  # noqa: BLE001 - a torn checkpoint means restart
+            log.warning("ignoring unreadable ALS checkpoint %s", ck)
+
+    kwargs = dict(
+        features=features, lam=lam, alpha=alpha, implicit=implicit,
+        mesh=mesh, cap=cap, block=block, compute_dtype=compute_dtype,
+    )
+    # checkpoints are only written mid-build (done < iterations) and the
+    # fingerprint pins `iterations`, so done < iterations always holds
+    # here; clamp defensively anyway — X is derived from Y, so at least
+    # one sweep must run
+    done = min(done, iterations - 1)
+    model = None
+    while done < iterations:
+        chunk = min(max(1, checkpoint_every), iterations - done)
+        model = train_als(
+            data, iterations=chunk, seed_key=seed_key,
+            resume_y=resume_y, **kwargs,
+        )
+        done += chunk
+        resume_y = model.y
+        if done < iterations:
+            tmp = str(ck) + ".tmp"
+            np.savez(tmp, y=model.y, done=done, fingerprint=fingerprint)
+            # np.savez appends .npz to names without it
+            os.replace(tmp if os.path.exists(tmp) else tmp + ".npz", ck)
+    if ck.exists():
+        ck.unlink()
+    return model
+
+
 def _row_pad(a: np.ndarray, n: int) -> np.ndarray:
     if a.shape[0] == n:
         return a
@@ -460,6 +584,33 @@ def _row_pad(a: np.ndarray, n: int) -> np.ndarray:
 # bucketed lists: rows grouped by interaction count so light rows don't pay
 # the heaviest row's padding
 # ---------------------------------------------------------------------------
+
+_prepared_lists_cache: dict = {}
+
+
+def _cached_lists(tag: str, data, params: tuple, build):
+    """Memoize padded/bucketed list construction per InteractionData object
+    (and scalar build parameters). The checkpointed trainer re-enters
+    train_als once per chunk with the SAME data object; rebuilding the
+    lists each chunk would repeat minutes of host work on large builds.
+    Entries die with the data object via weakref.finalize."""
+    import weakref
+
+    key = (id(data), tag, params)
+    hit = _prepared_lists_cache.get(key)
+    if hit is not None:
+        return hit
+    out = build()
+    if not any(k[0] == id(data) for k in _prepared_lists_cache):
+        weakref.finalize(data, _purge_prepared, id(data))
+    _prepared_lists_cache[key] = out
+    return out
+
+
+def _purge_prepared(obj_id: int) -> None:
+    for k in [k for k in _prepared_lists_cache if k[0] == obj_id]:
+        _prepared_lists_cache.pop(k, None)
+
 
 def build_bucketed_lists(
     entity: np.ndarray,
@@ -757,6 +908,7 @@ def train_als_tp(
     block: int = 1024,
     seed_key=None,
     compute_dtype: str = "float32",
+    resume_y: np.ndarray | None = None,
 ) -> ALSModelArrays:
     """Tensor-parallel train_als: X sharded by user over "data", Y by item
     over "model"; neither factor table is ever whole on one device."""
@@ -768,8 +920,14 @@ def train_als_tp(
         raise ValueError("empty interaction data")
     dp, tp = mesh.shape[DATA_AXIS], mesh.shape[MODEL_AXIS]
 
-    u_lists = build_padded_lists(data.users, data.items, data.values, n_u, cap)
-    i_lists = build_padded_lists(data.items, data.users, data.values, n_i, cap)
+    u_lists = _cached_lists(
+        "u_lists", data, (cap,),
+        lambda: build_padded_lists(data.users, data.items, data.values, n_u, cap),
+    )
+    i_lists = _cached_lists(
+        "i_lists", data, (cap,),
+        lambda: build_padded_lists(data.items, data.users, data.values, n_i, cap),
+    )
 
     # local row counts must divide the lax.map block: shrink the block to
     # the local shard size when shards are small
@@ -781,21 +939,25 @@ def train_als_tp(
     u_idx, u_val, u_mask = (_row_pad(a, n_u_pad) for a in u_lists)
     i_idx, i_val, i_mask = (_row_pad(a, n_i_pad) for a in i_lists)
 
-    key = seed_key if seed_key is not None else RandomManager.get_key()
-    if jax.process_count() > 1 and seed_key is None:
-        # every host must init the SAME y0: its sharding replicates along
-        # the cross-host data axis, and per-process urandom-seeded keys
-        # would stitch divergent replicas into a silently corrupt model
-        from jax.experimental import multihost_utils
+    if resume_y is not None:
+        y0 = jnp.asarray(_row_pad(np.asarray(resume_y, dtype=np.float32), n_i_pad))
+    else:
+        key = seed_key if seed_key is not None else RandomManager.get_key()
+        if jax.process_count() > 1 and seed_key is None:
+            # every host must init the SAME y0: its sharding replicates
+            # along the cross-host data axis, and per-process urandom-
+            # seeded keys would stitch divergent replicas into a silently
+            # corrupt model
+            from jax.experimental import multihost_utils
 
-        key = jax.random.wrap_key_data(
-            multihost_utils.broadcast_one_to_all(jax.random.key_data(key))
+            key = jax.random.wrap_key_data(
+                multihost_utils.broadcast_one_to_all(jax.random.key_data(key))
+            )
+        y0 = (
+            jax.random.normal(key, (n_i_pad, features), dtype=jnp.float32) * 0.1
+            + 1.0 / math.sqrt(features)
         )
-    y0 = (
-        jax.random.normal(key, (n_i_pad, features), dtype=jnp.float32) * 0.1
-        + 1.0 / math.sqrt(features)
-    )
-    y0 = y0 * (jnp.arange(n_i_pad) < n_i)[:, None]
+        y0 = y0 * (jnp.arange(n_i_pad) < n_i)[:, None]
 
     row_d = NamedSharding(mesh, P(DATA_AXIS, None))
     row_m = NamedSharding(mesh, P(MODEL_AXIS, None))

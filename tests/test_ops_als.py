@@ -367,3 +367,80 @@ def test_bfloat16_compute_dtype_quality():
     bf16 = genre_score("bfloat16")
     assert f32 > 0.05 and bf16 > 0.05  # both models learned the structure
     assert bf16 > 0.8 * f32  # bf16 within tolerance of full precision
+
+
+def test_checkpointed_training_resume_equals_uninterrupted(tmp_path):
+    """Kill-and-resume must produce EXACTLY the uninterrupted model: the
+    per-sweep carry is fully determined by Y, which is what the
+    checkpoint stores."""
+    import jax
+
+    from oryx_tpu.ops.als import train_als, train_als_checkpointed
+
+    rng = np.random.default_rng(3)
+    data = aggregate_interactions(
+        rng.integers(0, 300, 20_000).astype(str),
+        rng.integers(0, 200, 20_000).astype(str),
+        rng.random(20_000) + 0.1,
+        implicit=True,
+    )
+    key = jax.random.PRNGKey(11)
+    base = train_als(data, features=8, iterations=6, implicit=True, seed_key=key)
+
+    # run the checkpointed variant but ABORT after the first chunk by
+    # training only 2 of 6 sweeps, leaving the checkpoint behind
+    ck = tmp_path / "ck"
+    partial = train_als_checkpointed(
+        data, ck, checkpoint_every=2, features=8, iterations=2,
+        implicit=True, seed_key=key,
+    )
+    # simulate the abort: write the mid-build checkpoint a crash would
+    # have left (the wrapper removes it on success, so recreate it)
+    import json as _json
+
+    fingerprint = _json.dumps(
+        {
+            "n_users": data.n_users, "n_items": data.n_items,
+            "nnz": int(len(data.values)), "features": 8, "lam": 0.001,
+            "alpha": 1.0, "implicit": True, "compute_dtype": "float32",
+            "iterations": 6,
+        },
+        sort_keys=True,
+    )
+    np.savez(ck / "als-train.ckpt.npz.tmp", y=partial.y, done=2, fingerprint=fingerprint)
+    import os
+
+    os.replace(ck / "als-train.ckpt.npz.tmp.npz", ck / "als-train.ckpt.npz")
+
+    resumed = train_als_checkpointed(
+        data, ck, checkpoint_every=2, features=8, iterations=6,
+        implicit=True, seed_key=key,
+    )
+    np.testing.assert_allclose(resumed.x, base.x, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(resumed.y, base.y, rtol=1e-5, atol=1e-6)
+    assert not (ck / "als-train.ckpt.npz").exists()  # removed on success
+
+
+def test_checkpointed_training_ignores_mismatched_checkpoint(tmp_path):
+    """A checkpoint from different data/config restarts cleanly."""
+    from oryx_tpu.ops.als import train_als, train_als_checkpointed
+
+    import jax
+
+    rng = np.random.default_rng(4)
+    data = aggregate_interactions(
+        rng.integers(0, 100, 5_000).astype(str),
+        rng.integers(0, 80, 5_000).astype(str),
+        rng.random(5_000) + 0.1,
+        implicit=True,
+    )
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    (ck / "als-train.ckpt.npz").write_bytes(b"torn garbage")
+    key = jax.random.PRNGKey(2)
+    m = train_als_checkpointed(
+        data, ck, checkpoint_every=2, features=4, iterations=4,
+        implicit=True, seed_key=key,
+    )
+    base = train_als(data, features=4, iterations=4, implicit=True, seed_key=key)
+    np.testing.assert_allclose(m.x, base.x, rtol=1e-5, atol=1e-6)
