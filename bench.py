@@ -421,6 +421,84 @@ def _bench_train_body() -> None:
     )
 
 
+def _bench_speed_body() -> None:
+    """Speed-tier throughput: raw input events -> parse -> aggregate ->
+    vmapped fold-in solves -> UP messages, through the real
+    ALSSpeedModelManager (the reference's 10-second micro-batch loop,
+    ALSSpeedModelManager.buildUpdates). Reported as events/sec so the
+    micro-batch interval can be sized against expected ingest rate."""
+    import json as _json
+
+    import numpy as np
+    import jax
+
+    from oryx_tpu.apps.als.speed import ALSSpeedModelManager
+    from oryx_tpu.common.config import load_config
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    n_items, n_users, features = (
+        (1_000_000, 100_000, 50) if on_accel else (100_000, 10_000, 50)
+    )
+    batch_events = 100_000 if on_accel else 20_000
+
+    rng = np.random.default_rng(3)
+    cfg = load_config(overlay={"oryx.als.hyperparams.features": features})
+    mgr = ALSSpeedModelManager(cfg)
+    # MODEL header then the factor flood, exactly as the update topic would
+    mgr.consume_key_message(
+        "MODEL",
+        _json.dumps({"app": "als", "extensions": {"features": str(features)},
+                     "content": {}}),
+    )
+    st_x = rng.standard_normal((n_users, features)).astype(np.float32)
+    st_y = rng.standard_normal((n_items, features)).astype(np.float32)
+    mgr.state.x.bulk_set([f"u{j}" for j in range(n_users)], st_x)
+    mgr.state.y.bulk_set([f"i{j}" for j in range(n_items)], st_y)
+    mgr.state.set_expected(mgr.state.x.ids(), mgr.state.y.ids())
+
+    def batch():
+        # exactly batch_events UNIQUE (user, item) pairs: the aggregation
+        # dedups pairs, and a varying post-dedup count would change the
+        # vmapped fold batch shape and trigger an XLA recompile inside
+        # the timed region (draw 5% extra, dedup, trim)
+        draw = int(batch_events * 1.05)
+        us = rng.integers(0, n_users, draw)
+        its = rng.integers(0, n_items, draw)
+        _, first = np.unique(us.astype(np.int64) * n_items + its, return_index=True)
+        keep = np.sort(first)[:batch_events]
+        us, its = us[keep], its[keep]
+        return [f"u{u},i{i},1,{j}" for j, (u, i) in enumerate(zip(us, its))]
+
+    # pre-generate outside the timed region: 100k f-string formats per
+    # round are data-generation cost, not speed-tier pipeline cost
+    rounds = 5
+    batches = [batch() for _ in range(rounds)]
+    mgr.build_updates(batch())  # warm: compile the fold-in kernels
+    t0 = time.perf_counter()
+    n_updates = 0
+    for b in batches:
+        n_updates += len(mgr.build_updates(b))
+    dt = time.perf_counter() - t0
+    eps = rounds * batch_events / dt
+    print(
+        f"speed fold-in: {rounds * batch_events} events -> {n_updates} UP "
+        f"messages in {dt:.2f}s on {platform}",
+        file=sys.stderr,
+    )
+    print(
+        _json.dumps(
+            {
+                "metric": "als_speed_events_per_sec",
+                "value": round(eps, 1),
+                "unit": "events/s",
+                "platform": platform,
+                "updates_emitted": n_updates,
+            }
+        )
+    )
+
+
 # --------------------------------------------------------------------------
 # orchestration — no jax import in this process, all backend touches are
 # bounded-time subprocesses
@@ -565,6 +643,16 @@ def main() -> None:
             result["als_build_interactions"] = train.get("interactions")
         else:
             errors.append("training bench failed")
+
+    # speed tier: micro-batch fold-in throughput
+    if result is not None:
+        speed = _run_bench(
+            env_used, timeout=left(300), body="_bench_speed_body", force_cpu=forced
+        )
+        if speed is not None:
+            result["speed_events_per_sec"] = speed.get("value")
+        else:
+            errors.append("speed bench failed")
 
     if result is None:
         result = {

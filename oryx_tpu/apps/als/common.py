@@ -127,16 +127,22 @@ def parse_events(data) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     )
 
 
+def _round6(vector) -> list:
+    # vectorized: a per-element Python round() dominates UP-message cost
+    # at speed-tier rates (two messages per folded event)
+    return np.round(np.asarray(vector, dtype=np.float64), 6).tolist()
+
+
 def x_update_message(user_id: str, vector, known_items) -> tuple[str, str]:
     return "UP", json.dumps(
-        ["X", user_id, [round(float(v), 6) for v in vector], sorted(known_items)],
+        ["X", user_id, _round6(vector), sorted(known_items)],
         separators=(",", ":"),
     )
 
 
 def y_update_message(item_id: str, vector) -> tuple[str, str]:
     return "UP", json.dumps(
-        ["Y", item_id, [round(float(v), 6) for v in vector]], separators=(",", ":")
+        ["Y", item_id, _round6(vector)], separators=(",", ":")
     )
 
 
