@@ -88,7 +88,7 @@ def _bench_body() -> None:
     # same shape (VERDICT #8 — the claim must be a measured number). Each
     # timing chains iterations and materializes only the last result, so
     # the tunnel round-trip is amortized out of the per-dispatch figure.
-    pallas_ms = xla_ms = None
+    pallas_ms = xla_ms = approx_ms = None
     if on_accel:
         from oryx_tpu.ops.als import topk_dot_batch_xla
 
@@ -112,6 +112,20 @@ def _bench_body() -> None:
         except Exception as e:  # noqa: BLE001 - the [B,I] score matrix can
             # OOM where the streaming kernel does not; keep the qps result
             print(f"xla kernel bench failed: {e}", file=sys.stderr)
+        try:
+            from functools import partial as _partial
+
+            @_partial(jax.jit, static_argnames=("kk",))
+            def _approx(xs_, y_, kk):
+                s = jnp.dot(
+                    xs_, y_.T, preferred_element_type=jnp.float32
+                )
+                return jax.lax.approx_max_k(s, kk, recall_target=0.95)
+
+            approx_ms = _time_kernel(lambda: _approx(users, y, kk=k))
+        except Exception as e:  # noqa: BLE001
+            approx_ms = None
+            print(f"approx_max_k bench failed: {e}", file=sys.stderr)
 
     scaled = "" if on_accel else f" [CPU-FALLBACK scale: {n_items} items]"
     shootout = (
@@ -138,6 +152,8 @@ def _bench_body() -> None:
         out["kernel_xla_ms"] = round(xla_ms, 2)
         if pallas_ms:
             out["pallas_speedup"] = round(xla_ms / pallas_ms, 2)
+    if approx_ms is not None:
+        out["kernel_approx_ms"] = round(approx_ms, 2)
     print(json.dumps(out))
 
 
@@ -499,6 +515,75 @@ def _bench_speed_body() -> None:
     )
 
 
+def _bench_kmeans_rdf_body() -> None:
+    """Build wall-clocks for the other two packaged model families —
+    k-means (Lloyd's + k-means|| init) and random decision forest
+    (vectorized histogram growth) — so every app tier has a measured
+    training number, not just ALS."""
+    import json as _json
+
+    import numpy as np
+    import jax
+
+    from oryx_tpu.common.rng import RandomManager
+    from oryx_tpu.ops.kmeans import train_kmeans
+    from oryx_tpu.ops.rdf import bin_dataset, grow_forest
+
+    RandomManager.use_test_seed(9)
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    n_pts, dims, k = (5_000_000, 20, 100) if on_accel else (500_000, 20, 50)
+    n_ex, n_feat, trees, depth = (
+        (1_000_000, 20, 20, 10) if on_accel else (100_000, 20, 10, 8)
+    )
+
+    rng = np.random.default_rng(11)
+    # clustered points so Lloyd's has real structure to find
+    centers_true = rng.standard_normal((k, dims)) * 5
+    pts = (
+        centers_true[rng.integers(0, k, n_pts)]
+        + rng.standard_normal((n_pts, dims))
+    ).astype(np.float32)
+    t0 = time.perf_counter()
+    km = train_kmeans(pts, k=k, iterations=10)
+    km_s = time.perf_counter() - t0
+
+    X = rng.standard_normal((n_ex, n_feat)).astype(np.float32)
+    yv = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.int32)
+    t0 = time.perf_counter()
+    binned = bin_dataset(
+        X,
+        is_categorical=np.zeros(n_feat, dtype=bool),
+        category_counts=np.zeros(n_feat, dtype=np.int32),
+        max_split_candidates=32,
+    )
+    forest = grow_forest(
+        binned, yv, num_trees=trees, max_depth=depth,
+        impurity="entropy", n_classes=2,
+    )
+    rdf_s = time.perf_counter() - t0
+
+    print(
+        f"kmeans {n_pts}x{dims} k={k}: {km_s:.1f}s; "
+        f"rdf {n_ex}x{n_feat} {trees}t d{depth}: {rdf_s:.1f}s on {platform}",
+        file=sys.stderr,
+    )
+    print(
+        _json.dumps(
+            {
+                "metric": "kmeans_rdf_build_seconds",
+                "value": round(km_s + rdf_s, 1),
+                "unit": "s",
+                "platform": platform,
+                "kmeans_seconds": round(km_s, 1),
+                "kmeans_points": n_pts,
+                "rdf_seconds": round(rdf_s, 1),
+                "rdf_examples": n_ex,
+            }
+        )
+    )
+
+
 # --------------------------------------------------------------------------
 # orchestration — no jax import in this process, all backend touches are
 # bounded-time subprocesses
@@ -628,7 +713,10 @@ def main() -> None:
         )
         if kernel is not None:
             result["kernel_qps"] = kernel.get("value")
-            for extra in ("kernel_pallas_ms", "kernel_xla_ms", "pallas_speedup"):
+            for extra in (
+                "kernel_pallas_ms", "kernel_xla_ms", "pallas_speedup",
+                "kernel_approx_ms",
+            ):
                 if extra in kernel:
                     result[extra] = kernel[extra]
 
@@ -653,6 +741,18 @@ def main() -> None:
             result["speed_events_per_sec"] = speed.get("value")
         else:
             errors.append("speed bench failed")
+
+    # the other two model families: k-means + forest build wall-clocks
+    if result is not None:
+        kr = _run_bench(
+            env_used, timeout=left(420), body="_bench_kmeans_rdf_body",
+            force_cpu=forced,
+        )
+        if kr is not None:
+            result["kmeans_build_seconds"] = kr.get("kmeans_seconds")
+            result["rdf_build_seconds"] = kr.get("rdf_seconds")
+        else:
+            errors.append("kmeans/rdf bench failed")
 
     if result is None:
         result = {

@@ -92,6 +92,12 @@ class FactorStore:
         with self._lock.read():
             return self._n
 
+    def nbytes(self) -> int:
+        """Host arena bytes (capacity, not just occupancy) — the serving
+        memory figure the reference's heap table tracks per model size."""
+        with self._lock.read():
+            return int(self._arena.nbytes)
+
     def ids(self) -> list[str]:
         with self._lock.read():
             return list(self._rev)

@@ -309,6 +309,7 @@ def register(app: ServingApp) -> None:
         model = _model(a)  # 503s before the model is queryable
         st = model.state
         known = st.known_items_snapshot()
+        mb = (st.x.nbytes() + st.y.nbytes()) / 1e6
         return [
             ("users (X rows)", len(st.x)),
             ("items (Y rows)", len(st.y)),
@@ -317,6 +318,28 @@ def register(app: ServingApp) -> None:
             ("users with known items", len(known)),
             ("known-item pairs", sum(len(s) for s in known.values())),
             ("LSH sample rate", model.sample_rate),
+            ("host factor arenas", f"{mb:.1f} MB"),
         ]
 
     app.console_sections.append(("ALS model", _als_console))
+
+    # memory parity metric: the reference's performance page tracks heap MB
+    # per (users+items) x features; this is the equivalent host-side figure
+    import weakref
+
+    from oryx_tpu.common.metrics import GaugeSeriesGone, get_registry
+
+    ref = weakref.ref(app)
+
+    def _model_bytes() -> float:
+        a = ref()
+        if a is None:
+            raise GaugeSeriesGone("app gone")
+        model = a.model_manager.get_model()
+        if model is None:
+            return 0.0
+        return float(model.state.x.nbytes() + model.state.y.nbytes())
+
+    get_registry().gauge(
+        "oryx_als_model_bytes", "Host factor-arena bytes (X + Y)"
+    ).set_function(_model_bytes, manager=type(app.model_manager).__name__)

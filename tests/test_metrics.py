@@ -142,3 +142,33 @@ def test_serving_metrics_endpoint(tmp_path):
     assert 'method="GET"' in text
     assert "oryx_serving_model_load_fraction" in text
     assert "oryx_serving_request_seconds_bucket" in text
+
+
+def test_als_model_bytes_gauge():
+    """The ALS memory gauge reports the host arena bytes once a model is
+    loaded (the reference's heap-per-model-size table analogue)."""
+    import numpy as np
+
+    from oryx_tpu.apps.als.serving import ALSServingModel, ALSServingModelManager
+    from oryx_tpu.apps.als.state import ALSState
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.common.metrics import get_registry
+    from oryx_tpu.serving.app import ServingApp
+
+    cfg = load_config(overlay={
+        "oryx.serving.application-resources": [
+            "oryx_tpu.serving.resources.common",
+            "oryx_tpu.serving.resources.als",
+        ],
+    })
+    state = ALSState(8, implicit=True)
+    state.x.bulk_set(["u1", "u2"], np.ones((2, 8), dtype=np.float32))
+    state.y.bulk_set(["i1"], np.ones((1, 8), dtype=np.float32))
+    mgr = ALSServingModelManager(cfg)
+    mgr.model = ALSServingModel(state)
+    app = ServingApp(cfg, mgr)
+    text = get_registry().render_prometheus()
+    line = [l for l in text.splitlines() if l.startswith("oryx_als_model_bytes{")]
+    assert line, text[-500:]
+    assert float(line[0].rsplit(" ", 1)[1]) >= 3 * 8 * 4  # >= occupied bytes
+    del app
