@@ -85,6 +85,23 @@ def lloyd_jit(points, weights, centers0, *, iterations: int):
     return centers, counts
 
 
+def _pad_centers_pow2(centers: np.ndarray) -> np.ndarray:
+    """Pad the center count to a power of two by REPEATING row 0: argmin
+    returns the first of tied rows, so a padding duplicate can never win
+    over the original and assignments/distances are unchanged. (Infinity
+    padding would poison the expanded ||p||^2 - 2p.c + ||c||^2 distance
+    form.) The jit cache then sees a handful of shapes instead of one per
+    candidate-set size — the growing k-means|| candidate set was
+    recompiling the distance kernel, and re-uploading the full point set,
+    every round."""
+    c = len(centers)
+    p = 1 << max(0, c - 1).bit_length()
+    if p == c:
+        return centers
+    pad = np.broadcast_to(centers[0], (p - c, centers.shape[1]))
+    return np.concatenate([centers, pad])
+
+
 def _kmeans_parallel_init(
     points: np.ndarray, weights: np.ndarray, k: int, key, rounds: int = 5
 ) -> np.ndarray:
@@ -93,20 +110,30 @@ def _kmeans_parallel_init(
     keys = jax.random.split(key, rounds + 2)
     first = int(jax.random.randint(keys[0], (), 0, n))
     candidates = [points[first]]
+    new = [points[first]]
     ell = 2 * k
+    pts_j = jnp.asarray(points)  # one host->device upload for all rounds
+    d2 = None  # running min squared distance to ANY candidate so far:
+    # each round only scores the centers added last round, instead of
+    # rescanning the whole growing candidate set (2-3x less distance work)
     for r in range(rounds):
-        centers = np.stack(candidates)
-        _, dist = assign_clusters(jnp.asarray(points), jnp.asarray(centers))
-        d2 = np.asarray(dist, dtype=np.float64) ** 2 * weights
-        total = d2.sum()
+        if new:  # an empty round keeps d2 and simply redraws below
+            _, dist = assign_clusters(
+                pts_j, jnp.asarray(_pad_centers_pow2(np.stack(new)))
+            )
+            nd2 = np.asarray(dist, dtype=np.float64) ** 2
+            d2 = nd2 if d2 is None else np.minimum(d2, nd2)
+        dw = d2 * weights
+        total = dw.sum()
         if total <= 0:
             break
-        prob = np.minimum(1.0, ell * d2 / total)
+        prob = np.minimum(1.0, ell * dw / total)
         draw = np.asarray(
             jax.random.uniform(keys[r + 1], (n,), dtype=jnp.float32)
         )
         picked = np.nonzero(draw < prob)[0]
-        candidates.extend(points[j] for j in picked)
+        new = [points[j] for j in picked]
+        candidates.extend(new)
         if len(candidates) >= max(ell * rounds, k):
             break
     cand = np.unique(np.stack(candidates), axis=0)
@@ -121,8 +148,10 @@ def _kmeans_parallel_init(
     # duplicate-heavy data may simply not have k distinct points: clamp,
     # matching the reference's tolerance of k > distinct-count inputs
     k = min(k, len(cand))
-    # weight candidates by the total point weight attracted to each
-    ids, _ = assign_clusters(jnp.asarray(points), jnp.asarray(cand))
+    # weight candidates by the total point weight attracted to each.
+    # (padding rows duplicate row 0 and argmin keeps the FIRST of tied
+    # rows, so ids stay within len(cand) — see _pad_centers_pow2)
+    ids, _ = assign_clusters(pts_j, jnp.asarray(_pad_centers_pow2(cand)))
     w = np.zeros(len(cand), dtype=np.float32)
     np.add.at(w, np.asarray(ids), weights.astype(np.float32))
     # reduce candidates -> k centers (weighted Lloyd from a random k-subset)
