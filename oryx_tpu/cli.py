@@ -231,10 +231,111 @@ def cmd_speed(config: Config) -> int:
     return _run_until_interrupt(SpeedLayer(config))
 
 
-def cmd_serving(config: Config) -> int:
+def cmd_serving(config: Config, argv: list[str] | None = None) -> int:
     from oryx_tpu.serving.server import ServingLayer
 
+    n_procs = config.get_int("oryx.serving.api.processes", 1)
+    import os
+
+    if n_procs > 1 and not os.environ.get("ORYX_SERVING_REPLICA"):
+        return _supervise_serving_replicas(config, n_procs, argv or [])
     return _run_until_interrupt(ServingLayer(config))
+
+
+def _supervise_serving_replicas(config: Config, n_procs: int, argv: list[str]) -> int:
+    """Run N full serving replicas sharing one port via SO_REUSEPORT — the
+    kernel load-balances connections, each replica replays the update topic
+    into its own model, and per-process GIL ceilings multiply out.
+
+    Requires a fixed port and a cross-process broker (file:// or kafka://;
+    mem:// is per-process). Replicas that die are restarted; SIGTERM/INT
+    fans out. NOTE: accelerator-backed scoring is per-process — replicas
+    on a single-chip host should run with JAX_PLATFORMS=cpu (one chip
+    cannot be opened by several processes)."""
+    import os
+    import subprocess
+    import time as _time
+
+    if config.get_int("oryx.serving.api.port", 0) == 0:
+        raise SystemExit("oryx.serving.api.processes > 1 requires a fixed port")
+    broker = config.get_string("oryx.update-topic.broker", "")
+    if broker.startswith("mem://"):
+        raise SystemExit("serving replicas need a cross-process broker, not mem://")
+
+    env = dict(os.environ, ORYX_SERVING_REPLICA="1")
+    cmd = [sys.executable, "-m", "oryx_tpu.cli", "serving", *argv]
+    procs: list[subprocess.Popen] = []
+    stopping = False
+    log_ = logging.getLogger(__name__)
+
+    def spawn() -> subprocess.Popen | None:
+        if stopping:
+            return None
+        return subprocess.Popen(cmd, env=env)
+
+    def shutdown(*_):
+        nonlocal stopping
+        stopping = True
+
+    old = signal.signal(signal.SIGTERM, shutdown)
+    rc_out = 0
+    try:
+        for _ in range(n_procs):
+            p = spawn()
+            if p is not None:
+                procs.append(p)
+        log_.info(
+            "serving supervisor: %d replicas on port %d",
+            n_procs,
+            config.get_int("oryx.serving.api.port", 0),
+        )
+        consec_fast_fails = 0
+        backoff = 1.0
+        while not stopping:
+            for i, p in enumerate(procs):
+                rc = p.poll()
+                if rc is not None and not stopping:
+                    # a replica that dies within seconds of spawn is a
+                    # crash loop (bad config, port conflict): back off,
+                    # and give up after repeated immediate failures so
+                    # the operator/init system sees a nonzero exit
+                    consec_fast_fails += 1
+                    if consec_fast_fails >= 3 * n_procs:
+                        log_.error(
+                            "serving replicas crash-looping (rc=%s); giving up",
+                            rc,
+                        )
+                        stopping = True
+                        rc_out = 1
+                        break
+                    log_.warning(
+                        "serving replica died (rc=%s); restarting in %.0fs",
+                        rc, backoff,
+                    )
+                    _time.sleep(backoff)
+                    backoff = min(backoff * 2, 30.0)
+                    np_ = spawn()
+                    if np_ is not None:
+                        procs[i] = np_
+            if not stopping and all(p.poll() is None for p in procs):
+                # a full pass with every replica alive clears the
+                # crash-loop counters
+                consec_fast_fails = 0
+                backoff = 1.0
+            _time.sleep(1.0)
+    except KeyboardInterrupt:
+        shutdown()
+    finally:
+        for p in procs:  # fan out termination even to late spawns
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        signal.signal(signal.SIGTERM, old)
+    return rc_out
 
 
 def cmd_loadtest(config: Config, args) -> int:
@@ -356,10 +457,16 @@ def main(argv=None) -> int:
         return cmd_import_pmml(config, args.pmml)
     if args.command == "loadtest":
         return cmd_loadtest(config, args)
+    if args.command == "serving":
+        # replica children re-run this exact command line minus the
+        # subcommand token (argparse accepts options BEFORE the
+        # positional, so strip the first "serving", wherever it is)
+        raw = list(argv if argv is not None else sys.argv[1:])
+        raw.remove("serving")
+        return cmd_serving(config, raw)
     return {
         "batch": cmd_batch,
         "speed": cmd_speed,
-        "serving": cmd_serving,
         "setup": cmd_setup,
         "tail": cmd_tail,
         "input": cmd_input,

@@ -62,11 +62,13 @@ class AsyncHTTPServer:
         port: int,
         ssl_context: ssl.SSLContext | None = None,
         workers: int = 128,
+        reuse_port: bool = False,
     ):
         self.app = app
         self.auth = auth
         self.port = port
         self._ssl = ssl_context
+        self._reuse_port = reuse_port
         self._pool = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix="oryx-serving-worker"
         )
@@ -119,6 +121,9 @@ class AsyncHTTPServer:
                     self.port,
                     ssl=self._ssl,
                     backlog=1024,
+                    # lets N replica processes share one port, the kernel
+                    # load-balancing connections across them
+                    reuse_port=self._reuse_port or None,
                 )
             )
             self.port = self._server.sockets[0].getsockname()[1]

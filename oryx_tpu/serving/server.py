@@ -106,12 +106,26 @@ class ServingLayer:
                 self.port,
                 ssl_context=ctx,
                 workers=self.config.get_int("oryx.serving.api.workers", 128),
+                reuse_port=self.config.get_int("oryx.serving.api.processes", 1) > 1,
             )
             self._aio_server.start()
             self.port = self._aio_server.port
         else:
             handler = _make_handler(self.app, auth)
-            self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+            if self.config.get_int("oryx.serving.api.processes", 1) > 1:
+                # replica mode shares the port across processes
+                import socket
+
+                class _ReusePortServer(ThreadingHTTPServer):
+                    def server_bind(self):
+                        self.socket.setsockopt(
+                            socket.SOL_SOCKET, socket.SO_REUSEPORT, 1
+                        )
+                        super().server_bind()
+
+                self._httpd = _ReusePortServer(("0.0.0.0", self.port), handler)
+            else:
+                self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
             if ctx is not None:
                 # defer the handshake to the per-connection handler thread —
                 # with the default handshake-on-accept, one client that opens

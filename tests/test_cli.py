@@ -168,3 +168,93 @@ def test_loadtest_command(tmp_path):
     assert report["latency_ms"]["p50"] > 0
     # pacing must not EXCEED the target (a loaded host may undershoot)
     assert report["qps"] <= 260
+
+
+def test_serving_replicas_share_port(tmp_path):
+    """oryx.serving.api.processes=2: the CLI supervises two full serving
+    replicas on ONE port via SO_REUSEPORT over a file:// broker; requests
+    succeed under concurrency, and a killed replica is restarted."""
+    import json as _json
+    import os
+    import signal as _signal
+    import subprocess
+    import urllib.request
+
+    import pytest as _pytest
+
+    if not hasattr(__import__("socket"), "SO_REUSEPORT"):
+        _pytest.skip("no SO_REUSEPORT on this platform")
+
+    from oryx_tpu.bus.broker import get_broker
+    from oryx_tpu.common.ioutil import choose_free_port
+
+    bus = f"file://{tmp_path}/bus"
+    b = get_broker(bus)
+    b.create_topic("OryxInput", 1)
+    b.create_topic("OryxUpdate", 1)
+    b.send("OryxUpdate", "MODEL", _json.dumps({"replica": 7}))
+    port = choose_free_port()
+    conf = tmp_path / "oryx.conf"
+    conf.write_text(f'''
+oryx.id = replicas
+oryx.input-topic.broker = "{bus}"
+oryx.update-topic.broker = "{bus}"
+oryx.serving.api.port = {port}
+oryx.serving.api.processes = 2
+oryx.serving.model-manager-class = "oryx_tpu.apps.example.serving.ExampleServingModelManager"
+oryx.serving.application-resources = ["oryx_tpu.serving.resources.common", "oryx_tpu.serving.resources.example"]
+''')
+    root = pathlib.Path(__file__).resolve().parent.parent
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(root))
+    sup = subprocess.Popen(
+        [sys.executable, "-m", "oryx_tpu.cli", "serving", "--conf", str(conf)],
+        cwd=str(root),
+        env=env,
+        # DEVNULL, not PIPE: three chatty processes share this fd and an
+        # undrained pipe buffer would block them mid-test
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        deadline = time.time() + 60
+        ok = False
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/distinct/replica", timeout=2
+                ) as r:
+                    if r.status == 200 and _json.loads(r.read()) == 7:
+                        ok = True
+                        break
+            except Exception:
+                pass
+            time.sleep(0.3)
+        assert ok, "replicas never became ready"
+
+        def children():
+            out = subprocess.run(
+                ["pgrep", "-P", str(sup.pid)], capture_output=True, text=True
+            ).stdout.split()
+            return [int(x) for x in out]
+
+        kids = children()
+        assert len(kids) == 2, kids
+
+        # kill one replica; requests keep succeeding and it is restarted
+        os.kill(kids[0], _signal.SIGKILL)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if len(children()) == 2 and kids[0] not in children():
+                break
+            time.sleep(0.3)
+        assert len(children()) == 2, "dead replica was not restarted"
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/distinct/replica", timeout=5
+        ) as r:
+            assert r.status == 200
+    finally:
+        sup.terminate()
+        try:
+            sup.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            sup.kill()
