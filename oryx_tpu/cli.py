@@ -138,7 +138,11 @@ def cmd_tail(config: Config) -> int:
 
 def cmd_input(config: Config) -> int:
     """Pump stdin lines into the input topic, keyed by line hash
-    (oryx-run.sh kafka-input; keying as AbstractOryxResource.sendInput)."""
+    (oryx-run.sh kafka-input; keying as AbstractOryxResource.sendInput).
+    crc32, not the builtin hash: the builtin is salted per process and
+    would shuffle partition assignment between runs."""
+    import zlib
+
     from oryx_tpu.bus.broker import get_broker
 
     uri, topic, _ = _topic_pairs(config)[0]
@@ -147,7 +151,7 @@ def cmd_input(config: Config) -> int:
     for line in sys.stdin:
         line = line.rstrip("\n")
         if line:
-            broker.send(topic, str(abs(hash(line)) % (1 << 31)), line)
+            broker.send(topic, str(zlib.crc32(line.encode("utf-8"))), line)
             n += 1
     print(f"sent {n} lines to {topic}", file=sys.stderr)
     return 0
@@ -256,11 +260,17 @@ def _supervise_serving_replicas(config: Config, n_procs: int, argv: list[str]) -
     import subprocess
     import time as _time
 
+    import socket as _socket
+
     if config.get_int("oryx.serving.api.port", 0) == 0:
         raise SystemExit("oryx.serving.api.processes > 1 requires a fixed port")
-    broker = config.get_string("oryx.update-topic.broker", "")
-    if broker.startswith("mem://"):
-        raise SystemExit("serving replicas need a cross-process broker, not mem://")
+    for key in ("oryx.update-topic.broker", "oryx.input-topic.broker"):
+        if config.get_string(key, "").startswith("mem://"):
+            raise SystemExit(
+                f"serving replicas need a cross-process broker; {key} is mem://"
+            )
+    if not hasattr(_socket, "SO_REUSEPORT"):
+        raise SystemExit("serving replicas require SO_REUSEPORT on this platform")
 
     env = dict(os.environ, ORYX_SERVING_REPLICA="1")
     cmd = [sys.executable, "-m", "oryx_tpu.cli", "serving", *argv]
@@ -268,10 +278,14 @@ def _supervise_serving_replicas(config: Config, n_procs: int, argv: list[str]) -
     stopping = False
     log_ = logging.getLogger(__name__)
 
+    spawn_at: dict[int, float] = {}  # pid -> spawn timestamp
+
     def spawn() -> subprocess.Popen | None:
         if stopping:
             return None
-        return subprocess.Popen(cmd, env=env)
+        p = subprocess.Popen(cmd, env=env)
+        spawn_at[p.pid] = _time.monotonic()
+        return p
 
     def shutdown(*_):
         nonlocal stopping
@@ -317,9 +331,14 @@ def _supervise_serving_replicas(config: Config, n_procs: int, argv: list[str]) -
                     np_ = spawn()
                     if np_ is not None:
                         procs[i] = np_
-            if not stopping and all(p.poll() is None for p in procs):
-                # a full pass with every replica alive clears the
-                # crash-loop counters
+            now = _time.monotonic()
+            if not stopping and all(
+                p.poll() is None and now - spawn_at.get(p.pid, now) >= 10.0
+                for p in procs
+            ):
+                # counters clear only once every replica has SURVIVED a
+                # while — "alive at the instant of the check" describes
+                # a freshly respawned crash-looper too
                 consec_fast_fails = 0
                 backoff = 1.0
             _time.sleep(1.0)
