@@ -14,12 +14,11 @@ import json
 import re
 import time
 import weakref
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable
 
 from oryx_tpu.api import ServingModelManager
 from oryx_tpu.bus.api import TopicProducer
-from oryx_tpu.common.classutil import load_class
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.metrics import GaugeSeriesGone, get_registry
 
