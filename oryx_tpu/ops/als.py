@@ -1075,6 +1075,11 @@ def topk_dot_batch_xla(xs, y, *, k: int):
 
 _pallas_failed_shapes: set = set()
 
+# Largest k dispatched to the fused Pallas kernel. The serving
+# micro-batcher derives a k bucket from this so default /recommend
+# overfetch (k=18) stays on the fused path — keep them coupled.
+PALLAS_TOPK_MAX_K = 32
+
 
 def topk_dot_batch(xs, y, *, k: int):
     """Batched top-k scoring with automatic kernel selection: the fused
@@ -1090,7 +1095,7 @@ def topk_dot_batch(xs, y, *, k: int):
         xs = jnp.asarray(xs, dtype=y.dtype)
     sig = (xs.shape, y.shape, xs.dtype, y.dtype, k)
     if (
-        k <= 16
+        k <= PALLAS_TOPK_MAX_K
         and n_items >= 32768
         and sig not in _pallas_failed_shapes
         and jax.default_backend() == "tpu"

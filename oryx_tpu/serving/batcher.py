@@ -36,10 +36,15 @@ import numpy as np
 
 log = logging.getLogger(__name__)
 
+from oryx_tpu.ops.als import PALLAS_TOPK_MAX_K
+
 # k rounds up to the smallest of these (then min'd with the item count);
-# larger requests fall back to next_pow2(k). Two buckets cover every
-# realistic how_many + exclusion overfetch without recompiles.
-K_BUCKETS = (16, 128, 1024)
+# larger requests fall back to next_pow2(k). A few buckets cover every
+# realistic how_many + exclusion overfetch without recompiles. The
+# PALLAS_TOPK_MAX_K bucket matters: a default /recommend?howMany=10
+# overfetches to k=18, and this bucket keeps it on the fused Pallas path
+# instead of jumping to the 128 bucket's XLA fallback.
+K_BUCKETS = (16, PALLAS_TOPK_MAX_K, 128, 1024)
 
 MAX_BATCH = 4096  # rows per device dispatch (the bench-measured knee)
 

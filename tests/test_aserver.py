@@ -237,3 +237,44 @@ def test_route_precedence():
 
     assert get("/specific") == "literal"
     assert get("/other") == "wildcard"
+
+
+def test_threaded_frontend_reuse_port():
+    """With processes > 1 configured, the threaded frontend also binds with
+    SO_REUSEPORT — two servers share one port inside one process."""
+    import socket as _socket
+
+    if not hasattr(_socket, "SO_REUSEPORT"):
+        pytest.skip("no SO_REUSEPORT")
+    from oryx_tpu.common.ioutil import choose_free_port
+
+    bus = "mem://aserver-rp"
+    _setup_bus(bus)
+    port = choose_free_port()
+    cfg = _config(
+        bus, "threaded",
+        **{"oryx.serving.api.port": port, "oryx.serving.api.processes": 2},
+    )
+    with ServingLayer(cfg), ServingLayer(cfg):
+        # /ready only proves ONE of the two kernel-balanced servers has
+        # loaded its model; poll fresh connections until several in a row
+        # succeed so both sockets are warm before asserting
+        import time as _time
+
+        deadline = _time.time() + 30
+        streak = 0
+        while _time.time() < deadline and streak < 6:
+            try:
+                c = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+                c.request("GET", "/distinct/word")
+                r = c.getresponse()
+                body = r.read()
+                c.close()
+                if r.status == 200 and json.loads(body) == 2:
+                    streak += 1
+                    continue
+            except Exception:
+                pass
+            streak = 0
+            _time.sleep(0.1)
+        assert streak >= 6, "both reuse-port servers never became ready"

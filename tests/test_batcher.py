@@ -30,7 +30,10 @@ def _direct(vec, k, y):
 def test_k_bucket():
     assert k_bucket(1) == 16
     assert k_bucket(16) == 16
-    assert k_bucket(17) == 128
+    # 17..32 stay on the fused-kernel-eligible 32 bucket (a default
+    # howMany=10 overfetches to 18)
+    assert k_bucket(17) == 32
+    assert k_bucket(33) == 128
     assert k_bucket(128) == 128
     assert k_bucket(129) == 1024
     assert k_bucket(5000) == 8192

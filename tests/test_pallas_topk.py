@@ -38,6 +38,9 @@ def test_uneven_batch_and_items():
 def test_k_equals_one_and_larger_k():
     _check(b=4, n_items=300, feats=8, k=1)
     _check(b=4, n_items=300, feats=8, k=16)
+    # 32 is the serving micro-batcher's bucket for default /recommend
+    # overfetch (k=18 -> 32) — the fused-kernel dispatch bound
+    _check(b=4, n_items=300, feats=8, k=32)
 
 
 def test_single_item_block():
