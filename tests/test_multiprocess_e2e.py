@@ -197,3 +197,70 @@ def _http_post(url, body, timeout=10):
             return resp.status, resp.read().decode()
     except urllib.error.HTTPError as e:
         return e.code, e.read().decode()
+
+
+def test_batch_build_killed_and_resumed(tmp_path):
+    """A batch build hard-killed mid-training (SIGKILL-equivalent process
+    exit between checkpoint writes) resumes from the last checkpointed
+    sweep in a fresh process — config-driven, through ALSUpdate."""
+    import os
+
+    import numpy as np
+
+    worker = """
+import sys, os, logging
+logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+sys.path.insert(0, sys.argv[3])
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from oryx_tpu.common.config import load_config
+from oryx_tpu.common.rng import RandomManager
+from oryx_tpu.apps.als.batch import ALSUpdate
+from oryx_tpu.bus.api import KeyMessage
+td = sys.argv[1]
+cfg = load_config(overlay={
+    "oryx.batch.storage.model-dir": td + "/models",
+    "oryx.als.hyperparams.features": 8,
+    "oryx.als.hyperparams.iterations": 6,
+    "oryx.als.checkpoint-interval": 2,
+    "oryx.ml.eval.test-fraction": 0.0,
+})
+RandomManager.use_test_seed(77)
+rng = np.random.default_rng(1)
+lines = [KeyMessage(None, f"u{u},i{i},1,{j}") for j, (u, i) in enumerate(
+    zip(rng.integers(0, 200, 8000), rng.integers(0, 150, 8000)))]
+upd = ALSUpdate(cfg, mesh=None)
+if sys.argv[2] == "abort":
+    import oryx_tpu.ops.als as als
+    orig = als.train_als
+    calls = {"n": 0}
+    def wrapped(*a, **k):
+        m = orig(*a, **k)
+        calls["n"] += 1
+        if calls["n"] == 2:
+            os._exit(9)  # die between chunk 2's compute and its checkpoint
+        return m
+    als.train_als = wrapped
+art = upd.build_model(lines, {"features": 8, "lambda": 0.001, "alpha": 1.0})
+print("BUILD_OK", art.tensors["X"].shape, flush=True)
+"""
+    root = str(REPO)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p1 = subprocess.run(
+        [sys.executable, "-c", worker, str(tmp_path), "abort", root],
+        env=env, capture_output=True, text=True, timeout=150,
+    )
+    assert p1.returncode == 9, (p1.returncode, p1.stderr[-500:])
+    ck = tmp_path / "models" / ".als-checkpoint"
+    cks = list(ck.rglob("als-train.ckpt.npz"))
+    assert cks, "no checkpoint left behind by the killed build"
+    with np.load(cks[0]) as z:
+        assert int(z["done"]) == 2
+
+    p2 = subprocess.run(
+        [sys.executable, "-c", worker, str(tmp_path), "run", root],
+        env=env, capture_output=True, text=True, timeout=150,
+    )
+    assert p2.returncode == 0 and "BUILD_OK" in p2.stdout, p2.stderr[-500:]
+    assert "resuming ALS build from checkpoint: 2/6" in p2.stderr, p2.stderr[-500:]
+    assert not list(ck.rglob("als-train.ckpt.npz"))  # consumed on success
