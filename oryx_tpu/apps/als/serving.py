@@ -22,7 +22,7 @@ from oryx_tpu.api import AbstractServingModelManager, ServingModel
 from oryx_tpu.common.config import Config
 from oryx_tpu.ops.als import compute_updated_xu
 from oryx_tpu.apps.als.common import ALSConfig
-from oryx_tpu.serving.batcher import TopKBatcher
+from oryx_tpu.serving.batcher import TopKBatcher, host_topk
 from oryx_tpu.apps.als.state import ALSState, apply_update_message
 
 log = logging.getLogger(__name__)
@@ -164,13 +164,11 @@ class ALSServingModel(ServingModel):
             if rows.size == 0:
                 return []
             cand = y_host[rows]
-            sub = cand @ np.asarray(user_vector, dtype=np.float32)
-            if cosine:
-                sub = sub / np.maximum(np.linalg.norm(cand, axis=1), 1e-12)
-            k = min(k, rows.size)
-            top = np.argpartition(-sub, k - 1)[:k]
-            top = top[np.argsort(-sub[top])]
-            vals, idx = sub[top], rows[top]
+            vals, top = host_topk(
+                np.asarray(user_vector, dtype=np.float32),
+                min(k, rows.size), cand, cosine,
+            )
+            idx = rows[top]
         else:
             if cosine:
                 y, ids, host_mat = self._y_unit_view()
@@ -184,7 +182,11 @@ class ALSServingModel(ServingModel):
             # dispatch (serving/batcher.py) — B=1 matmuls waste the MXU and
             # a data-dependent k would recompile per exclusion-set size.
             k = min(n, how_many + len(exclude) + 8)
-            vals, idx = TopKBatcher.shared().submit(user_vector, k, y)
+            # host_mat doubles as the wedged-device fallback: the batcher
+            # scores on the host if the accelerator transport hangs
+            vals, idx = TopKBatcher.shared().submit(
+                user_vector, k, y, host_mat=host_mat, cosine=cosine
+            )
             # The device scan selects candidates in bf16 (half the HBM
             # traffic of the memory-bound sweep); near-ties inside the
             # candidate set are then re-ranked EXACTLY by one vectorized

@@ -278,10 +278,26 @@ def _half_step(
                            precision=prec,
                            preferred_element_type=jnp.float32)
         chol = jnp.linalg.cholesky(a)
+        # bf16-assembled normal equations can round a marginal system
+        # indefinite (the MXU rounds einsum INPUTS to bf16; observed at
+        # ML-25M scale: one failed factorization NaN-poisons gram() and
+        # with it the whole next half-sweep). Retry non-finite rows with
+        # trace-scaled jitter — the ALS analogue of the reference solver's
+        # singularity guard (ops/solver.py; Solver.java ill-conditioned
+        # check) — and zero whatever still fails: a zero row re-enters the
+        # next half-sweep cleanly and is re-solved from scratch.
+        ok = jnp.isfinite(chol).all(axis=(-2, -1), keepdims=True)
+        jitter = (
+            0.02 * jnp.trace(a, axis1=-2, axis2=-1) / k + 1e-6
+        )[:, None, None]
+        chol = jnp.where(
+            ok, chol, jnp.linalg.cholesky(a + jitter * eye[None])
+        )
         y = jax.scipy.linalg.solve_triangular(chol, b[..., None], lower=True)
         x = jax.scipy.linalg.solve_triangular(
             jnp.swapaxes(chol, -1, -2), y, lower=False
         )[..., 0]
+        x = jnp.where(jnp.isfinite(x).all(axis=-1, keepdims=True), x, 0.0)
         # rows with no interactions (all-pad) solve to ~0 already (b = 0)
         return x
 
@@ -335,6 +351,23 @@ class ALSModelArrays:
     y: np.ndarray  # [n_items, K]
     user_ids: list[str]
     item_ids: list[str]
+
+
+def _finish_model(x, y, n_u: int, n_i: int, data) -> ALSModelArrays:
+    """Trim padding and surface solver-guard diagnostics. An all-zero
+    factor row is almost always the _half_step singularity guard zeroing an
+    unsolvable system in the final sweep (explicit rows whose aggregated
+    ratings are all exactly zero also land here) — worth a warning, never
+    worth a NaN."""
+    x = np.asarray(x)[:n_u]
+    y = np.asarray(y)[:n_i]
+    zeroed = int((~x.any(axis=1)).sum() + (~y.any(axis=1)).sum())
+    if zeroed:
+        log.warning(
+            "ALS: %d all-zero factor rows (singularity guard, or all-zero "
+            "explicit ratings) of %d users + %d items", zeroed, n_u, n_i,
+        )
+    return ALSModelArrays(x, y, data.user_ids, data.item_ids)
 
 
 def train_als(
@@ -416,9 +449,7 @@ def train_als(
             blocks_u=tuple(blocks_u), blocks_i=tuple(blocks_i), n_u=n_u_pad,
             compute_dtype=compute_dtype,
         )
-        return ALSModelArrays(
-            np.asarray(x)[:n_u], np.asarray(y)[:n_i], data.user_ids, data.item_ids
-        )
+        return _finish_model(x, y, n_u, n_i, data)
 
     # mesh path: one global width, rows padded to a common multiple of the
     # chunk block and the mesh "data" axis so lax.map reshapes and shard
@@ -469,9 +500,7 @@ def train_als(
         block=blk,
         compute_dtype=compute_dtype,
     )
-    return ALSModelArrays(
-        np.asarray(x)[:n_u], np.asarray(y)[:n_i], data.user_ids, data.item_ids
-    )
+    return _finish_model(x, y, n_u, n_i, data)
 
 
 def train_als_checkpointed(
@@ -988,8 +1017,8 @@ def train_als_tp(
 
         x = multihost_utils.process_allgather(x, tiled=True)
         y = multihost_utils.process_allgather(y, tiled=True)
-    return ALSModelArrays(
-        np.asarray(x)[:n_u], np.asarray(y)[:n_i], data.user_ids, data.item_ids
+    return _finish_model(
+        x, y, n_u, n_i, data
     )
 
 

@@ -110,3 +110,100 @@ def test_k_larger_than_items():
 
 def test_shared_is_singleton():
     assert TopKBatcher.shared() is TopKBatcher.shared()
+
+# ---------------------------------------------------------------------------
+# wedged-device failover (round-2 lesson: the tunneled TPU can hang an
+# in-flight transfer forever; the serving tier must degrade, not die)
+# ---------------------------------------------------------------------------
+
+
+from oryx_tpu.ops.als import topk_dot_batch as _real_topk_dot_batch
+
+
+class _WedgeHook:
+    """Monkeypatch target making topk_dot_batch block until released."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.calls = 0
+
+    def __call__(self, xs, y, k):
+        self.calls += 1
+        if self.calls == 1:
+            self.release.wait(timeout=30)
+        return _real_topk_dot_batch(xs, y, k=k)
+
+
+def _host_mat(y):
+    return np.asarray(y, dtype=np.float32)
+
+
+def test_wedged_dispatch_fails_over_to_host(y, monkeypatch):
+    import oryx_tpu.serving.batcher as bmod
+
+    hook = _WedgeHook()
+    monkeypatch.setattr(
+        "oryx_tpu.ops.als.topk_dot_batch", hook, raising=True
+    )
+    b = TopKBatcher(device_timeout=0.5, probe_interval=0.2)
+    vec = np.random.default_rng(0).normal(size=8).astype(np.float32)
+    # the dispatch wedges; the watchdog must host-resolve within ~timeout
+    vals, idx = b.submit(vec, 10, y, host_mat=_host_mat(y))
+    assert b.device_failovers == 1
+    dvals, didx = _direct(vec, 10, y)
+    assert list(idx) == list(didx)
+    np.testing.assert_allclose(vals, dvals, rtol=1e-5)
+    # while down, new submits take the host path immediately
+    vals2, idx2 = b.submit(vec, 10, y, host_mat=_host_mat(y))
+    assert list(idx2) == list(didx)
+    assert b.host_fallbacks >= 2
+    hook.release.set()
+    b.close()
+
+
+def test_wedged_dispatch_without_host_mat_errors(y, monkeypatch):
+    hook = _WedgeHook()
+    monkeypatch.setattr(
+        "oryx_tpu.ops.als.topk_dot_batch", hook, raising=True
+    )
+    b = TopKBatcher(device_timeout=0.5, probe_interval=0.2)
+    vec = np.random.default_rng(0).normal(size=8).astype(np.float32)
+    with pytest.raises(RuntimeError):
+        b.submit(vec, 10, y)
+    hook.release.set()
+    b.close()
+
+
+def test_device_recovery_resumes_device_path(y, monkeypatch):
+    hook = _WedgeHook()
+    monkeypatch.setattr(
+        "oryx_tpu.ops.als.topk_dot_batch", hook, raising=True
+    )
+    b = TopKBatcher(device_timeout=0.4, probe_interval=0.1)
+    vec = np.random.default_rng(0).normal(size=8).astype(np.float32)
+    b.submit(vec, 10, y, host_mat=_host_mat(y))  # wedge + failover
+    assert b._device_down.is_set()
+    hook.release.set()  # transport recovers
+    # submits keep working throughout; eventually a probe flips the path
+    deadline = __import__("time").time() + 10
+    while b._device_down.is_set() and __import__("time").time() < deadline:
+        b.submit(vec, 10, y, host_mat=_host_mat(y))
+        __import__("time").sleep(0.05)
+    assert not b._device_down.is_set(), "probe never recovered the device"
+    # device path again: a fresh dispatcher thread serves the queue
+    vals, idx = b.submit(vec, 10, y, host_mat=_host_mat(y))
+    dvals, didx = _direct(vec, 10, y)
+    assert list(idx) == list(didx)
+    b.close()
+
+
+def test_host_topk_cosine_matches_numpy(y):
+    from oryx_tpu.serving.batcher import host_topk
+
+    hm = _host_mat(y)
+    vec = np.random.default_rng(5).normal(size=8).astype(np.float32)
+    vals, idx = host_topk(vec, 5, hm, cosine=True)
+    ref = (hm @ vec) / np.maximum(np.linalg.norm(hm, axis=1), 1e-12)
+    order = np.argsort(-ref)[:5]
+    assert list(idx) == list(order)
+    np.testing.assert_allclose(vals, ref[order], rtol=1e-5)

@@ -444,3 +444,28 @@ def test_checkpointed_training_ignores_mismatched_checkpoint(tmp_path):
     )
     base = train_als(data, features=4, iterations=4, implicit=True, seed_key=key)
     np.testing.assert_allclose(m.x, base.x, rtol=1e-5, atol=1e-6)
+
+
+def test_singular_systems_never_nan():
+    """Rank-deficient normal equations (explicit, lam=0, users with fewer
+    interactions than features) must never leak NaN into the factors: the
+    _half_step singularity guard retries with trace-scaled jitter and zeroes
+    anything still unsolvable (the reference Solver.java refuses
+    ill-conditioned systems; here one NaN row would poison gram() and with
+    it the entire next half-sweep)."""
+    from oryx_tpu.ops.als import aggregate_interactions
+
+    rng = np.random.default_rng(11)
+    # 40 users x 30 items, every user rates exactly ONE item -> each user
+    # system is rank-1 with lam=0
+    users = np.arange(40, dtype=np.int64)
+    items = rng.integers(0, 30, size=40).astype(np.int64)
+    values = rng.uniform(1, 5, size=40)
+    data = aggregate_interactions(users, items, values, implicit=False)
+    m = train_als(
+        data, features=8, lam=0.0, alpha=1.0, iterations=4, implicit=False
+    )
+    assert np.isfinite(m.x).all(), "NaN leaked into user factors"
+    assert np.isfinite(m.y).all(), "NaN leaked into item factors"
+    # and the model still scores: predictions are finite everywhere
+    assert np.isfinite(m.x @ m.y.T).all()
