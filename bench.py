@@ -53,9 +53,14 @@ def _bench_body() -> None:
     batch = 4096 if on_accel else 256
     n_items, features, k = (1_000_000, 50, 10) if on_accel else (100_000, 50, 10)
 
+    from oryx_tpu.ops.transfer import staged_device_put
+
     rng = np.random.default_rng(42)
-    y = jnp.asarray(
-        rng.standard_normal((n_items, features), dtype=np.float32), dtype=jnp.bfloat16
+    # chunked upload: a single ~200MB buffered write is the transport
+    # pattern that has wedged this host's tunneled TPU
+    y = staged_device_put(
+        rng.standard_normal((n_items, features), dtype=np.float32),
+        dtype=jnp.bfloat16,
     )
     users = jnp.asarray(
         rng.standard_normal((batch, features), dtype=np.float32), dtype=jnp.bfloat16

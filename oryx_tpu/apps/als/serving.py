@@ -114,7 +114,9 @@ class ALSServingModel(ServingModel):
             # re-rank — row-aligned with the device view by construction,
             # read lock-free on the request path.
             mat = np.asarray(mat, dtype=np.float32)
-            view = (jnp.asarray(mat, dtype=jnp.bfloat16), ids, version, mat)
+            from oryx_tpu.ops.transfer import staged_device_put
+
+            view = (staged_device_put(mat, dtype=jnp.bfloat16), ids, version, mat)
             self._device_view = view
         return view
 
@@ -130,16 +132,19 @@ class ALSServingModel(ServingModel):
         y, ids, version, host_mat = self._y_view_full()
         view = self._unit_view
         if view is not None and view[2] == version:
-            return view[0], view[1], view[3]
+            return view[0], view[1], view[3], view[4]
         with self._sync_lock:
             view = self._unit_view
             if view is not None and view[2] == version:
-                return view[0], view[1], view[3]
+                return view[0], view[1], view[3], view[4]
             yf = y.astype(jnp.float32)
             norms = jnp.maximum(jnp.linalg.norm(yf, axis=1, keepdims=True), 1e-12)
-            view = ((yf / norms).astype(y.dtype), ids, version, host_mat)
+            # host row norms cached per version too: the wedged-device
+            # cosine fallback must not pay an O(N.K) norm pass per request
+            host_norms = np.linalg.norm(host_mat, axis=1)
+            view = ((yf / norms).astype(y.dtype), ids, version, host_mat, host_norms)
             self._unit_view = view
-        return view[0], view[1], view[3]
+        return view[0], view[1], view[3], view[4]
 
     # -- queries -----------------------------------------------------------
 
@@ -170,8 +175,9 @@ class ALSServingModel(ServingModel):
             )
             idx = rows[top]
         else:
+            host_norms = None
             if cosine:
-                y, ids, host_mat = self._y_unit_view()
+                y, ids, host_mat, host_norms = self._y_unit_view()
             else:
                 y, ids, _v, host_mat = self._y_view_full()
             n = len(ids)
@@ -185,7 +191,8 @@ class ALSServingModel(ServingModel):
             # host_mat doubles as the wedged-device fallback: the batcher
             # scores on the host if the accelerator transport hangs
             vals, idx = TopKBatcher.shared().submit(
-                user_vector, k, y, host_mat=host_mat, cosine=cosine
+                user_vector, k, y, host_mat=host_mat, cosine=cosine,
+                host_norms=host_norms,
             )
             # The device scan selects candidates in bf16 (half the HBM
             # traffic of the memory-bound sweep); near-ties inside the

@@ -112,7 +112,9 @@ def _kmeans_parallel_init(
     candidates = [points[first]]
     new = [points[first]]
     ell = 2 * k
-    pts_j = jnp.asarray(points)  # one host->device upload for all rounds
+    from oryx_tpu.ops.transfer import staged_device_put
+
+    pts_j = staged_device_put(points)  # chunked host->device upload, reused all rounds
     d2 = None  # running min squared distance to ANY candidate so far:
     # each round only scores the centers added last round, instead of
     # rescanning the whole growing candidate set (2-3x less distance work)

@@ -79,15 +79,21 @@ def k_bucket(k: int) -> int:
 
 
 def host_topk(
-    vec: np.ndarray, k: int, host_mat: np.ndarray, cosine: bool = False
+    vec: np.ndarray,
+    k: int,
+    host_mat: np.ndarray,
+    cosine: bool = False,
+    norms: np.ndarray | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Score one query on the host: f32 matmul + argpartition. The degraded
-    path when the accelerator is unavailable — exact, just slower."""
+    path when the accelerator is unavailable — exact, just slower. Pass
+    ``norms`` (cached per matrix snapshot) to skip the O(N.K) row-norm pass
+    on cosine queries."""
     scores = host_mat @ np.asarray(vec, dtype=np.float32)
     if cosine:
-        scores = scores / np.maximum(
-            np.linalg.norm(host_mat, axis=1), 1e-12
-        )
+        if norms is None:
+            norms = np.linalg.norm(host_mat, axis=1)
+        scores = scores / np.maximum(norms, 1e-12)
     k = min(k, scores.shape[0])
     top = np.argpartition(-scores, k - 1)[:k]
     top = top[np.argsort(-scores[top])]
@@ -95,31 +101,41 @@ def host_topk(
 
 
 class _Pending:
-    __slots__ = ("vec", "k", "y", "future", "host_mat", "cosine")
+    __slots__ = ("vec", "k", "y", "future", "host_mat", "cosine", "host_norms")
 
-    def __init__(self, vec, k, y, future, host_mat=None, cosine=False):
+    def __init__(self, vec, k, y, future, host_mat=None, cosine=False, host_norms=None):
         self.vec = vec
         self.k = k
         self.y = y
         self.future = future
         self.host_mat = host_mat
         self.cosine = cosine
+        self.host_norms = host_norms
 
-    def resolve_on_host(self, reason: Exception | None = None) -> None:
+    def resolve_on_host(self, reason: Exception | None = None) -> bool:
+        """Host-score this request. Returns True if a result was delivered,
+        False if it could only be failed (no host matrix) — callers count
+        host fallbacks from the return value, so errored requests don't
+        inflate the degraded-traffic metric."""
         if self.future.done():
-            return
+            return False
         if self.host_mat is None:
             self.future.set_exception(
                 reason or RuntimeError("device unavailable, no host fallback")
             )
-            return
+            return False
         try:
             self.future.set_result(
-                host_topk(self.vec, self.k, self.host_mat, self.cosine)
+                host_topk(
+                    self.vec, self.k, self.host_mat, self.cosine,
+                    self.host_norms,
+                )
             )
+            return True
         except Exception as e:  # pragma: no cover - defensive
             if not self.future.done():
                 self.future.set_exception(e)
+            return False
 
 
 class TopKBatcher:
@@ -162,11 +178,37 @@ class TopKBatcher:
         self._last_y = None
         # observability: dispatch count + coalesced-request count let a
         # /metrics scrape compute the achieved mean batch size;
-        # host_fallbacks counts degraded-path requests
+        # host_fallbacks counts requests actually scored on the host
         self.dispatches = 0
         self.coalesced = 0
         self.host_fallbacks = 0
         self.device_failovers = 0
+
+    def register_gauges(self) -> None:
+        """Expose the batcher's counters as callback gauges on the global
+        metrics registry (the serving layer calls this once at startup;
+        scrapes then read live values with no per-scrape mutation)."""
+        from oryx_tpu.common.metrics import get_registry
+
+        reg = get_registry()
+        for name, help_text, fn in (
+            ("oryx_topk_dispatches",
+             "device top-k dispatches issued by the micro-batcher",
+             lambda: float(self.dispatches)),
+            ("oryx_topk_coalesced",
+             "requests coalesced into device dispatches",
+             lambda: float(self.coalesced)),
+            ("oryx_topk_host_fallbacks",
+             "requests scored on the host because the device was down",
+             lambda: float(self.host_fallbacks)),
+            ("oryx_topk_device_failovers",
+             "wedged-dispatch failovers declared by the watchdog",
+             lambda: float(self.device_failovers)),
+            ("oryx_topk_device_down",
+             "1 while top-k serving is on the degraded host path",
+             lambda: 1.0 if self._device_down.is_set() else 0.0),
+        ):
+            reg.gauge(name, help_text).set_function(fn)
 
     # -- public API --------------------------------------------------------
 
@@ -177,16 +219,18 @@ class TopKBatcher:
         y,
         host_mat: np.ndarray | None = None,
         cosine: bool = False,
+        host_norms: np.ndarray | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Score vec against device matrix y, returning (values, indices)
         for the top-k rows. Blocks until the coalesced dispatch completes.
 
         host_mat (the row-aligned f32 host copy of y) enables degraded
-        host-side scoring when the device transport is wedged.
+        host-side scoring when the device transport is wedged; host_norms
+        caches its row norms for cosine fallbacks.
         """
         vec = np.asarray(vec, dtype=np.float32)
         fut: Future = Future()
-        p = _Pending(vec, int(k), y, fut, host_mat, cosine)
+        p = _Pending(vec, int(k), y, fut, host_mat, cosine, host_norms)
         with self._cond:
             if self._closed:
                 raise RuntimeError("batcher is closed")
@@ -204,11 +248,11 @@ class TopKBatcher:
                 self._ensure_watchdog()
                 self._queue.append(p)
                 self._cond.notify()
-            else:
-                self.host_fallbacks += 1
         if down:
             self._maybe_probe()
-            p.resolve_on_host()
+            if p.resolve_on_host():
+                with self._lock:
+                    self.host_fallbacks += 1
         return fut.result()
 
     def close(self) -> None:
@@ -374,7 +418,6 @@ class TopKBatcher:
                 self._queue = []
                 self._busy_since = None
                 self._thread = None  # supersede the wedged dispatcher
-                self.host_fallbacks += len(stuck)
             log.error(
                 "device dispatch stuck > %.0fs — failing %d requests over "
                 "to host scoring and marking the device down",
@@ -384,8 +427,33 @@ class TopKBatcher:
             err = RuntimeError(
                 f"device dispatch exceeded {self.device_timeout}s"
             )
-            for p in stuck:
-                p.resolve_on_host(err)
+
+            # drain concurrently: serial host scoring of a MAX_BATCH-deep
+            # backlog would add minutes of extra wait on top of the
+            # timeout the callers already paid
+            def _drain(chunk: list[_Pending]) -> None:
+                n = 0
+                for p in chunk:
+                    if p.resolve_on_host(err):
+                        n += 1
+                with self._lock:
+                    self.host_fallbacks += n
+
+            n_threads = min(8, max(1, len(stuck) // 32 + 1))
+            if n_threads == 1:
+                _drain(stuck)
+            else:
+                drains = [
+                    threading.Thread(
+                        target=_drain, args=(stuck[i::n_threads],),
+                        name=f"oryx-topk-drain-{i}", daemon=True,
+                    )
+                    for i in range(n_threads)
+                ]
+                for t in drains:
+                    t.start()
+                for t in drains:
+                    t.join()
 
     def _maybe_probe(self) -> None:
         """While the device is down, periodically test it with a tiny

@@ -46,6 +46,12 @@ def register(app: ServingApp) -> None:
 
     if app.config.get_bool("oryx.monitoring.metrics", True):
 
+        from oryx_tpu.serving.batcher import TopKBatcher
+
+        # live callback gauges: scrapes read the batcher's counters (incl.
+        # the wedged-device failover state) without per-scrape mutation
+        TopKBatcher.shared().register_gauges()
+
         @app.route("GET", "/metrics")
         def metrics(a: ServingApp, req: Request):
             text = get_registry().render_prometheus()

@@ -172,3 +172,38 @@ def test_als_model_bytes_gauge():
     assert line, text[-500:]
     assert float(line[0].rsplit(" ", 1)[1]) >= 3 * 8 * 4  # >= occupied bytes
     del app
+
+
+def test_metrics_exposes_batcher_failover_gauges(tmp_path):
+    """/metrics reports the top-k batcher's dispatch and wedged-device
+    failover counters when the shared batcher exists (ops sizes an outage
+    from oryx_topk_device_down + oryx_topk_host_fallbacks)."""
+    from oryx_tpu.api import ServingModelManager
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.serving.app import Request, ServingApp
+    from oryx_tpu.serving.batcher import TopKBatcher
+
+    TopKBatcher.shared()  # ensure the shared instance exists
+
+    class Manager(ServingModelManager):
+        def __init__(self, config):
+            self.config = config
+
+        def consume(self, it):
+            pass
+
+        def get_model(self):
+            return None
+
+    cfg = load_config(
+        overlay={"oryx.serving.application-resources": ["oryx_tpu.serving.resources.common"]}
+    )
+    app = ServingApp(cfg, Manager(cfg))
+    status, body, _ = app.dispatch(
+        Request("GET", "/metrics", {}, {}, b"", {"accept": "text/plain"})
+    )
+    assert status == 200
+    text = body.decode()
+    assert "oryx_topk_dispatches" in text
+    assert "oryx_topk_host_fallbacks" in text
+    assert "oryx_topk_device_down" in text
