@@ -178,6 +178,7 @@ def client(ci):
     uids = [lrng.randrange(n_users) for _ in range(4096)]
     j = 0
     while time.time() < t_end:
+        t0 = time.time()
         try:
             conn.request("GET", f"/recommend/u{uids[j % len(uids)]}?howMany=10")
             r = conn.getresponse()
@@ -191,15 +192,19 @@ def client(ci):
         if t_measure <= done < t_end:  # completions past t_end would
             if ok:                     # inflate qps (dt stays nominal)
                 counts[ci] += 1
+                lats[ci].append(done - t0)
             else:
                 errors[ci] += 1
         j += 1
     conn.close()
 
+lats = [[] for _ in range(n_threads)]
 threads = [threading.Thread(target=client, args=(i,)) for i in range(n_threads)]
 for t in threads: t.start()
 for t in threads: t.join()
 print(f"COUNTS {sum(counts)} {sum(errors)}", flush=True)
+all_lats = sorted(l for ls in lats for l in ls)
+print("LATMS " + " ".join(f"{l*1000:.1f}" for l in all_lats), flush=True)
 """
 
 
@@ -314,6 +319,7 @@ def _bench_http_body() -> None:
     # the measured window (warm dispatches ramp through small batch shapes)
     warm_disp, warm_coal = b.dispatches, b.coalesced
     total = n_errors = 0
+    all_lat_ms: list[float] = []
     for pi, p in enumerate(procs):
         out, _ = p.communicate(timeout=duration + 240)
         counted = False
@@ -323,11 +329,19 @@ def _bench_http_body() -> None:
                 total += int(c)
                 n_errors += int(e)
                 counted = True
+            elif line.startswith("LATMS "):
+                all_lat_ms.extend(float(v) for v in line.split()[1:])
         # a crashed load generator must fail the bench loudly, not shave
         # its share of offered load off the reported qps
         assert p.returncode == 0 and counted, (
             f"http client proc {pi} rc={p.returncode} counted={counted}"
         )
+    all_lat_ms.sort()
+
+    def pctl(q: float) -> float:
+        if not all_lat_ms:
+            return 0.0
+        return all_lat_ms[min(len(all_lat_ms) - 1, int(q * len(all_lat_ms)))]
     dt = duration
     qps = total / dt
     mean_batch = (b.coalesced - warm_coal) / max(1, b.dispatches - warm_disp)
@@ -351,6 +365,9 @@ def _bench_http_body() -> None:
                 "clients": n_clients,
                 "mean_device_batch": round(mean_batch, 1),
                 "errors": n_errors,
+                "latency_ms_p50": round(pctl(0.50), 1),
+                "latency_ms_p90": round(pctl(0.90), 1),
+                "latency_ms_p99": round(pctl(0.99), 1),
             }
         )
     )
@@ -568,6 +585,97 @@ def _bench_speed_body() -> None:
     )
 
 
+def _bench_scale_body() -> None:
+    """Serving-kernel throughput across the reference's ENTIRE benchmark
+    grid (BASELINE.md: items {1M,5M,20M} x features {50,250}; the
+    reference needed LSH approximation above 1M items to stay usable).
+    Models are generated directly in device HBM (jax.random) — content is
+    irrelevant to scan cost, and a 10GB host upload would dominate the
+    bench budget. Scoring here is EXACT (no LSH); both baseline columns
+    (with/without LSH) are attached per row for comparison."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from oryx_tpu.ops.als import topk_dot_batch
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    # (items, features) -> (lsh_qps, exact_qps) from BASELINE.md tables
+    baselines = {
+        (1_000_000, 50): (437.0, 70.0),
+        (1_000_000, 250): (160.0, 24.0),
+        (5_000_000, 50): (91.0, 16.0),
+        (5_000_000, 250): (37.0, 6.0),
+        (20_000_000, 50): (25.0, 4.0),
+        (20_000_000, 250): (7.0, 1.0),  # 10GB bf16: fits v5e HBM, barely
+    }
+    if on_accel:
+        grid = list(baselines)
+        batch, k, budget_per = 4096, 10, 60.0
+    else:  # CPU fallback: prove the harness, not the numbers
+        grid = [(100_000, 50), (100_000, 250)]
+        batch, k, budget_per = 256, 10, 10.0
+
+    rows = []
+    for n_items, features in grid:
+        base_lsh, base_exact = baselines.get((n_items, features), (None, None))
+        try:
+            t_setup = time.perf_counter()
+            y = jax.random.normal(
+                jax.random.PRNGKey(0), (n_items, features), dtype=jnp.bfloat16
+            )
+            users = jax.random.normal(
+                jax.random.PRNGKey(1), (batch, features), dtype=jnp.bfloat16
+            )
+            jax.block_until_ready((y, users))
+            jax.block_until_ready(topk_dot_batch(users, y, k=k))  # compile
+            compile_s = time.perf_counter() - t_setup
+            n, t0, pending, rounds = 0, time.perf_counter(), None, 0
+            while True:
+                _, idx = topk_dot_batch(users, y, k=k)
+                idx.copy_to_host_async()
+                rounds += 1
+                if pending is not None:
+                    np.asarray(pending)
+                    n += batch
+                pending = idx
+                dt = time.perf_counter() - t0
+                if dt > 3.0 or time.perf_counter() - t_setup > budget_per:
+                    break
+            np.asarray(pending)
+            n += batch
+            dt = time.perf_counter() - t0
+            qps = n / dt
+            row = {
+                "items": n_items, "features": features,
+                "qps": round(qps, 1),
+                "baseline_lsh_qps": base_lsh,
+                "baseline_exact_qps": base_exact,
+                "compile_s": round(compile_s, 1),
+            }
+            if base_lsh:
+                row["vs_lsh_baseline"] = round(qps / base_lsh, 1)
+            rows.append(row)
+            print(
+                f"scale {n_items}x{features}: {qps:.0f} qps exact "
+                f"(ref lsh={base_lsh} exact={base_exact})", file=sys.stderr,
+            )
+        except Exception as e:  # noqa: BLE001 - e.g. HBM OOM at 20Mx250
+            rows.append({
+                "items": n_items, "features": features, "error": str(e)[:200],
+            })
+            print(f"scale {n_items}x{features} failed: {e}", file=sys.stderr)
+        finally:
+            # free HBM before the next (bigger) config
+            y = users = pending = idx = None
+        # cumulative emit after EVERY config: if a later (bigger) config
+        # wedges the transport and the subprocess is killed, the completed
+        # rows survive on the last fully-printed JSON line (the parent
+        # parses the last parseable line)
+        print(json.dumps({"metric": "als_scaling_sweep", "rows": rows}), flush=True)
+
+
 def _bench_kmeans_rdf_body() -> None:
     """Build wall-clocks for the other two packaged model families —
     k-means (Lloyd's + k-means|| init) and random decision forest
@@ -700,9 +808,18 @@ def _probe_backend(env: dict, timeout: float) -> str | None:
 
 
 def _run_bench(
-    env: dict, timeout: float, body: str = "_bench_http_body", force_cpu: bool = False
+    env: dict,
+    timeout: float,
+    body: str = "_bench_http_body",
+    force_cpu: bool = False,
+    allow_partial: bool = False,
 ) -> dict | None:
-    """Run a bench body in a subprocess; return its parsed JSON or None."""
+    """Run a bench body in a subprocess; return its parsed JSON or None.
+
+    allow_partial: parse the last complete JSON line even if the body was
+    killed or crashed — for bodies that emit cumulative progress lines
+    (the scaling sweep), a wedge mid-way must not discard finished rows.
+    """
     code = (
         (_FORCE_CPU_PREFIX if force_cpu else "")
         + f"import sys; sys.path.insert(0, {HERE!r}); "
@@ -710,10 +827,10 @@ def _run_bench(
     )
     rc, stdout, stderr = _run_subprocess(code, env, timeout)
     sys.stderr.write(stderr)
-    if rc is None:
+    if rc is None and not allow_partial:
         print("bench body timed out", file=sys.stderr)
         return None
-    if rc != 0:
+    if rc is not None and rc != 0 and not allow_partial:
         print(f"bench body failed rc={rc}", file=sys.stderr)
         return None
     for line in reversed(stdout.splitlines()):
@@ -728,10 +845,11 @@ def _run_bench(
 
 def main() -> None:
     errors: list[str] = []
-    deadline = time.monotonic() + 2400  # overall wall-clock budget:
-    # stage caps (probes + http + kernel + train + speed + kmeans/rdf)
-    # can legitimately sum past 1500s on a cold accelerator; the floor
-    # in left() must not starve the late stages
+    deadline = time.monotonic() + 3000  # overall wall-clock budget: the
+    # stage caps (probes + http + kernel + train + speed + kmeans/rdf +
+    # scaling sweep) sum to ~2700s worst case on a cold accelerator; the
+    # budget must cover that sum or the floor in left() starves the late
+    # stages into guaranteed 30s SIGKILLs
     left = lambda cap: max(30.0, min(cap, deadline - time.monotonic()))
 
     # 1. try the default platform (real TPU on the bench host), with retries
@@ -809,6 +927,17 @@ def main() -> None:
             result["rdf_build_seconds"] = kr.get("rdf_seconds")
         else:
             errors.append("kmeans/rdf bench failed")
+
+    # the reference's full (items x features) serving grid, exact scoring
+    if result is not None:
+        sc = _run_bench(
+            env_used, timeout=left(600), body="_bench_scale_body",
+            force_cpu=forced, allow_partial=True,
+        )
+        if sc is not None and sc.get("rows"):
+            result["scaling"] = sc["rows"]
+        else:
+            errors.append("scaling sweep failed")
 
     if result is None:
         result = {
