@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from concurrent.futures import Future
 
 import numpy as np
 
@@ -22,6 +23,7 @@ from oryx_tpu.api import AbstractServingModelManager, ServingModel
 from oryx_tpu.common.config import Config
 from oryx_tpu.ops.als import compute_updated_xu
 from oryx_tpu.apps.als.common import ALSConfig
+from oryx_tpu.serving.app import chain_future
 from oryx_tpu.serving.batcher import TopKBatcher, host_topk
 from oryx_tpu.apps.als.state import ALSState, apply_update_message
 
@@ -29,6 +31,25 @@ log = logging.getLogger(__name__)
 
 # Max LSH partition-rebuild frequency under live update ingestion.
 _LSH_REFRESH_SEC = 1.0
+
+_POST_POOL = None
+_POST_POOL_LOCK = threading.Lock()
+
+
+def _post_pool():
+    """Shared pool for per-request post-processing chained off batcher
+    futures (sized for trim/render work; a rescorer that blocks holds one
+    of these threads, never the batcher dispatcher)."""
+    global _POST_POOL
+    if _POST_POOL is None:
+        with _POST_POOL_LOCK:
+            if _POST_POOL is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                _POST_POOL = ThreadPoolExecutor(
+                    max_workers=8, thread_name_prefix="oryx-topn-post"
+                )
+    return _POST_POOL
 
 
 class ALSServingModel(ServingModel):
@@ -156,69 +177,91 @@ class ALSServingModel(ServingModel):
         rescorer=None,
         cosine: bool = False,
     ) -> list[tuple[str, float]]:
+        return self.top_n_async(
+            user_vector, how_many, exclude, rescorer, cosine
+        ).result()
+
+    def top_n_async(
+        self,
+        user_vector: np.ndarray,
+        how_many: int,
+        exclude: set[str] = frozenset(),
+        rescorer=None,
+        cosine: bool = False,
+    ) -> Future:
+        """top_n as a Future: the device path chains its host-side
+        post-processing (exact re-rank, exclusion/rescorer trim) onto the
+        batcher future, so a deferred endpoint holds no thread while the
+        coalesced dispatch is in flight."""
+        out: Future = Future()
         if self.sample_rate < 1.0:
             # LSH candidate subsampling: score only items whose partition is
             # within the Hamming ball of the query's (the reference's
             # candidate-partition fan-out, ALSServingModel.java:264-279).
             # Matrix/ids/partitions are one matched snapshot from _lsh_index.
-            lsh, y_host, ids, parts = self._lsh_index()
-            if not ids:
-                return []
-            k = min(len(ids), how_many + len(exclude) + 8)
-            rows = np.nonzero(np.isin(parts, lsh.candidate_indices(user_vector)))[0]
-            if rows.size == 0:
-                return []
-            cand = y_host[rows]
-            vals, top = host_topk(
-                np.asarray(user_vector, dtype=np.float32),
-                min(k, rows.size), cand, cosine,
-            )
-            idx = rows[top]
+            # Pure host work — completes immediately.
+            try:
+                lsh, y_host, ids, parts = self._lsh_index()
+                if not ids:
+                    out.set_result([])
+                    return out
+                k = min(len(ids), how_many + len(exclude) + 8)
+                rows = np.nonzero(
+                    np.isin(parts, lsh.candidate_indices(user_vector))
+                )[0]
+                if rows.size == 0:
+                    out.set_result([])
+                    return out
+                cand = y_host[rows]
+                vals, top = host_topk(
+                    np.asarray(user_vector, dtype=np.float32),
+                    min(k, rows.size), cand, cosine,
+                )
+                idx = rows[top]
+                out.set_result(
+                    _trim_pairs(vals, idx, ids, how_many, exclude, rescorer)
+                )
+            except BaseException as e:  # noqa: BLE001 - carried to caller
+                out.set_exception(e)
+            return out
+
+        host_norms = None
+        if cosine:
+            y, ids, host_mat, host_norms = self._y_unit_view()
         else:
-            host_norms = None
-            if cosine:
-                y, ids, host_mat, host_norms = self._y_unit_view()
-            else:
-                y, ids, _v, host_mat = self._y_view_full()
-            n = len(ids)
-            if n == 0:
-                return []
-            # over-fetch to survive exclusions/filters, then trim.
-            # Concurrent requests coalesce into one bucketed-shape device
-            # dispatch (serving/batcher.py) — B=1 matmuls waste the MXU and
-            # a data-dependent k would recompile per exclusion-set size.
-            k = min(n, how_many + len(exclude) + 8)
-            # host_mat doubles as the wedged-device fallback: the batcher
-            # scores on the host if the accelerator transport hangs
-            vals, idx = TopKBatcher.shared().submit(
-                user_vector, k, y, host_mat=host_mat, cosine=cosine,
-                host_norms=host_norms,
-            )
+            y, ids, _v, host_mat = self._y_view_full()
+        n = len(ids)
+        if n == 0:
+            out.set_result([])
+            return out
+        # over-fetch to survive exclusions/filters, then trim.
+        # Concurrent requests coalesce into one bucketed-shape device
+        # dispatch (serving/batcher.py) — B=1 matmuls waste the MXU and
+        # a data-dependent k would recompile per exclusion-set size.
+        k = min(n, how_many + len(exclude) + 8)
+        # host_mat doubles as the wedged-device fallback: the batcher
+        # scores on the host if the accelerator transport hangs
+        fut = TopKBatcher.shared().submit_nowait(
+            user_vector, k, y, host_mat=host_mat, cosine=cosine,
+            host_norms=host_norms,
+        )
+
+        def _post(result):
+            vals, idx = result
             # The device scan selects candidates in bf16 (half the HBM
             # traffic of the memory-bound sweep); near-ties inside the
             # candidate set are then re-ranked EXACTLY by one vectorized
             # f32 gather against the row-aligned host matrix — k*features
-            # flops, noise next to the 1M-row scan it corrects.
+            # flops, noise next to the scan it corrects.
             vals, idx = _rerank_exact(user_vector, vals, idx, host_mat, cosine)
-        out = []
-        for v, j in zip(np.asarray(vals), np.asarray(idx)):
-            ident = ids[int(j)]
-            if ident in exclude:
-                continue
-            score = float(v)
-            if rescorer is not None:
-                if rescorer.is_filtered(ident):
-                    continue
-                score = rescorer.rescore(ident, score)
-                if score is None or np.isnan(score):
-                    continue
-            out.append((ident, score))
-            if len(out) == how_many and rescorer is None:
-                break
-        if rescorer is not None:
-            out.sort(key=lambda t: -t[1])
-            out = out[:how_many]
-        return out
+            return _trim_pairs(vals, idx, ids, how_many, exclude, rescorer)
+
+        # post-processing (and everything chained after it: pagination,
+        # render, metrics) bounces onto a pool — run inline it would
+        # serialize on the batcher dispatcher thread inside the watchdog
+        # window, stalling the device pipeline and deadlocking any
+        # rescorer that submits its own query
+        return chain_future(fut, _post, executor=_post_pool())
 
     def get_user_vector(self, user: str) -> np.ndarray | None:
         return self.state.x.get(user)
@@ -303,6 +346,32 @@ class ALSServingModel(ServingModel):
         out = [(u, len(s)) for u, s in self.state.known_items_snapshot().items()]
         out.sort(key=lambda t: (-t[1], t[0]))
         return out[:how_many]
+
+
+def _trim_pairs(
+    vals, idx, ids, how_many: int, exclude: set[str], rescorer
+) -> list[tuple[str, float]]:
+    """Ranked (id, score) pairs after exclusion filtering and optional
+    rescoring (the reference's per-request filter/rescore pass)."""
+    out: list[tuple[str, float]] = []
+    for v, j in zip(np.asarray(vals), np.asarray(idx)):
+        ident = ids[int(j)]
+        if ident in exclude:
+            continue
+        score = float(v)
+        if rescorer is not None:
+            if rescorer.is_filtered(ident):
+                continue
+            score = rescorer.rescore(ident, score)
+            if score is None or np.isnan(score):
+                continue
+        out.append((ident, score))
+        if len(out) == how_many and rescorer is None:
+            break
+    if rescorer is not None:
+        out.sort(key=lambda t: -t[1])
+        out = out[:how_many]
+    return out
 
 
 def _rerank_exact(user_vector, vals, idx, host_mat: np.ndarray, cosine: bool):

@@ -14,6 +14,7 @@ import json
 import re
 import time
 import weakref
+from concurrent.futures import Future
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -31,6 +32,48 @@ class RawResponse:
     status: int
     body: bytes
     content_type: str
+
+
+@dataclass
+class Deferred:
+    """A handler result that completes later (device-batched endpoints).
+
+    Handlers return Deferred(future-of-raw-result) instead of parking
+    their worker thread on the micro-batcher; the async frontend awaits
+    the future on the event loop, so in-flight request capacity is bounded
+    by memory, not by worker-pool threads (the reference's analogue is
+    Tomcat NIO async servlets). The threaded frontend and direct
+    dispatch() callers keep blocking semantics.
+    """
+
+    future: "Future"
+
+
+def chain_future(
+    future: "Future", fn: Callable[[Any], Any], executor=None
+) -> "Future":
+    """Future of fn(future.result()), exceptions carried through. With an
+    executor, fn runs there instead of inline in the completing thread —
+    REQUIRED when the completing thread is a latency-critical loop (the
+    batcher dispatcher) or when fn may block."""
+    out: Future = Future()
+
+    def _apply(f):
+        try:
+            out.set_result(fn(f.result()))
+        except BaseException as e:  # noqa: BLE001 - carried downstream
+            out.set_exception(e)
+
+    if executor is None:
+        future.add_done_callback(_apply)
+    else:
+        future.add_done_callback(lambda f: executor.submit(_apply, f))
+    return out
+
+
+def deferred_map(future: "Future", fn: Callable[[Any], Any]) -> Deferred:
+    """Deferred whose result is fn(future.result())."""
+    return Deferred(chain_future(future, fn))
 
 
 class OryxServingException(Exception):
@@ -176,17 +219,46 @@ class ServingApp:
     # -- dispatch ----------------------------------------------------------
 
     def dispatch(self, req: Request) -> tuple[int, bytes, str]:
-        """Route and render; returns (status, body_bytes, content_type)."""
+        """Route and render; returns (status, body_bytes, content_type).
+        Blocks on deferred handlers — the contract tests and the threaded
+        frontend rely on."""
+        resp = self.dispatch_nowait(req)
+        if isinstance(resp, Deferred):
+            resp = resp.future.result()
+        return resp
+
+    def dispatch_nowait(self, req: Request):
+        """Route and render without blocking on deferred handlers: returns
+        either a rendered (status, body, content_type) tuple or a Deferred
+        of one (the async frontend awaits it off-thread)."""
         start = time.monotonic()
         resp = self._dispatch(req)
+        if isinstance(resp, Deferred):
+            rendered: Future = Future()
+
+            def _finish(f):
+                try:
+                    out = _render(f.result(), req)
+                except OryxServingException as e:
+                    out = _render_error(e.status, e.message, req)
+                except BaseException as e:  # noqa: BLE001 - boundary: 500
+                    out = _render_error(500, f"{type(e).__name__}: {e}", req)
+                self._observe(req, start, out[0])
+                rendered.set_result(out)
+
+            resp.future.add_done_callback(_finish)
+            return Deferred(rendered)
+        self._observe(req, start, resp[0])
+        return resp
+
+    def _observe(self, req: Request, start: float, status: int) -> None:
         # bucket unknown methods: the label is client-controlled and must
         # not grow the process-global registry without bound
         method = req.method if req.method in _KNOWN_METHODS else "OTHER"
         self._m_latency.observe(time.monotonic() - start, method=method)
-        self._m_requests.inc(method=method, status=str(resp[0]))
-        return resp
+        self._m_requests.inc(method=method, status=str(status))
 
-    def _dispatch(self, req: Request) -> tuple[int, bytes, str]:
+    def _dispatch(self, req: Request):
         # Precedence contract: literal-first-segment routes match before
         # parameter-first ones; within each group, registration order wins.
         # (This differs from a pure registration-order scan only when a
@@ -211,6 +283,8 @@ class ServingApp:
                 return _render_error(e.status, e.message, req)
             except Exception as e:  # noqa: BLE001 - boundary: render a 500
                 return _render_error(500, f"{type(e).__name__}: {e}", req)
+            if isinstance(result, Deferred):
+                return result  # rendered at completion by dispatch_nowait
             return _render(result, req)
         if matched_path:
             return _render_error(405, "method not allowed", req)

@@ -27,7 +27,7 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from urllib.parse import parse_qs, urlsplit
 
-from oryx_tpu.serving.app import Request, ServingApp
+from oryx_tpu.serving.app import Deferred, Request, ServingApp
 from oryx_tpu.serving.auth import Authenticator
 
 log = logging.getLogger(__name__)
@@ -261,9 +261,15 @@ class AsyncHTTPServer:
         )
         loop = asyncio.get_running_loop()
         try:
-            status, payload, ctype = await loop.run_in_executor(
-                self._pool, self.app.dispatch, req
+            resp = await loop.run_in_executor(
+                self._pool, self.app.dispatch_nowait, req
             )
+            if isinstance(resp, Deferred):
+                # deferred endpoints (device-batched top-k) complete on the
+                # event loop: the worker thread is already free, so in-flight
+                # requests are bounded by memory, not by pool size
+                resp = await asyncio.wrap_future(resp.future)
+            status, payload, ctype = resp
         except Exception:  # pragma: no cover - dispatch renders its own 500s
             log.exception("dispatch failed")
             status, payload, ctype = 500, b"internal error", "text/plain"

@@ -228,6 +228,22 @@ class TopKBatcher:
         host-side scoring when the device transport is wedged; host_norms
         caches its row norms for cosine fallbacks.
         """
+        return self.submit_nowait(
+            vec, k, y, host_mat=host_mat, cosine=cosine, host_norms=host_norms
+        ).result()
+
+    def submit_nowait(
+        self,
+        vec: np.ndarray,
+        k: int,
+        y,
+        host_mat: np.ndarray | None = None,
+        cosine: bool = False,
+        host_norms: np.ndarray | None = None,
+    ) -> Future:
+        """submit() without the wait: returns the Future of (values,
+        indices). Deferred endpoints chain post-processing onto it instead
+        of parking a worker thread per in-flight request."""
         vec = np.asarray(vec, dtype=np.float32)
         fut: Future = Future()
         p = _Pending(vec, int(k), y, fut, host_mat, cosine, host_norms)
@@ -253,7 +269,7 @@ class TopKBatcher:
             if p.resolve_on_host():
                 with self._lock:
                     self.host_fallbacks += 1
-        return fut.result()
+        return fut
 
     def close(self) -> None:
         with self._cond:

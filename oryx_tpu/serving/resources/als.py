@@ -20,7 +20,9 @@ from __future__ import annotations
 import numpy as np
 
 from oryx_tpu.common.text import join_csv
-from oryx_tpu.serving.app import OryxServingException, Request, ServingApp
+from oryx_tpu.serving.app import (
+    OryxServingException, Request, ServingApp, deferred_map,
+)
 
 
 def _model(a: ServingApp):
@@ -87,8 +89,10 @@ def register(app: ServingApp) -> None:
         consider_known = req.q1("considerKnownItems", "false") == "true"
         exclude = set() if consider_known else model.state.get_known_items(user)
         rescorer = _rescorer(a, "get_recommend_rescorer", req, [user], model)
-        pairs = model.top_n(xu, how_many + offset, exclude, rescorer)
-        return _page(pairs, how_many, offset)
+        return deferred_map(
+            model.top_n_async(xu, how_many + offset, exclude, rescorer),
+            lambda pairs: _page(pairs, how_many, offset),
+        )
 
     @app.route("GET", "/recommendToMany/{userIDs:rest}")
     def recommend_to_many(a: ServingApp, req: Request):
@@ -106,9 +110,11 @@ def register(app: ServingApp) -> None:
         consider_known = req.q1("considerKnownItems", "false") == "true"
         rescorer = _rescorer(a, "get_recommend_rescorer", req, users, model)
         mean_vec = np.mean(vecs, axis=0)
-        pairs = model.top_n(mean_vec, how_many + offset,
-                            set() if consider_known else known, rescorer)
-        return _page(pairs, how_many, offset)
+        return deferred_map(
+            model.top_n_async(mean_vec, how_many + offset,
+                              set() if consider_known else known, rescorer),
+            lambda pairs: _page(pairs, how_many, offset),
+        )
 
     @app.route("GET", "/recommendToAnonymous/{itemPrefs:rest}")
     def recommend_to_anonymous(a: ServingApp, req: Request):
@@ -120,8 +126,10 @@ def register(app: ServingApp) -> None:
         how_many, offset = _how_many(req)
         rescorer = _rescorer(a, "get_recommend_to_anonymous_rescorer", req,
                              [i for i, _ in prefs], model)
-        pairs = model.top_n(xu, how_many + offset, {i for i, _ in prefs}, rescorer)
-        return _page(pairs, how_many, offset)
+        return deferred_map(
+            model.top_n_async(xu, how_many + offset, {i for i, _ in prefs}, rescorer),
+            lambda pairs: _page(pairs, how_many, offset),
+        )
 
     @app.route("GET", "/recommendWithContext/{userID}/{itemPrefs:rest}")
     def recommend_with_context(a: ServingApp, req: Request):
@@ -136,8 +144,10 @@ def register(app: ServingApp) -> None:
         how_many, offset = _how_many(req)
         exclude = model.state.get_known_items(user) | {i for i, _ in prefs}
         rescorer = _rescorer(a, "get_recommend_rescorer", req, [user], model)
-        pairs = model.top_n(xu, how_many + offset, exclude, rescorer)
-        return _page(pairs, how_many, offset)
+        return deferred_map(
+            model.top_n_async(xu, how_many + offset, exclude, rescorer),
+            lambda pairs: _page(pairs, how_many, offset),
+        )
 
     # -- similarity family -------------------------------------------------
 
@@ -150,10 +160,12 @@ def register(app: ServingApp) -> None:
             raise OryxServingException(404, "no known items")
         how_many, offset = _how_many(req)
         rescorer = _rescorer(a, "get_most_similar_items_rescorer", req, model)
-        pairs = model.top_n(
-            mean_vec, how_many + offset, set(items), rescorer, cosine=True
+        return deferred_map(
+            model.top_n_async(
+                mean_vec, how_many + offset, set(items), rescorer, cosine=True
+            ),
+            lambda pairs: _page(pairs, how_many, offset),
         )
-        return _page(pairs, how_many, offset)
 
     @app.route("GET", "/similarityToItem/{toItemID}/{itemIDs:rest}")
     def similarity_to_item(a: ServingApp, req: Request):

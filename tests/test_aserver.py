@@ -278,3 +278,49 @@ def test_threaded_frontend_reuse_port():
             streak = 0
             _time.sleep(0.1)
         assert streak >= 6, "both reuse-port servers never became ready"
+
+
+def test_recommend_dispatch_is_deferred():
+    """The recommend-family endpoints must not park the dispatch thread:
+    dispatch_nowait returns a Deferred whose future completes with the
+    rendered response (the async frontend awaits it with no worker held)."""
+    import numpy as np
+
+    from oryx_tpu.apps.als.serving import ALSServingModel, ALSServingModelManager
+    from oryx_tpu.apps.als.state import ALSState
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.serving.app import Deferred, Request, ServingApp
+
+    rng = np.random.default_rng(0)
+    state = ALSState(4, implicit=True)
+    state.y.bulk_set(["i0", "i1", "i2"], rng.standard_normal((3, 4), dtype=np.float32))
+    state.x.bulk_set(["u0"], rng.standard_normal((1, 4), dtype=np.float32))
+    state.set_expected(["u0"], ["i0", "i1", "i2"])
+    cfg = load_config(
+        overlay={
+            "oryx.serving.application-resources": [
+                "oryx_tpu.serving.resources.common",
+                "oryx_tpu.serving.resources.als",
+            ]
+        }
+    )
+    mgr = ALSServingModelManager(cfg)
+    mgr.model = ALSServingModel(state, sample_rate=1.0)
+    app = ServingApp(cfg, mgr)
+
+    resp = app.dispatch_nowait(
+        Request("GET", "/recommend/u0", {}, {"howMany": ["2"]}, b"",
+                {"accept": "application/json"})
+    )
+    assert isinstance(resp, Deferred)
+    status, body, ctype = resp.future.result(timeout=30)
+    assert status == 200
+    import json
+
+    assert len(json.loads(body)) == 2
+    # blocking dispatch() keeps its synchronous contract on the same route
+    status2, body2, _ = app.dispatch(
+        Request("GET", "/recommend/u0", {}, {"howMany": ["2"]}, b"",
+                {"accept": "application/json"})
+    )
+    assert status2 == 200 and json.loads(body2) == json.loads(body)
