@@ -437,6 +437,7 @@ def _bench_train_body() -> None:
     t0 = time.perf_counter()
     data = aggregate_interactions(users[tr], items[tr], values[tr], implicit=True)
     t_agg = time.perf_counter() - t0
+    timings: dict = {}
     model = train_als(
         data,
         features=features,
@@ -448,6 +449,7 @@ def _bench_train_body() -> None:
         # 0.939 f32 on this generator at the 1M fallback scale) and the
         # held-out AUC below keeps that claim measured every run
         compute_dtype="bfloat16",
+        timings=timings,
     )
     build_s = time.perf_counter() - t0
 
@@ -504,6 +506,12 @@ def _bench_train_body() -> None:
                 "interactions": nnz,
                 "auc": round(auc, 4),
                 "factor_nan_rows": nan_rows,
+                # breakdown: total = agg + lists + compile + train (+ eval
+                # prep); compile is one-time and amortizes across rebuilds
+                "agg_s": round(t_agg, 1),
+                "lists_s": round(timings.get("lists_s", 0.0), 1),
+                "compile_s": round(timings.get("compile_s", 0.0), 1),
+                "train_s": round(timings.get("train_s", 0.0), 1),
             }
         )
     )
@@ -901,6 +909,9 @@ def main() -> None:
             result["als_build_seconds"] = train.get("value")
             result["als_build_auc"] = train.get("auc")
             result["als_build_interactions"] = train.get("interactions")
+            for part in ("agg_s", "lists_s", "compile_s", "train_s"):
+                if part in train:
+                    result[f"als_build_{part}"] = train[part]
             if train.get("factor_nan_rows"):
                 result["als_factor_nan_rows"] = train["factor_nan_rows"]
         else:

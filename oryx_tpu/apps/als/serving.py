@@ -34,12 +34,22 @@ _LSH_REFRESH_SEC = 1.0
 
 _POST_POOL = None
 _POST_POOL_LOCK = threading.Lock()
+_POST_POOL_WORKERS = 8  # overridden from config by the serving manager
+
+
+def configure_post_pool(workers: int) -> None:
+    """Size the post-processing pool (oryx.serving.api.post-workers) —
+    takes effect at first use; an already-created pool keeps its size."""
+    global _POST_POOL_WORKERS
+    _POST_POOL_WORKERS = max(1, int(workers))
 
 
 def _post_pool():
     """Shared pool for per-request post-processing chained off batcher
     futures (sized for trim/render work; a rescorer that blocks holds one
-    of these threads, never the batcher dispatcher)."""
+    of these threads, never the batcher dispatcher — and blocking top_n()
+    callers post-process on their own thread, so nested rescorer queries
+    cannot exhaust this pool into a deadlock)."""
     global _POST_POOL
     if _POST_POOL is None:
         with _POST_POOL_LOCK:
@@ -47,7 +57,8 @@ def _post_pool():
                 from concurrent.futures import ThreadPoolExecutor
 
                 _POST_POOL = ThreadPoolExecutor(
-                    max_workers=8, thread_name_prefix="oryx-topn-post"
+                    max_workers=_POST_POOL_WORKERS,
+                    thread_name_prefix="oryx-topn-post",
                 )
     return _POST_POOL
 
@@ -169,61 +180,32 @@ class ALSServingModel(ServingModel):
 
     # -- queries -----------------------------------------------------------
 
-    def top_n(
-        self,
-        user_vector: np.ndarray,
-        how_many: int,
-        exclude: set[str] = frozenset(),
-        rescorer=None,
-        cosine: bool = False,
-    ) -> list[tuple[str, float]]:
-        return self.top_n_async(
-            user_vector, how_many, exclude, rescorer, cosine
-        ).result()
-
-    def top_n_async(
-        self,
-        user_vector: np.ndarray,
-        how_many: int,
-        exclude: set[str] = frozenset(),
-        rescorer=None,
-        cosine: bool = False,
-    ) -> Future:
-        """top_n as a Future: the device path chains its host-side
-        post-processing (exact re-rank, exclusion/rescorer trim) onto the
-        batcher future, so a deferred endpoint holds no thread while the
-        coalesced dispatch is in flight."""
-        out: Future = Future()
+    def _top_n_plan(self, user_vector, how_many, exclude, rescorer, cosine):
+        """Shared front half of top_n/top_n_async: either ("done", pairs)
+        for paths resolved synchronously on the host, or
+        ("fut", batcher_future, post_fn) for the device path."""
         if self.sample_rate < 1.0:
             # LSH candidate subsampling: score only items whose partition is
             # within the Hamming ball of the query's (the reference's
             # candidate-partition fan-out, ALSServingModel.java:264-279).
             # Matrix/ids/partitions are one matched snapshot from _lsh_index.
             # Pure host work — completes immediately.
-            try:
-                lsh, y_host, ids, parts = self._lsh_index()
-                if not ids:
-                    out.set_result([])
-                    return out
-                k = min(len(ids), how_many + len(exclude) + 8)
-                rows = np.nonzero(
-                    np.isin(parts, lsh.candidate_indices(user_vector))
-                )[0]
-                if rows.size == 0:
-                    out.set_result([])
-                    return out
-                cand = y_host[rows]
-                vals, top = host_topk(
-                    np.asarray(user_vector, dtype=np.float32),
-                    min(k, rows.size), cand, cosine,
-                )
-                idx = rows[top]
-                out.set_result(
-                    _trim_pairs(vals, idx, ids, how_many, exclude, rescorer)
-                )
-            except BaseException as e:  # noqa: BLE001 - carried to caller
-                out.set_exception(e)
-            return out
+            lsh, y_host, ids, parts = self._lsh_index()
+            if not ids:
+                return "done", []
+            k = min(len(ids), how_many + len(exclude) + 8)
+            rows = np.nonzero(
+                np.isin(parts, lsh.candidate_indices(user_vector))
+            )[0]
+            if rows.size == 0:
+                return "done", []
+            cand = y_host[rows]
+            vals, top = host_topk(
+                np.asarray(user_vector, dtype=np.float32),
+                min(k, rows.size), cand, cosine,
+            )
+            idx = rows[top]
+            return "done", _trim_pairs(vals, idx, ids, how_many, exclude, rescorer)
 
         host_norms = None
         if cosine:
@@ -232,8 +214,7 @@ class ALSServingModel(ServingModel):
             y, ids, _v, host_mat = self._y_view_full()
         n = len(ids)
         if n == 0:
-            out.set_result([])
-            return out
+            return "done", []
         # over-fetch to survive exclusions/filters, then trim.
         # Concurrent requests coalesce into one bucketed-shape device
         # dispatch (serving/batcher.py) — B=1 matmuls waste the MXU and
@@ -256,12 +237,55 @@ class ALSServingModel(ServingModel):
             vals, idx = _rerank_exact(user_vector, vals, idx, host_mat, cosine)
             return _trim_pairs(vals, idx, ids, how_many, exclude, rescorer)
 
+        return "fut", fut, _post
+
+    def top_n(
+        self,
+        user_vector: np.ndarray,
+        how_many: int,
+        exclude: set[str] = frozenset(),
+        rescorer=None,
+        cosine: bool = False,
+    ) -> list[tuple[str, float]]:
+        """Blocking top-N. Post-processing runs on the CALLER's thread —
+        never the post pool — so rescorers issuing nested blocking queries
+        cannot exhaust the pool into a deadlock."""
+        plan = self._top_n_plan(user_vector, how_many, exclude, rescorer, cosine)
+        if plan[0] == "done":
+            return plan[1]
+        _, fut, post = plan
+        return post(fut.result())
+
+    def top_n_async(
+        self,
+        user_vector: np.ndarray,
+        how_many: int,
+        exclude: set[str] = frozenset(),
+        rescorer=None,
+        cosine: bool = False,
+    ) -> Future:
+        """top_n as a Future: the device path chains its host-side
+        post-processing (exact re-rank, exclusion/rescorer trim) onto the
+        batcher future, so a deferred endpoint holds no thread while the
+        coalesced dispatch is in flight."""
+        out: Future = Future()
+        try:
+            plan = self._top_n_plan(
+                user_vector, how_many, exclude, rescorer, cosine
+            )
+        except BaseException as e:  # noqa: BLE001 - carried to caller
+            out.set_exception(e)
+            return out
+        if plan[0] == "done":
+            out.set_result(plan[1])
+            return out
+        _, fut, post = plan
         # post-processing (and everything chained after it: pagination,
         # render, metrics) bounces onto a pool — run inline it would
         # serialize on the batcher dispatcher thread inside the watchdog
         # window, stalling the device pipeline and deadlocking any
         # rescorer that submits its own query
-        return chain_future(fut, _post, executor=_post_pool())
+        return chain_future(fut, post, executor=_post_pool())
 
     def get_user_vector(self, user: str) -> np.ndarray | None:
         return self.state.x.get(user)
@@ -394,6 +418,9 @@ class ALSServingModelManager(AbstractServingModelManager):
         self.als = ALSConfig.from_config(config)
         self.model: ALSServingModel | None = None
         self._rescorer_provider = _load_rescorer_provider(config)
+        configure_post_pool(
+            config.get_int("oryx.serving.api.post-workers", 8)
+        )
 
     def get_model(self) -> ALSServingModel | None:
         return self.model

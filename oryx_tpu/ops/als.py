@@ -383,6 +383,7 @@ def train_als(
     seed_key=None,
     compute_dtype: str = "float32",
     resume_y: np.ndarray | None = None,
+    timings: dict | None = None,
 ) -> ALSModelArrays:
     """Train ALS factor matrices. If a mesh is given, the padded lists and
     factor tables are sharded over its "data" axis and the whole scan runs
@@ -392,7 +393,13 @@ def train_als(
     the normal-equation einsums bf16 inputs with f32 accumulation (the
     MXU-native fast path; solves stay f32). resume_y replaces the random
     item-factor init with a [n_items, features] matrix (mid-build
-    checkpoint resume: the per-sweep carry is fully determined by Y)."""
+    checkpoint resume: the per-sweep carry is fully determined by Y).
+
+    timings (single-device path only): pass a dict to receive a
+    {"lists_s", "compile_s", "train_s"} breakdown — the XLA compile is
+    separated from compute via AOT lower/compile, so benchmarks report
+    one-time compilation apart from the per-build cost it amortizes into.
+    """
     if mesh is not None:
         from oryx_tpu.parallel.mesh import MODEL_AXIS
 
@@ -409,6 +416,9 @@ def train_als(
         raise ValueError("empty interaction data")
 
     if mesh is None:
+        import time as _time
+
+        t_mark = _time.perf_counter()
         # single-device: bucketed lists — work scales with real row
         # lengths instead of the heaviest row's power-of-two padding.
         # Row counts round to a 1024 unit so retrains on slowly growing
@@ -441,14 +451,28 @@ def train_als(
                 + 1.0 / math.sqrt(features)
             )
             y0 = y0 * (jnp.arange(n_i_pad) < n_i)[:, None]
-        x, y = als_train_bucketed_jit(
+        args = (
             tuple(tuple(jnp.asarray(a) for a in b) for b in u_buckets),
             tuple(tuple(jnp.asarray(a) for a in b) for b in i_buckets),
             y0, jnp.float32(lam), jnp.float32(alpha),
+        )
+        kwargs = dict(
             implicit=implicit, iterations=iterations,
             blocks_u=tuple(blocks_u), blocks_i=tuple(blocks_i), n_u=n_u_pad,
             compute_dtype=compute_dtype,
         )
+        if timings is None:
+            x, y = als_train_bucketed_jit(*args, **kwargs)
+        else:
+            # AOT lower/compile so the one-time XLA compile is measured
+            # apart from the compute it amortizes into
+            timings["lists_s"] = _time.perf_counter() - t_mark
+            t_mark = _time.perf_counter()
+            compiled = als_train_bucketed_jit.lower(*args, **kwargs).compile()
+            timings["compile_s"] = _time.perf_counter() - t_mark
+            t_mark = _time.perf_counter()
+            x, y = jax.block_until_ready(compiled(*args))
+            timings["train_s"] = _time.perf_counter() - t_mark
         return _finish_model(x, y, n_u, n_i, data)
 
     # mesh path: one global width, rows padded to a common multiple of the

@@ -67,7 +67,20 @@ def chain_future(
     if executor is None:
         future.add_done_callback(_apply)
     else:
-        future.add_done_callback(lambda f: executor.submit(_apply, f))
+
+        def _bounce(f):
+            try:
+                executor.submit(_apply, f)
+            except Exception:
+                # pool shut down: fail the future rather than leave
+                # blocked callers hanging — and never run fn inline here,
+                # because the completing thread may be the batcher
+                # dispatcher, which arbitrary fn code could deadlock
+                if not out.done():
+                    out.set_exception(
+                        RuntimeError("post-processing pool is shut down")
+                    )
+        future.add_done_callback(_bounce)
     return out
 
 
@@ -239,10 +252,8 @@ class ServingApp:
             def _finish(f):
                 try:
                     out = _render(f.result(), req)
-                except OryxServingException as e:
-                    out = _render_error(e.status, e.message, req)
-                except BaseException as e:  # noqa: BLE001 - boundary: 500
-                    out = _render_error(500, f"{type(e).__name__}: {e}", req)
+                except BaseException as e:  # noqa: BLE001 - boundary
+                    out = _render_exception(e, req)
                 self._observe(req, start, out[0])
                 rendered.set_result(out)
 
@@ -279,10 +290,8 @@ class ServingApp:
             req.params = {k: _unquote(v) for k, v in m.groupdict().items()}
             try:
                 result = r.handler(self, req)
-            except OryxServingException as e:
-                return _render_error(e.status, e.message, req)
-            except Exception as e:  # noqa: BLE001 - boundary: render a 500
-                return _render_error(500, f"{type(e).__name__}: {e}", req)
+            except Exception as e:  # noqa: BLE001 - boundary: render error
+                return _render_exception(e, req)
             if isinstance(result, Deferred):
                 return result  # rendered at completion by dispatch_nowait
             return _render(result, req)
@@ -355,6 +364,14 @@ def _render(result: Any, req: Request) -> tuple[int, bytes, str]:
     rows = _to_csv_rows(payload)
     text = "\n".join(join_csv(r) for r in rows)
     return status, (text + ("\n" if text else "")).encode("utf-8"), "text/csv"
+
+
+def _render_exception(e: BaseException, req: Request) -> tuple[int, bytes, str]:
+    """The ONE error-rendering boundary, shared by sync dispatch and
+    deferred completion so status/format behavior cannot drift."""
+    if isinstance(e, OryxServingException):
+        return _render_error(e.status, e.message, req)
+    return _render_error(500, f"{type(e).__name__}: {e}", req)
 
 
 def _render_error(status: int, message: str, req: Request) -> tuple[int, bytes, str]:

@@ -154,3 +154,51 @@ def test_model_with_inline_tensors_counts_loaded():
     }
     st = apply_update_message(None, "MODEL", art.to_string())
     assert st.fraction_loaded() == 1.0
+
+
+def test_nested_rescorer_query_does_not_deadlock_post_pool():
+    """A rescorer that issues its own blocking top_n() runs on a post-pool
+    thread; the nested query must not need the pool again (blocking top_n
+    post-processes on the caller's thread) or a 1-thread pool deadlocks."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import oryx_tpu.apps.als.serving as srv
+    from oryx_tpu.apps.als.serving import ALSServingModel
+    from oryx_tpu.apps.als.state import ALSState
+
+    rng = np.random.default_rng(0)
+    state = ALSState(4, implicit=True)
+    state.y.bulk_set(
+        [f"i{j}" for j in range(20)], rng.standard_normal((20, 4), dtype=np.float32)
+    )
+    state.x.bulk_set(["u0"], rng.standard_normal((1, 4), dtype=np.float32))
+    state.set_expected(["u0"], [f"i{j}" for j in range(20)])
+    model = ALSServingModel(state, sample_rate=1.0)
+
+    class NestedRescorer:
+        def __init__(self):
+            self.nested_done = False
+
+        def is_filtered(self, ident):
+            return False
+
+        def rescore(self, ident, score):
+            if not self.nested_done:
+                self.nested_done = True
+                # nested blocking query from inside post-processing
+                inner = model.top_n(np.ones(4, dtype=np.float32), 2)
+                assert len(inner) == 2
+            return score
+
+    old = srv._POST_POOL
+    srv._POST_POOL = ThreadPoolExecutor(max_workers=1, thread_name_prefix="t1")
+    try:
+        r = NestedRescorer()
+        fut = model.top_n_async(
+            np.ones(4, dtype=np.float32), 3, rescorer=r
+        )
+        pairs = fut.result(timeout=30)
+        assert len(pairs) == 3 and r.nested_done
+    finally:
+        srv._POST_POOL.shutdown(wait=False)
+        srv._POST_POOL = old

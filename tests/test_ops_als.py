@@ -469,3 +469,21 @@ def test_singular_systems_never_nan():
     assert np.isfinite(m.y).all(), "NaN leaked into item factors"
     # and the model still scores: predictions are finite everywhere
     assert np.isfinite(m.x @ m.y.T).all()
+
+
+def test_train_timings_breakdown_matches_normal_path():
+    """timings= uses AOT lower/compile; the factors must match the normal
+    jit path (same HLO, independently compiled) and the breakdown must be
+    populated."""
+    data = _synthetic_implicit()
+    t: dict = {}
+    m1 = train_als(data, features=4, lam=0.01, alpha=10.0, iterations=3,
+                   implicit=True, seed_key=jax.random.PRNGKey(5))
+    m2 = train_als(data, features=4, lam=0.01, alpha=10.0, iterations=3,
+                   implicit=True, seed_key=jax.random.PRNGKey(5), timings=t)
+    # two independent compilations of the same HLO: allow last-ulp drift
+    # on backends with nondeterministic autotuning
+    np.testing.assert_allclose(m1.x, m2.x, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(m1.y, m2.y, rtol=1e-6, atol=1e-7)
+    assert set(t) == {"lists_s", "compile_s", "train_s"}
+    assert all(v >= 0 for v in t.values())
