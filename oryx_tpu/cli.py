@@ -221,23 +221,31 @@ def _run_until_interrupt(layer) -> int:
 
 def cmd_batch(config: Config) -> int:
     from oryx_tpu.layers import BatchLayer
-    from oryx_tpu.parallel.distributed import init_distributed
+    from oryx_tpu.parallel.distributed import (
+        configure_compilation_cache, init_distributed,
+    )
 
+    configure_compilation_cache(config)
     init_distributed(config)
     return _run_until_interrupt(BatchLayer(config))
 
 
 def cmd_speed(config: Config) -> int:
     from oryx_tpu.layers import SpeedLayer
-    from oryx_tpu.parallel.distributed import init_distributed
+    from oryx_tpu.parallel.distributed import (
+        configure_compilation_cache, init_distributed,
+    )
 
+    configure_compilation_cache(config)
     init_distributed(config)
     return _run_until_interrupt(SpeedLayer(config))
 
 
 def cmd_serving(config: Config, argv: list[str] | None = None) -> int:
+    from oryx_tpu.parallel.distributed import configure_compilation_cache
     from oryx_tpu.serving.server import ServingLayer
 
+    configure_compilation_cache(config)
     n_procs = config.get_int("oryx.serving.api.processes", 1)
     import os
 
