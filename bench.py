@@ -778,9 +778,10 @@ def _bench_kmeans_rdf_body() -> None:
 # --------------------------------------------------------------------------
 
 def _cpu_env() -> dict:
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    return env
+    sys.path.insert(0, HERE)
+    from oryx_tpu.common.executil import cpu_subprocess_env
+
+    return cpu_subprocess_env()
 
 
 # The env var alone does NOT stop this host's sitecustomize from

@@ -61,3 +61,19 @@ class LoggingRunnable:
         except Exception:  # noqa: BLE001 - must log whatever escapes a thread
             log.exception("unexpected error in %s", self.name)
             raise
+
+
+def cpu_subprocess_env(base: dict | None = None, **overrides: str) -> dict:
+    """Environment for a CPU-only child python process: forces
+    JAX_PLATFORMS=cpu and strips accelerator-plugin triggers. A
+    sitecustomize-registered device transport dials the accelerator at
+    interpreter startup, and a wedged transport then hangs even CPU-only
+    children at import (observed on the round-1 bench host) — a child that
+    will never use the device must not inherit the trigger."""
+    import os
+
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(overrides)
+    return env

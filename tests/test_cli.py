@@ -205,7 +205,9 @@ oryx.serving.model-manager-class = "oryx_tpu.apps.example.serving.ExampleServing
 oryx.serving.application-resources = ["oryx_tpu.serving.resources.common", "oryx_tpu.serving.resources.example"]
 ''')
     root = pathlib.Path(__file__).resolve().parent.parent
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(root))
+    from oryx_tpu.common.executil import cpu_subprocess_env
+
+    env = cpu_subprocess_env(PYTHONPATH=str(root))
     sup = subprocess.Popen(
         [sys.executable, "-m", "oryx_tpu.cli", "serving", "--conf", str(conf)],
         cwd=str(root),

@@ -179,6 +179,7 @@ def test_two_process_pod_collectives(tmp_path):
     port = _free_port()
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # see cpu_subprocess_env
     flags = [
         f
         for f in env.get("XLA_FLAGS", "").split()

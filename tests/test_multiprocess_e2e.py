@@ -245,7 +245,9 @@ art = upd.build_model(lines, {"features": 8, "lambda": 0.001, "alpha": 1.0})
 print("BUILD_OK", art.tensors["X"].shape, flush=True)
 """
     root = str(REPO)
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    from oryx_tpu.common.executil import cpu_subprocess_env
+
+    env = cpu_subprocess_env()
     p1 = subprocess.run(
         [sys.executable, "-c", worker, str(tmp_path), "abort", root],
         env=env, capture_output=True, text=True, timeout=150,
