@@ -20,6 +20,13 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Strip the accelerator-plugin trigger ONCE for the whole test session:
+# every child process the tests spawn inherits this mutated os.environ, so
+# no CPU-only child can dial a (possibly wedged) device transport at
+# interpreter startup (see oryx_tpu.common.executil.cpu_subprocess_env).
+# Too late for THIS process (sitecustomize already ran) — that is what the
+# jax.config.update below handles.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,15 @@
+"""Shared helpers for the per-app end-to-end lambda-slice suites."""
+
+import urllib.error
+import urllib.request
+
+
+def http_request(method, url, body=None, accept="application/json"):
+    req = urllib.request.Request(
+        url, method=method, data=body, headers={"Accept": accept}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()

@@ -8,8 +8,6 @@ in-process broker with a real HTTP server on a free port.
 
 import json
 import time
-import urllib.request
-import urllib.error
 
 import numpy as np
 import pytest
@@ -33,14 +31,7 @@ def _fresh_registry():
     InProcBroker.reset_all()
 
 
-def _http(method, url, body=None, accept="application/json"):
-    req = urllib.request.Request(url, method=method, data=body,
-                                 headers={"Accept": accept})
-    try:
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            return resp.status, resp.read().decode()
-    except urllib.error.HTTPError as e:
-        return e.code, e.read().decode()
+from e2e_common import http_request as _http  # noqa: E402
 
 
 def _make_config(tmp_path, port):

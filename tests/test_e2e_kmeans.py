@@ -1,0 +1,149 @@
+"""End-to-end k-means lambda slice: ingest -> batch build -> update topic
+-> serving answers /assign + /distanceToNearest -> speed layer shifts
+centroids from /add traffic -> serving applies the moves.
+
+The clustering analogue of test_e2e_als.py (the reference's
+KMeansUpdateIT + serving ITs), over the in-process broker with a real
+HTTP server.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from oryx_tpu.apps.kmeans import KMeansSpeedModelManager, KMeansUpdate
+from oryx_tpu.apps.kmeans.serving import KMeansServingModelManager
+from oryx_tpu.bus.broker import get_broker, topics
+from oryx_tpu.bus.inproc import InProcBroker
+from oryx_tpu.common.config import load_config
+from oryx_tpu.common.rng import RandomManager
+from oryx_tpu.layers import BatchLayer, SpeedLayer
+from oryx_tpu.serving.server import ServingLayer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    InProcBroker.reset_all()
+    yield
+    InProcBroker.reset_all()
+
+
+from e2e_common import http_request as _http  # noqa: E402
+
+
+def _cfg(tmp_path):
+    return load_config(overlay={
+        "oryx.id": "e2ekm",
+        "oryx.input-topic.broker": "mem://e2ekm",
+        "oryx.update-topic.broker": "mem://e2ekm",
+        "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+        "oryx.serving.api.port": 0,
+        "oryx.serving.application-resources": [
+            "oryx_tpu.serving.resources.common",
+            "oryx_tpu.serving.resources.clustering",
+        ],
+        "oryx.input-schema.num-features": 2,
+        "oryx.input-schema.numeric-features": ["0", "1"],
+        "oryx.kmeans.hyperparams.k": 2,
+        "oryx.kmeans.iterations": 10,
+        "oryx.ml.eval.test-fraction": 0.2,
+        "oryx.serving.min-model-load-fraction": 1.0,
+        "oryx.speed.min-model-load-fraction": 0.8,
+    })
+
+
+def _blob_lines(seed=0):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for c in ((0.0, 0.0), (10.0, 10.0)):
+        for _ in range(40):
+            lines.append(f"{rng.normal(c[0], 0.2):.4f},{rng.normal(c[1], 0.2):.4f}")
+    return lines
+
+
+def test_full_kmeans_slice(tmp_path):
+    RandomManager.use_test_seed(42)
+    cfg = _cfg(tmp_path)
+    topics.maybe_create("mem://e2ekm", "OryxInput", partitions=2)
+    topics.maybe_create("mem://e2ekm", "OryxUpdate", partitions=1)
+    broker = get_broker("mem://e2ekm")
+
+    serving = ServingLayer(cfg, model_manager=KMeansServingModelManager(cfg))
+    serving.start()
+    base = f"http://127.0.0.1:{serving.port}"
+    status, _ = _http("GET", f"{base}/ready")
+    assert status == 503
+
+    lines = _blob_lines()
+    status, resp = _http("POST", f"{base}/ingest", body="\n".join(lines).encode())
+    assert status == 200, resp
+
+    batch = BatchLayer(cfg, update=KMeansUpdate(cfg))
+    batch.ensure_streams()
+    batch._consumer._fetch_pos = {p: 0 for p in batch._consumer._fetch_pos}
+    n = batch.run_generation(timestamp_ms=1_700_000_000_000)
+    assert n == len(lines)
+    batch.close()
+    assert broker.read("OryxUpdate", 0, 0, 5)[0][1] == "MODEL"
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        status, _ = _http("GET", f"{base}/ready")
+        if status == 200:
+            break
+        time.sleep(0.1)
+    assert status == 200, "serving never became ready"
+
+    # the two blobs land in different clusters, near their centers
+    status, a0 = _http("GET", f"{base}/assign/0.1,0.1")
+    assert status == 200
+    status, a1 = _http("GET", f"{base}/assign/9.9,10.1")
+    assert status == 200
+    assert json.loads(a0) != json.loads(a1)
+    status, d = _http("GET", f"{base}/distanceToNearest/0.1,0.1")
+    assert status == 200 and float(json.loads(d)) < 1.0
+
+    # console section
+    status, resp = _http("GET", f"{base}/console")
+    assert status == 200 and "cluster" in resp.lower()
+
+    # ---- speed tier: /add traffic drags a centroid toward (12,12) ----
+    status, d_before = _http("GET", f"{base}/distanceToNearest/12.0,12.0")
+    assert status == 200
+    d_before = float(json.loads(d_before))
+
+    speed = SpeedLayer(cfg, manager=KMeansSpeedModelManager(cfg))
+    speed.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if speed.manager._model is not None:
+            break
+        time.sleep(0.1)
+    assert speed.manager._model is not None
+
+    # baseline BEFORE injecting: the micro-batch consumer is async and
+    # could otherwise process everything before the baseline is read
+    before = speed.batch_count
+    for _ in range(30):
+        status, _ = _http("POST", f"{base}/add/12.0,12.0")
+        assert status == 200
+    deadline = time.time() + 30
+    while speed.batch_count == before and time.time() < deadline:
+        time.sleep(0.1)
+
+    deadline = time.time() + 30
+    d_after = d_before
+    while time.time() < deadline:
+        status, resp = _http("GET", f"{base}/distanceToNearest/12.0,12.0")
+        if status == 200:
+            d_after = float(json.loads(resp))
+            if d_after < d_before - 0.05:
+                break
+        time.sleep(0.2)
+    assert d_after < d_before - 0.05, (d_before, d_after)
+
+    speed.close()
+    serving.close()

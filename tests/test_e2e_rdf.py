@@ -1,0 +1,167 @@
+"""End-to-end random-decision-forest lambda slice: ingest labeled examples
+-> batch forest build -> update topic -> serving answers /predict +
+/classificationDistribution -> speed layer folds /train examples into
+terminal-node stats -> serving applies the leaf updates.
+
+The classreg analogue of test_e2e_als.py (the reference's RDFUpdateIT +
+serving ITs), over the in-process broker with a real HTTP server.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from oryx_tpu.apps.rdf.batch import RDFUpdate
+from oryx_tpu.apps.rdf.serving import RDFServingModelManager
+from oryx_tpu.apps.rdf.speed import RDFSpeedModelManager
+from oryx_tpu.bus.broker import get_broker, topics
+from oryx_tpu.bus.inproc import InProcBroker
+from oryx_tpu.common.config import load_config
+from oryx_tpu.common.rng import RandomManager
+from oryx_tpu.layers import BatchLayer, SpeedLayer
+from oryx_tpu.serving.server import ServingLayer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    InProcBroker.reset_all()
+    yield
+    InProcBroker.reset_all()
+
+
+from e2e_common import http_request as _http  # noqa: E402
+
+
+def _cfg(tmp_path):
+    return load_config(overlay={
+        "oryx.id": "e2erdf",
+        "oryx.input-topic.broker": "mem://e2erdf",
+        "oryx.update-topic.broker": "mem://e2erdf",
+        "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+        "oryx.serving.api.port": 0,
+        "oryx.serving.application-resources": [
+            "oryx_tpu.serving.resources.common",
+            "oryx_tpu.serving.resources.classreg",
+        ],
+        "oryx.input-schema.feature-names": ["size", "color", "label"],
+        "oryx.input-schema.numeric-features": ["size"],
+        "oryx.input-schema.target-feature": "label",
+        "oryx.rdf.num-trees": 8,
+        "oryx.rdf.hyperparams.max-depth": 5,
+        "oryx.ml.eval.test-fraction": 0.2,
+        "oryx.serving.min-model-load-fraction": 1.0,
+        "oryx.speed.min-model-load-fraction": 0.8,
+    })
+
+
+def _cls_lines(n=600, seed=0):
+    """label = banana iff (size>0.5) xor (color==red)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        size = rng.random()
+        color = rng.choice(["red", "green", "blue"])
+        label = "banana" if (size > 0.5) ^ (color == "red") else "apple"
+        out.append(f"{size:.4f},{color},{label}")
+    return out
+
+
+def test_full_rdf_slice(tmp_path):
+    RandomManager.use_test_seed(5)
+    cfg = _cfg(tmp_path)
+    topics.maybe_create("mem://e2erdf", "OryxInput", partitions=2)
+    topics.maybe_create("mem://e2erdf", "OryxUpdate", partitions=1)
+    broker = get_broker("mem://e2erdf")
+
+    serving = ServingLayer(cfg, model_manager=RDFServingModelManager(cfg))
+    serving.start()
+    base = f"http://127.0.0.1:{serving.port}"
+    status, _ = _http("GET", f"{base}/ready")
+    assert status == 503
+
+    lines = _cls_lines()
+    status, resp = _http("POST", f"{base}/ingest", body="\n".join(lines).encode())
+    assert status == 200, resp
+
+    batch = BatchLayer(cfg, update=RDFUpdate(cfg))
+    batch.ensure_streams()
+    batch._consumer._fetch_pos = {p: 0 for p in batch._consumer._fetch_pos}
+    n = batch.run_generation(timestamp_ms=1_700_000_000_000)
+    assert n == len(lines)
+    batch.close()
+    assert broker.read("OryxUpdate", 0, 0, 5)[0][1] == "MODEL"
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        status, _ = _http("GET", f"{base}/ready")
+        if status == 200:
+            break
+        time.sleep(0.1)
+    assert status == 200, "serving never became ready"
+
+    # the forest learned the XOR rule on all four quadrants
+    for datum, want in (
+        ("0.9,green", "banana"),  # size>0.5, not red
+        ("0.9,red", "apple"),
+        ("0.1,red", "banana"),
+        ("0.1,blue", "apple"),
+    ):
+        status, resp = _http("GET", f"{base}/predict/{datum}")
+        assert status == 200, resp
+        assert json.loads(resp) == want, (datum, resp)
+
+    # distribution sums to ~1 and favors the predicted class
+    status, resp = _http("GET", f"{base}/classificationDistribution/0.9,green")
+    assert status == 200
+    dist = dict(json.loads(resp))
+    assert abs(sum(dist.values()) - 1.0) < 1e-6
+    assert dist.get("banana", 0) > dist.get("apple", 0)
+
+    # feature importances cover both predictors
+    status, resp = _http("GET", f"{base}/feature/importance")
+    assert status == 200 and len(json.loads(resp)) == 2
+
+    # bad feature index -> 400, unknown route -> 404 (an unparseable
+    # numeric feature is treated as MISSING and routed down the default
+    # branch, like the reference forest's missing-value handling)
+    status, _ = _http("GET", f"{base}/feature/importance/9")
+    assert status == 400
+    status, _ = _http("GET", f"{base}/nothere")
+    assert status == 404
+
+    # per-app console section
+    status, resp = _http("GET", f"{base}/console")
+    assert status == 200 and "importance" in resp.lower()
+
+    # ---- speed tier: /train examples update terminal-node stats ----
+    speed = SpeedLayer(cfg, manager=RDFSpeedModelManager(cfg))
+    speed.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if speed.manager.model is not None:
+            break
+        time.sleep(0.1)
+    assert speed.manager.model is not None
+
+    # baseline BEFORE injecting: the micro-batch consumer is async
+    before = speed.batch_count
+    train_lines = "\n".join(_cls_lines(n=100, seed=9))
+    status, _ = _http("POST", f"{base}/train", body=train_lines.encode())
+    assert status == 200
+    deadline = time.time() + 30
+    while speed.batch_count == before and time.time() < deadline:
+        time.sleep(0.1)
+    assert speed.batch_count > before, "speed micro-batch never ran"
+
+    # serving keeps answering correctly while leaf updates stream in
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        status, resp = _http("GET", f"{base}/predict/0.9,green")
+        assert status == 200 and json.loads(resp) == "banana"
+        time.sleep(0.2)
+
+    speed.close()
+    serving.close()

@@ -178,8 +178,9 @@ def test_two_process_pod_collectives(tmp_path):
 
     port = _free_port()
     env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("PALLAS_AXON_POOL_IPS", None)  # see cpu_subprocess_env
+    from oryx_tpu.common.executil import cpu_subprocess_env
+
+    env = cpu_subprocess_env(env)
     flags = [
         f
         for f in env.get("XLA_FLAGS", "").split()
