@@ -14,6 +14,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import weakref
 
 from oryx_tpu.api import BatchLayerUpdate
 from oryx_tpu.bus.api import ConsumeDataIterator, TopicProducer
@@ -21,8 +22,21 @@ from oryx_tpu.bus.broker import get_broker
 from oryx_tpu.common.classutil import load_instance_of
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.ioutil import delete_older_than, strip_scheme
-from oryx_tpu.common.metrics import GENERATION_BUCKETS, get_registry, maybe_profile
+from oryx_tpu.common.metrics import (
+    GENERATION_BUCKETS,
+    GaugeSeriesGone,
+    get_registry,
+    maybe_profile,
+)
 from oryx_tpu.layers.datastore import load_all_data, save_generation
+
+
+def _running_seconds(layer_ref) -> float:
+    layer = layer_ref()
+    if layer is None:
+        raise GaugeSeriesGone("batch layer gone")
+    started = layer._gen_started  # single read: may be cleared concurrently
+    return time.monotonic() - started if started is not None else 0.0
 
 log = logging.getLogger(__name__)
 
@@ -50,6 +64,7 @@ class BatchLayer:
 
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._watchdog: threading.Thread | None = None
         self._consumer: ConsumeDataIterator | None = None
         self.generation_count = 0
         self._profile_dir = config.get_string("oryx.monitoring.profile-dir", None)
@@ -68,6 +83,23 @@ class BatchLayer:
             "Wall-clock per batch generation (model build)",
             buckets=GENERATION_BUCKETS,
         )
+        # wedge detection: a device call inside a model build can hang
+        # forever on a broken accelerator transport; the gauge lets a
+        # scrape see a stuck generation, and the watchdog (start()) logs
+        # it — in-process cancellation of a hung C call is impossible, so
+        # detection + loud telemetry is the honest contract (the
+        # reference leaned on the Spark UI for the same visibility)
+        self._gen_started: float | None = None
+        self.watchdog_limit_sec = max(2.0 * self.interval_sec, 600.0)
+        self.watchdog_poll_sec = 30.0
+        # weak ref + single read: the process-global registry must not pin
+        # this layer alive (serving/app.py gauge pattern), and the running
+        # generation can finish between a None-check and the subtraction
+        ref = weakref.ref(self)
+        reg.gauge(
+            "oryx_batch_generation_running_seconds",
+            "Seconds the in-flight batch generation has been running (0 = idle)",
+        ).set_function(lambda: _running_seconds(ref))
 
     def ensure_streams(self) -> None:
         """Open consumers/producers now (otherwise lazily on first use).
@@ -102,6 +134,7 @@ class BatchLayer:
         new_data = self._consumer.poll_available()
         past_data = load_all_data(self.data_dir)
         if new_data or past_data:
+            self._gen_started = time.monotonic()
             try:
                 with self._m_duration.time(), maybe_profile(self._profile_dir, "batch-gen"):
                     self.update.run_update(
@@ -112,6 +145,8 @@ class BatchLayer:
                 # still run, and the next generation retries over history
                 log.exception("model build failed at generation %d", ts)
                 self._m_failures.inc()
+            finally:
+                self._gen_started = None
         else:
             log.info("generation %d: no data yet", ts)
         save_generation(self.data_dir, ts, new_data)
@@ -137,6 +172,37 @@ class BatchLayer:
         self._thread = threading.Thread(target=loop, name="oryx-batch", daemon=True)
         self._thread.start()
 
+        def watch():
+            # a build running far past the generation interval is almost
+            # certainly a wedged device call, not a slow model; say so
+            # loudly (and repeatedly) instead of going silent forever
+            limit = self.watchdog_limit_sec
+            warned_for: float | None = None  # the started-stamp last warned about
+            warned_at = 0.0
+            while not self._stop.wait(self.watchdog_poll_sec):
+                started = self._gen_started
+                if started is None:
+                    continue
+                if started != warned_for:
+                    # a NEW generation: reset the repeat clock even if the
+                    # idle gap fell between two polls
+                    warned_for, warned_at = started, 0.0
+                elapsed = time.monotonic() - started
+                if elapsed > limit and elapsed - warned_at > limit:
+                    warned_at = elapsed
+                    log.error(
+                        "batch generation has been running %.0fs (> %.0fs "
+                        "limit) — likely a wedged accelerator transport; "
+                        "the build cannot be cancelled in-process, restart "
+                        "the batch layer if the device is known dead",
+                        elapsed, limit,
+                    )
+
+        self._watchdog = threading.Thread(
+            target=watch, name="oryx-batch-watchdog", daemon=True
+        )
+        self._watchdog.start()
+
     def await_termination(self) -> None:
         if self._thread:
             self._thread.join()
@@ -147,6 +213,8 @@ class BatchLayer:
             self._consumer.close()
         if self._thread:
             self._thread.join(timeout=10)
+        if self._watchdog:
+            self._watchdog.join(timeout=10)
 
     def __enter__(self):
         return self

@@ -288,3 +288,52 @@ def test_transient_model_load_failure_retries(monkeypatch):
     mgr.consume(iter([KeyMessage("MODEL", "m-payload")]))
     assert mgr.attempts == 3
     assert mgr.loaded == [("MODEL", "m-payload")]
+
+
+def test_batch_watchdog_flags_stuck_generation(tmp_path, caplog):
+    """A model build running far past its limit is loudly reported (a
+    wedged device call cannot be cancelled in-process — detection is the
+    contract) and the running-generation gauge exposes the elapsed time."""
+    import logging as _logging
+    import threading as _threading
+
+    from oryx_tpu.api import BatchLayerUpdate
+    from oryx_tpu.common.metrics import get_registry
+
+    release = _threading.Event()
+
+    class StuckUpdate(BatchLayerUpdate):
+        def run_update(self, ts, new_data, past_data, model_dir, producer):
+            release.wait(timeout=30)
+
+    cfg = load_config(overlay={
+        "oryx.id": "wdog",
+        "oryx.input-topic.broker": "mem://wdog",
+        "oryx.update-topic.broker": "mem://wdog",
+        "oryx.batch.storage.data-dir": str(tmp_path / "d"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "m"),
+        "oryx.batch.streaming.generation-interval-sec": 1,
+    })
+    topics.maybe_create("mem://wdog", "OryxInput", partitions=1)
+    topics.maybe_create("mem://wdog", "OryxUpdate", partitions=1)
+    layer = BatchLayer(cfg, update=StuckUpdate())
+    layer.watchdog_limit_sec = 0.3
+    layer.watchdog_poll_sec = 0.1
+    layer.start()
+    producer = TopicProducer(get_broker("mem://wdog"), "OryxInput")
+    producer.send("k", "v")
+
+    gauge = get_registry().gauge(
+        "oryx_batch_generation_running_seconds", ""
+    )
+    with caplog.at_level(_logging.ERROR, logger="oryx_tpu.layers.batch"):
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if any("wedged" in r.message for r in caplog.records):
+                break
+            time.sleep(0.05)
+    assert any("wedged" in r.message for r in caplog.records), "no watchdog log"
+    assert gauge.value() > 0.3  # generation still in flight
+    release.set()
+    layer.close()
+    assert gauge.value() == 0.0
