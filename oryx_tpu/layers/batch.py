@@ -22,21 +22,9 @@ from oryx_tpu.bus.broker import get_broker
 from oryx_tpu.common.classutil import load_instance_of
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.ioutil import delete_older_than, strip_scheme
-from oryx_tpu.common.metrics import (
-    GENERATION_BUCKETS,
-    GaugeSeriesGone,
-    get_registry,
-    maybe_profile,
-)
+from oryx_tpu.common.metrics import GENERATION_BUCKETS, get_registry, maybe_profile
 from oryx_tpu.layers.datastore import load_all_data, save_generation
-
-
-def _running_seconds(layer_ref) -> float:
-    layer = layer_ref()
-    if layer is None:
-        raise GaugeSeriesGone("batch layer gone")
-    started = layer._gen_started  # single read: may be cleared concurrently
-    return time.monotonic() - started if started is not None else 0.0
+from oryx_tpu.layers.watchdog import running_seconds, start_wedge_watchdog
 
 log = logging.getLogger(__name__)
 
@@ -99,7 +87,7 @@ class BatchLayer:
         reg.gauge(
             "oryx_batch_generation_running_seconds",
             "Seconds the in-flight batch generation has been running (0 = idle)",
-        ).set_function(lambda: _running_seconds(ref))
+        ).set_function(lambda: running_seconds(ref, "_gen_started"))
 
     def ensure_streams(self) -> None:
         """Open consumers/producers now (otherwise lazily on first use).
@@ -172,36 +160,9 @@ class BatchLayer:
         self._thread = threading.Thread(target=loop, name="oryx-batch", daemon=True)
         self._thread.start()
 
-        def watch():
-            # a build running far past the generation interval is almost
-            # certainly a wedged device call, not a slow model; say so
-            # loudly (and repeatedly) instead of going silent forever
-            limit = self.watchdog_limit_sec
-            warned_for: float | None = None  # the started-stamp last warned about
-            warned_at = 0.0
-            while not self._stop.wait(self.watchdog_poll_sec):
-                started = self._gen_started
-                if started is None:
-                    continue
-                if started != warned_for:
-                    # a NEW generation: reset the repeat clock even if the
-                    # idle gap fell between two polls
-                    warned_for, warned_at = started, 0.0
-                elapsed = time.monotonic() - started
-                if elapsed > limit and elapsed - warned_at > limit:
-                    warned_at = elapsed
-                    log.error(
-                        "batch generation has been running %.0fs (> %.0fs "
-                        "limit) — likely a wedged accelerator transport; "
-                        "the build cannot be cancelled in-process, restart "
-                        "the batch layer if the device is known dead",
-                        elapsed, limit,
-                    )
-
-        self._watchdog = threading.Thread(
-            target=watch, name="oryx-batch-watchdog", daemon=True
+        self._watchdog = start_wedge_watchdog(
+            self, "_gen_started", "batch generation", log, "oryx-batch-watchdog"
         )
-        self._watchdog.start()
 
     def await_termination(self) -> None:
         if self._thread:

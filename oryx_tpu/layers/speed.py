@@ -14,6 +14,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import weakref
 
 from oryx_tpu.api import SpeedModelManager
 from oryx_tpu.bus.api import ConsumeDataIterator, TopicProducer
@@ -21,6 +22,7 @@ from oryx_tpu.bus.broker import get_broker
 from oryx_tpu.common.classutil import load_instance_of
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.metrics import MICROBATCH_BUCKETS, get_registry
+from oryx_tpu.layers.watchdog import running_seconds, start_wedge_watchdog
 
 log = logging.getLogger(__name__)
 
@@ -62,6 +64,17 @@ class SpeedLayer:
             "Wall-clock per speed micro-batch",
             buckets=MICROBATCH_BUCKETS,
         )
+        # wedge detection, same contract as the batch layer (layers/
+        # batch.py): the fold-in kernels run on the device, a wedged
+        # transport hangs them uncancellably — expose and log it
+        self._batch_started: float | None = None
+        self.watchdog_limit_sec = max(6.0 * self.interval_sec, 120.0)
+        self.watchdog_poll_sec = 10.0
+        ref = weakref.ref(self)
+        reg.gauge(
+            "oryx_speed_batch_running_seconds",
+            "Seconds the in-flight speed micro-batch has been running (0 = idle)",
+        ).set_function(lambda: running_seconds(ref, "_batch_started"))
 
     def ensure_streams(self) -> None:
         """Open consumers/producers now (otherwise lazily on first use).
@@ -101,6 +114,7 @@ class SpeedLayer:
         window_start = self._input_consumer.positions()
         batch = self._input_consumer.poll_available()
         if batch:
+            self._batch_started = time.monotonic()
             try:
                 with self._m_duration.time():
                     updates = list(self.manager.build_updates(batch))
@@ -115,6 +129,8 @@ class SpeedLayer:
                 self._input_consumer.seek(window_start)
                 self.batch_count += 1
                 return len(batch)
+            finally:
+                self._batch_started = None
         self._input_consumer.commit()
         self.batch_count += 1
         self._m_batches.inc()
@@ -140,9 +156,12 @@ class SpeedLayer:
 
         t1 = threading.Thread(target=listen, name="oryx-speed-model-listener", daemon=True)
         t2 = threading.Thread(target=loop, name="oryx-speed", daemon=True)
-        self._threads = [t1, t2]
         t1.start()
         t2.start()
+        t3 = start_wedge_watchdog(
+            self, "_batch_started", "speed micro-batch", log, "oryx-speed-watchdog"
+        )
+        self._threads = [t1, t2, t3]
 
     def await_termination(self) -> None:
         for t in self._threads:

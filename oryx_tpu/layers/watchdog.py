@@ -1,0 +1,59 @@
+"""Shared wedge-watchdog for the lambda tiers.
+
+A device call inside a model build or fold-in can hang forever on a
+broken accelerator transport, and a hung C call cannot be cancelled
+in-process — the honest contract is loud, repeated detection plus a
+scrape-visible gauge (the reference leaned on the Spark UI for the same
+visibility). Both layers share this mechanism; each exposes
+``watchdog_limit_sec`` / ``watchdog_poll_sec`` so tests can tighten them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from oryx_tpu.common.metrics import GaugeSeriesGone
+
+
+def running_seconds(layer_ref, attr: str) -> float:
+    """Gauge callback: elapsed seconds of the in-flight work, 0 when idle.
+    Weak ref so the process-global registry never pins a layer; single
+    attribute read because the work can finish concurrently."""
+    layer = layer_ref()
+    if layer is None:
+        raise GaugeSeriesGone("layer gone")
+    started = getattr(layer, attr)
+    return time.monotonic() - started if started is not None else 0.0
+
+
+def start_wedge_watchdog(layer, attr: str, what: str, log, name: str) -> threading.Thread:
+    """Daemon thread that logs an error while ``getattr(layer, attr)``
+    stays set past ``layer.watchdog_limit_sec``, re-warning once per limit
+    interval and resetting per piece of work (the started stamp changing
+    resets the clock even if the idle gap fell between two polls)."""
+
+    def watch() -> None:
+        warned_for: float | None = None
+        warned_at = 0.0
+        while not layer._stop.wait(layer.watchdog_poll_sec):
+            limit = layer.watchdog_limit_sec
+            started = getattr(layer, attr)
+            if started is None:
+                continue
+            if started != warned_for:
+                warned_for, warned_at = started, 0.0
+            elapsed = time.monotonic() - started
+            if elapsed > limit and elapsed - warned_at > limit:
+                warned_at = elapsed
+                log.error(
+                    "%s has been running %.0fs (> %.0fs limit) — likely a "
+                    "wedged accelerator transport; the call cannot be "
+                    "cancelled in-process, restart the layer if the device "
+                    "is known dead",
+                    what, elapsed, limit,
+                )
+
+    t = threading.Thread(target=watch, name=name, daemon=True)
+    t.start()
+    return t

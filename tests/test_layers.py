@@ -337,3 +337,52 @@ def test_batch_watchdog_flags_stuck_generation(tmp_path, caplog):
     release.set()
     layer.close()
     assert gauge.value() == 0.0
+
+
+def test_speed_watchdog_flags_stuck_batch(tmp_path, caplog):
+    """The speed tier mirrors the batch-layer wedge contract: a micro-batch
+    stuck past its limit is loudly reported and the running gauge exposes
+    the elapsed time."""
+    import logging as _logging
+    import threading as _threading
+
+    from oryx_tpu.api import SpeedModelManager
+    from oryx_tpu.common.metrics import get_registry
+
+    release = _threading.Event()
+
+    class StuckManager(SpeedModelManager):
+        def consume(self, it):
+            for _ in it:
+                pass
+
+        def build_updates(self, batch):
+            release.wait(timeout=30)
+            return []
+
+    cfg = load_config(overlay={
+        "oryx.id": "swdog",
+        "oryx.input-topic.broker": "mem://swdog",
+        "oryx.update-topic.broker": "mem://swdog",
+        "oryx.speed.streaming.generation-interval-sec": 1,
+    })
+    topics.maybe_create("mem://swdog", "OryxInput", partitions=1)
+    topics.maybe_create("mem://swdog", "OryxUpdate", partitions=1)
+    layer = SpeedLayer(cfg, manager=StuckManager())
+    layer.watchdog_limit_sec = 0.3
+    layer.watchdog_poll_sec = 0.1
+    layer.start()
+    TopicProducer(get_broker("mem://swdog"), "OryxInput").send("k", "v")
+
+    gauge = get_registry().gauge("oryx_speed_batch_running_seconds", "")
+    with caplog.at_level(_logging.ERROR, logger="oryx_tpu.layers.speed"):
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if any("wedged" in r.message for r in caplog.records):
+                break
+            time.sleep(0.05)
+    assert any("wedged" in r.message for r in caplog.records), "no watchdog log"
+    assert gauge.value() > 0.3
+    release.set()
+    layer.close()
+    assert gauge.value() == 0.0
