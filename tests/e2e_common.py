@@ -13,3 +13,26 @@ def http_request(method, url, body=None, accept="application/json"):
             return resp.status, resp.read().decode()
     except urllib.error.HTTPError as e:
         return e.code, e.read().decode()
+
+
+class WedgeHook:
+    """Monkeypatch target simulating a wedged device transport: blocks
+    topk_dot_batch until released, then delegates to the real kernel.
+
+    block_first_only=True blocks just the first call (a transient wedge);
+    False blocks every call until release (a dead transport)."""
+
+    def __init__(self, real_fn, block_first_only=True, timeout=30):
+        import threading
+
+        self.release = threading.Event()
+        self.calls = 0
+        self._real = real_fn
+        self._first_only = block_first_only
+        self._timeout = timeout
+
+    def __call__(self, xs, y, k):
+        self.calls += 1
+        if (self.calls == 1 or not self._first_only) and not self.release.is_set():
+            self.release.wait(timeout=self._timeout)
+        return self._real(xs, y, k=k)

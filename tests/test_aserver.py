@@ -324,3 +324,99 @@ def test_recommend_dispatch_is_deferred():
                 {"accept": "application/json"})
     )
     assert status2 == 200 and json.loads(body2) == json.loads(body)
+
+
+def test_wedge_failover_under_concurrent_http_load(monkeypatch):
+    """32 concurrent /recommend requests parked on a wedged device must ALL
+    be drained to host scoring by the watchdog (concurrent drain path) and
+    the server must keep serving degraded — through the real async
+    frontend, not the batcher API."""
+    import http.client
+    import threading as _threading
+    import time as _time
+
+    import numpy as np
+
+    import oryx_tpu.ops.als as als_mod
+    from oryx_tpu.apps.als.serving import ALSServingModel, ALSServingModelManager
+    from oryx_tpu.apps.als.state import ALSState
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.serving.batcher import TopKBatcher
+    from oryx_tpu.serving.server import ServingLayer
+
+    rng = np.random.default_rng(0)
+    state = ALSState(8, implicit=True)
+    state.y.bulk_set(
+        [f"i{j}" for j in range(300)], rng.standard_normal((300, 8), dtype=np.float32)
+    )
+    state.x.bulk_set(
+        [f"u{j}" for j in range(40)], rng.standard_normal((40, 8), dtype=np.float32)
+    )
+    state.set_expected(state.x.ids(), state.y.ids())
+    cfg = load_config(overlay={
+        "oryx.id": "chaos",
+        "oryx.input-topic.broker": "mem://chaos",
+        "oryx.update-topic.broker": "mem://chaos",
+        "oryx.serving.api.port": 0,
+        "oryx.serving.api.read-only": True,
+        "oryx.serving.application-resources": [
+            "oryx_tpu.serving.resources.common",
+            "oryx_tpu.serving.resources.als",
+        ],
+    })
+    from oryx_tpu.bus.broker import topics
+
+    topics.maybe_create("mem://chaos", "OryxUpdate", partitions=1)
+    mgr = ALSServingModelManager(cfg)
+    mgr.model = ALSServingModel(state, sample_rate=1.0)
+    serving = ServingLayer(cfg, model_manager=mgr)
+    serving.start()
+    try:
+        from e2e_common import WedgeHook
+
+        b = TopKBatcher.shared()
+        b.device_timeout, b.probe_interval = 1.0, 600.0  # no recovery mid-test
+
+        hook = WedgeHook(als_mod.topk_dot_batch, block_first_only=False, timeout=60)
+        monkeypatch.setattr(als_mod, "topk_dot_batch", hook)
+
+        results = [None] * 32
+
+        def client(i):
+            conn = http.client.HTTPConnection("127.0.0.1", serving.port, timeout=60)
+            conn.request("GET", f"/recommend/u{i}?howMany=5")
+            r = conn.getresponse()
+            body = r.read()
+            results[i] = (r.status, body)
+            conn.close()
+
+        threads = [_threading.Thread(target=client, args=(i,)) for i in range(32)]
+        t0 = _time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        dt = _time.time() - t0
+        assert all(r is not None and r[0] == 200 for r in results), [
+            r[0] if r else None for r in results
+        ]
+        assert dt < 25, f"drain took {dt:.1f}s"
+        assert b.device_failovers >= 1
+        assert b.host_fallbacks >= 1
+        # degraded path still serves new traffic
+        conn = http.client.HTTPConnection("127.0.0.1", serving.port, timeout=30)
+        conn.request("GET", "/recommend/u0?howMany=3")
+        r = conn.getresponse()
+        assert r.status == 200 and r.read()
+        conn.close()
+    finally:
+        # ALWAYS unblock the wedged dispatcher and shut the batcher down —
+        # an assertion failure above must not leak a spinning watchdog or
+        # a thread parked in the hook for the rest of the session
+        try:
+            hook.release.set()
+        except NameError:
+            pass
+        serving.close()
+        b.close()
+        TopKBatcher._shared = None

@@ -118,20 +118,11 @@ def test_shared_is_singleton():
 
 
 from oryx_tpu.ops.als import topk_dot_batch as _real_topk_dot_batch
+from e2e_common import WedgeHook
 
 
-class _WedgeHook:
-    """Monkeypatch target making topk_dot_batch block until released."""
-
-    def __init__(self):
-        self.release = threading.Event()
-        self.calls = 0
-
-    def __call__(self, xs, y, k):
-        self.calls += 1
-        if self.calls == 1:
-            self.release.wait(timeout=30)
-        return _real_topk_dot_batch(xs, y, k=k)
+def _WedgeHook():
+    return WedgeHook(_real_topk_dot_batch, block_first_only=True)
 
 
 def _host_mat(y):
