@@ -1,18 +1,26 @@
-"""Attention with ring sequence parallelism over the device mesh.
+"""Sequence-parallel attention over the device mesh: ring and all-to-all.
 
 The reference has no sequence models (SURVEY.md §5 "long-context ...
 absent"), but long-context support is a first-class capability of this
-framework: sequences too long for one chip's HBM are sharded over the mesh
-"data" axis and attended with a ring schedule — each device keeps its Q
-shard resident, streams K/V shards around the ring with lax.ppermute
-(neighbor exchanges over ICI, never a full all-gather), and folds each
-block in with the online-softmax (flash-attention) rescaling, so the full
-[S, S] score matrix never exists and K/V memory per chip stays S/n.
+framework. Two schedules, both sharding the sequence over the mesh "data"
+axis:
 
-Single-device `attention` is the exact reference implementation the ring
-is tested against; both support causal masking (the ring variant masks by
-global chunk position, skipping fully-masked blocks' contributions via
-where-masking so every device still executes the same program).
+- ``ring_attention``: each device keeps its Q shard resident and streams
+  K/V shards around the ring with lax.ppermute (neighbor exchanges over
+  ICI, never a full all-gather), folding blocks in with online-softmax
+  (flash-attention) rescaling — the full [S, S] score matrix never
+  exists and K/V memory per chip stays S/n. Best when S is the scarce
+  resource and head count is small.
+- ``ulysses_attention`` (DeepSpeed-Ulysses style): one all-to-all swaps
+  the sharded axis from sequence to heads (each device then holds H/n
+  full-sequence heads), attention runs locally and exactly, and a second
+  all-to-all swaps back. Two collectives total instead of n ring steps —
+  cheaper when H >= n and per-head attention fits on a chip.
+
+Single-device ``attention`` is the exact reference implementation both
+are tested against; all support causal masking (the ring variant masks by
+global chunk position via where-masking so every device still executes
+the same program).
 """
 
 from __future__ import annotations
@@ -103,6 +111,64 @@ def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = False):
     fn = jax.jit(
         jax.shard_map(
             body,
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+    )
+    sharding = NamedSharding(mesh, spec)
+    return fn(
+        jax.device_put(q, sharding),
+        jax.device_put(k, sharding),
+        jax.device_put(v, sharding),
+    )
+
+
+def _ulysses_local(q, k, v, *, causal: bool, axis_name: str):
+    """Per-device body under shard_map. q,k,v: [..., H, Sq_local, D]."""
+    h_ax, s_ax = q.ndim - 3, q.ndim - 2
+    # sequence-sharded -> head-sharded: [..., H, S/n, D] -> [..., H/n, S, D]
+    swap = lambda x: jax.lax.all_to_all(
+        x, axis_name, split_axis=h_ax, concat_axis=s_ax, tiled=True
+    )
+    o = attention(swap(q), swap(k), swap(v), causal=causal)
+    # head-sharded -> sequence-sharded
+    return jax.lax.all_to_all(
+        o, axis_name, split_axis=s_ax, concat_axis=h_ax, tiled=True
+    )
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, *, causal: bool = False):
+    """All-to-all sequence-parallel attention: [..., H, S, D] arrays with S
+    sharded over the mesh data axis and H divisible by the axis size. Two
+    all-to-alls re-shard sequence->heads and back; attention itself runs
+    locally and EXACTLY per head. Returns [..., H, S, D] sharded like q."""
+    n_shards = mesh.shape[DATA_AXIS]
+    if q.ndim < 3:
+        raise ValueError("ulysses_attention needs [..., H, S, D] inputs")
+    # validate q AND k (cross-attention may use a different S_k; GQA-style
+    # mismatched head counts are not supported by the all-to-all re-shard)
+    if k.shape[-3] != q.shape[-3]:
+        raise ValueError(
+            f"k head count {k.shape[-3]} must equal q's {q.shape[-3]}"
+        )
+    for name, arr in (("q", q), ("k", k)):
+        h, s = arr.shape[-3], arr.shape[-2]
+        if h % n_shards:
+            raise ValueError(
+                f"{name} head count {h} must divide the {n_shards}-way "
+                f"'{DATA_AXIS}' axis (use ring_attention when heads are scarce)"
+            )
+        if s % n_shards:
+            raise ValueError(
+                f"{name} sequence length {s} must divide the {n_shards}-way "
+                f"'{DATA_AXIS}' axis"
+            )
+    spec = P(*([None] * (q.ndim - 2)), DATA_AXIS, None)
+    fn = jax.jit(
+        jax.shard_map(
+            partial(_ulysses_local, causal=causal, axis_name=DATA_AXIS),
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,

@@ -371,12 +371,12 @@ def test_wedge_failover_under_concurrent_http_load(monkeypatch):
     mgr.model = ALSServingModel(state, sample_rate=1.0)
     serving = ServingLayer(cfg, model_manager=mgr)
     serving.start()
+    from e2e_common import WedgeHook
+
+    b = TopKBatcher.shared()
+    hook = None
     try:
-        from e2e_common import WedgeHook
-
-        b = TopKBatcher.shared()
         b.device_timeout, b.probe_interval = 1.0, 600.0  # no recovery mid-test
-
         hook = WedgeHook(als_mod.topk_dot_batch, block_first_only=False, timeout=60)
         monkeypatch.setattr(als_mod, "topk_dot_batch", hook)
 
@@ -412,11 +412,14 @@ def test_wedge_failover_under_concurrent_http_load(monkeypatch):
     finally:
         # ALWAYS unblock the wedged dispatcher and shut the batcher down —
         # an assertion failure above must not leak a spinning watchdog or
-        # a thread parked in the hook for the rest of the session
-        try:
+        # a thread parked in the hook for the rest of the session; each
+        # teardown step runs even if an earlier one raises
+        if hook is not None:
             hook.release.set()
-        except NameError:
-            pass
-        serving.close()
-        b.close()
-        TopKBatcher._shared = None
+        try:
+            serving.close()
+        finally:
+            try:
+                b.close()
+            finally:
+                TopKBatcher._shared = None

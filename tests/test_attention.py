@@ -4,6 +4,7 @@ on the virtual 8-device CPU mesh (conftest)."""
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -64,3 +65,64 @@ def test_causal_first_token_attends_only_itself():
     v = rng.standard_normal((s, d)).astype(np.float32)
     out = ring_attention(q, k, v, _mesh(2), causal=True)
     np.testing.assert_allclose(np.asarray(out)[0], v[0], atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# all-to-all (Ulysses) sequence parallelism
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("n_shards", [2, 4, 8])
+def test_ulysses_matches_exact(causal, n_shards):
+    from oryx_tpu.ops.attention import ulysses_attention
+
+    rng = np.random.default_rng(7)
+    b, h, s, d = 2, 8, 32, 4
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(b, h, s, d)), dtype=jnp.float32)
+        for _ in range(3)
+    )
+    out = ulysses_attention(q, k, v, _mesh(n_shards), causal=causal)
+    ref = attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_matches_ring():
+    """The two sequence-parallel schedules agree with each other (and the
+    exact path) on the same inputs."""
+    from oryx_tpu.ops.attention import ring_attention, ulysses_attention
+
+    rng = np.random.default_rng(8)
+    b, h, s, d = 1, 8, 64, 8
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(b, h, s, d)), dtype=jnp.float32)
+        for _ in range(3)
+    )
+    mesh = _mesh(4)
+    out_u = ulysses_attention(q, k, v, mesh, causal=True)
+    out_r = ring_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out_u), np.asarray(out_r), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from oryx_tpu.ops.attention import ulysses_attention
+
+    rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.normal(size=(3, 16, 4)), dtype=jnp.float32)  # H=3
+    with pytest.raises(ValueError, match="head count"):
+        ulysses_attention(q, q, q, _mesh(2), causal=False)
+
+
+def test_ulysses_keeps_sequence_sharding():
+    from oryx_tpu.ops.attention import ulysses_attention
+    from oryx_tpu.parallel.mesh import DATA_AXIS
+
+    rng = np.random.default_rng(10)
+    q = jnp.asarray(rng.normal(size=(4, 16, 4)), dtype=jnp.float32)
+    mesh = _mesh(4)
+    out = ulysses_attention(q, q, q, mesh, causal=False)
+    spec = out.sharding.spec
+    assert spec[-2] == DATA_AXIS  # sequence axis stays sharded
