@@ -99,7 +99,7 @@ def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = False):
     n_shards = mesh.shape[DATA_AXIS]
     if q.shape[-2] % n_shards or k.shape[-2] % n_shards:
         raise ValueError(
-            f"sequence length {q.shape[-2]} must divide the {n_shards}-way "
+            f"sequence length {q.shape[-2]} must be divisible by the {n_shards}-way "
             f"'{DATA_AXIS}' axis"
         )
     spec = P(*([None] * (q.ndim - 2)), DATA_AXIS, None)
@@ -157,12 +157,12 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, causal: bool = False):
         h, s = arr.shape[-3], arr.shape[-2]
         if h % n_shards:
             raise ValueError(
-                f"{name} head count {h} must divide the {n_shards}-way "
+                f"{name} head count {h} must be divisible by the {n_shards}-way "
                 f"'{DATA_AXIS}' axis (use ring_attention when heads are scarce)"
             )
         if s % n_shards:
             raise ValueError(
-                f"{name} sequence length {s} must divide the {n_shards}-way "
+                f"{name} sequence length {s} must be divisible by the {n_shards}-way "
                 f"'{DATA_AXIS}' axis"
             )
     spec = P(*([None] * (q.ndim - 2)), DATA_AXIS, None)
