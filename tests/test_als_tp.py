@@ -18,7 +18,6 @@ from oryx_tpu.ops.als import (
     _row_pad,
 )
 from oryx_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, MeshSpec, make_mesh
-from oryx_tpu.common.rng import RandomManager
 
 
 def _synth(n_users=60, n_items=40, nnz=600, seed=5):

@@ -130,8 +130,6 @@ def _host_mat(y):
 
 
 def test_wedged_dispatch_fails_over_to_host(y, monkeypatch):
-    import oryx_tpu.serving.batcher as bmod
-
     hook = _WedgeHook()
     monkeypatch.setattr(
         "oryx_tpu.ops.als.topk_dot_batch", hook, raising=True

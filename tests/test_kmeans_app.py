@@ -15,7 +15,7 @@ from oryx_tpu.apps.kmeans import (
     KMeansSpeedModelManager,
     KMeansUpdate,
 )
-from oryx_tpu.bus.api import KeyMessage, TopicProducer
+from oryx_tpu.bus.api import KeyMessage
 from oryx_tpu.bus.broker import get_broker, topics
 from oryx_tpu.bus.inproc import InProcBroker
 from oryx_tpu.common.config import load_config

@@ -2,8 +2,6 @@
 MLUpdate generation loop with a mock update (the MockMLUpdate pattern from
 the reference's SimpleMLUpdateIT — SURVEY.md §4)."""
 
-import json
-from pathlib import Path
 
 import numpy as np
 import pytest

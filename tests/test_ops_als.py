@@ -21,7 +21,6 @@ from oryx_tpu.ops import (
     random_unit_vectors,
 )
 from oryx_tpu.ops.als import (
-    InteractionData,
     aggregate_interactions,
     build_padded_lists,
     compute_target_qui,
