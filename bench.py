@@ -36,18 +36,9 @@ def _enable_compile_cache() -> None:
     later rounds on the same checkout) skip the tens-of-seconds cold
     compiles of the training scan and serving kernels."""
     try:
-        from oryx_tpu.common.config import load_config
-        from oryx_tpu.parallel.distributed import configure_compilation_cache
+        from oryx_tpu.parallel.distributed import enable_repo_compile_cache
 
-        configure_compilation_cache(
-            load_config(
-                overlay={
-                    "oryx.compute.compilation-cache-dir": os.path.join(
-                        HERE, ".jax_cache"
-                    )
-                }
-            )
-        )
+        enable_repo_compile_cache(HERE)
     except Exception as e:  # noqa: BLE001 - cache is an optimization only
         print(f"compile cache unavailable: {e}", file=sys.stderr)
 

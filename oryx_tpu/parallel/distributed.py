@@ -53,6 +53,26 @@ class DistributedConfig:
         return self.num_processes > 1 or self.coordinator_address is not None
 
 
+def enable_repo_compile_cache(base_dir: str) -> bool:
+    """Point the persistent compile cache at <base_dir>/.jax_cache —
+    the shared helper behind the benchmark's and the multichip dryrun's
+    repeat-run warm compiles. Returns False (never raises) when the cache
+    cannot be configured: it is an optimization only."""
+    import os
+
+    try:
+        from oryx_tpu.common.config import load_config
+
+        return configure_compilation_cache(load_config(overlay={
+            "oryx.compute.compilation-cache-dir": os.path.join(
+                base_dir, ".jax_cache"
+            )
+        }))
+    except Exception:  # noqa: BLE001 - never fail the caller over a cache
+        log.info("compile cache unavailable", exc_info=True)
+        return False
+
+
 def configure_compilation_cache(config: Config) -> bool:
     """Point JAX's persistent compilation cache at
     oryx.compute.compilation-cache-dir (off when empty/null). Cold XLA
