@@ -38,7 +38,8 @@ def _enable_compile_cache() -> None:
     try:
         from oryx_tpu.parallel.distributed import enable_repo_compile_cache
 
-        enable_repo_compile_cache(HERE)
+        if not enable_repo_compile_cache(HERE):
+            print("compile cache unavailable (see helper log)", file=sys.stderr)
     except Exception as e:  # noqa: BLE001 - cache is an optimization only
         print(f"compile cache unavailable: {e}", file=sys.stderr)
 

@@ -28,8 +28,7 @@ from oryx_tpu.ops.als import aggregate_interactions, train_als
 from oryx_tpu.apps.als.common import (
     ALSConfig,
     parse_events,
-    x_update_message,
-    y_update_message,
+    batch_update_messages,
 )
 
 log = logging.getLogger(__name__)
@@ -198,10 +197,22 @@ class ALSUpdate(MLUpdate):
         yids = model.get_extension_list("YIDs")
         x, y = model.tensors["X"], model.tensors["Y"]
         known = model.content.get("knownItems", {})
-        producer.send_batch(
-            y_update_message(iid, y[j]) for j, iid in enumerate(yids)
-        )
-        producer.send_batch(
-            x_update_message(uid, x[j], known.get(uid, [])) for j, uid in enumerate(xids)
-        )
+
+        def chunks(kind, ids, mat, known_of=None):
+            # batched message building (one C-encoder pass per chunk), in
+            # bounded chunks so a million-row flood never materializes one
+            # multi-hundred-MB JSON blob
+            step = 8192
+            for lo in range(0, len(ids), step):
+                part = ids[lo : lo + step]
+                yield from batch_update_messages(
+                    kind, part, mat[lo : lo + len(part)],
+                    known_lists=(
+                        [known_of.get(i, []) for i in part]
+                        if known_of is not None else None
+                    ),
+                )
+
+        producer.send_batch(chunks("Y", yids, y))
+        producer.send_batch(chunks("X", xids, x, known))
         log.info("published %d Y and %d X factor rows", len(yids), len(xids))

@@ -84,6 +84,21 @@ class FactorStore:
             row = self._ids.get(ident)
             return None if row is None else self._arena[row].copy()
 
+    def get_many(self, idents) -> tuple[np.ndarray, np.ndarray]:
+        """([n,K] matrix, [n] present mask) under ONE read lock — absent
+        ids yield zero rows. The speed tier gathers whole micro-batches
+        this way; per-id get() would take the lock per message."""
+        with self._lock.read():
+            rows = np.fromiter(
+                (self._ids.get(i, -1) for i in idents), dtype=np.int64,
+                count=len(idents),
+            )
+            present = rows >= 0
+            out = np.zeros((len(idents), self.features), dtype=np.float32)
+            if present.any():
+                out[present] = self._arena[rows[present]]
+            return out, present
+
     def __contains__(self, ident: str) -> bool:
         with self._lock.read():
             return ident in self._ids

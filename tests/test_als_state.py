@@ -202,3 +202,26 @@ def test_nested_rescorer_query_does_not_deadlock_post_pool():
     finally:
         srv._POST_POOL.shutdown(wait=False)
         srv._POST_POOL = old
+
+
+def test_batch_update_messages_byte_parity():
+    """The batched UP-message builder must produce byte-identical payloads
+    to the single-message path (the bus is a wire format; two encoders
+    must not drift)."""
+    from oryx_tpu.apps.als.common import (
+        batch_update_messages,
+        x_update_message,
+        y_update_message,
+    )
+
+    rng = np.random.default_rng(12)
+    v = rng.standard_normal((5, 7)) * np.array([1e-8, 1e-3, 1.0, 1e3, 1e7])[:, None]
+    ids = [f"u{j}" for j in range(5)]
+    known = [[f"i{j}", "i0"] for j in range(5)]
+    assert batch_update_messages("X", ids, v, known) == [
+        x_update_message(ids[j], v[j], known[j]) for j in range(5)
+    ]
+    assert batch_update_messages("Y", ids, v) == [
+        y_update_message(ids[j], v[j]) for j in range(5)
+    ]
+    assert batch_update_messages("X", [], np.zeros((0, 3))) == []
