@@ -203,15 +203,25 @@ class ALSUpdate(MLUpdate):
             # bounded chunks so a million-row flood never materializes one
             # multi-hundred-MB JSON blob
             step = 8192
+            dropped = 0
             for lo in range(0, len(ids), step):
                 part = ids[lo : lo + step]
+                block = mat[lo : lo + len(part)]
+                finite = np.isfinite(block).all(axis=1)
+                if not finite.all():  # builder contract: NaN is not JSON
+                    dropped += int((~finite).sum())
+                    rows = np.nonzero(finite)[0]
+                    part = [part[j] for j in rows]
+                    block = block[rows]
                 yield from batch_update_messages(
-                    kind, part, mat[lo : lo + len(part)],
+                    kind, part, block,
                     known_lists=(
                         [known_of.get(i, []) for i in part]
                         if known_of is not None else None
                     ),
                 )
+            if dropped:
+                log.warning("dropped %d non-finite %s factor rows at publish", dropped, kind)
 
         producer.send_batch(chunks("Y", yids, y))
         producer.send_batch(chunks("X", xids, x, known))

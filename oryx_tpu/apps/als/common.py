@@ -33,6 +33,7 @@ class ALSConfig:
     alpha: object
     iterations: int
     sample_rate: float
+    approx_recall: float
     compute_dtype: str
     checkpoint_interval: int
 
@@ -51,9 +52,19 @@ class ALSConfig:
             alpha=g("hyperparams.alpha", 1.0),
             iterations=int(g("hyperparams.iterations", 10)),
             sample_rate=float(g("sample-rate", 1.0)),
+            approx_recall=_valid_recall(float(g("approx-recall", 1.0))),
             compute_dtype=_valid_compute_dtype(str(g("compute-dtype", "float32"))),
             checkpoint_interval=int(g("checkpoint-interval", 0)),
         )
+
+
+def _valid_recall(value: float) -> float:
+    """Fail at config load, not on the first /recommend request."""
+    if not (0.0 < value <= 1.0):
+        raise ValueError(
+            f"oryx.als.approx-recall must be in (0, 1], got {value!r}"
+        )
+    return value
 
 
 def _valid_compute_dtype(value: str) -> str:

@@ -64,8 +64,18 @@ def _post_pool():
 
 
 class ALSServingModel(ServingModel):
-    def __init__(self, state: ALSState, sample_rate: float = 1.0, num_cores: int | None = None):
+    def __init__(
+        self,
+        state: ALSState,
+        sample_rate: float = 1.0,
+        num_cores: int | None = None,
+        approx_recall: float = 1.0,
+    ):
         self.state = state
+        # < 1.0: serve via the on-device approximate top-k (the TPU
+        # replacement for the reference's LSH sampling); the exact f32
+        # re-rank still runs over the returned candidates
+        self.approx_recall = approx_recall
         # (device matrix, ids, version) swapped as ONE tuple: readers always
         # see a matched pair, no lock on the read path
         self._device_view: tuple | None = None
@@ -224,7 +234,7 @@ class ALSServingModel(ServingModel):
         # scores on the host if the accelerator transport hangs
         fut = TopKBatcher.shared().submit_nowait(
             user_vector, k, y, host_mat=host_mat, cosine=cosine,
-            host_norms=host_norms,
+            host_norms=host_norms, recall=self.approx_recall,
         )
 
         def _post(result):
@@ -432,7 +442,10 @@ class ALSServingModelManager(AbstractServingModelManager):
         prev = self.model.state if self.model is not None else None
         state = apply_update_message(prev, key, message, with_known_items=True)
         if state is not None and state is not prev:
-            self.model = ALSServingModel(state, sample_rate=self.als.sample_rate)
+            self.model = ALSServingModel(
+                state, sample_rate=self.als.sample_rate,
+                approx_recall=self.als.approx_recall,
+            )
 
 
 def _load_rescorer_provider(config: Config):

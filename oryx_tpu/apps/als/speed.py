@@ -73,7 +73,7 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
         # absent (new) entities
         uids = [agg.user_ids[u] for u in agg.users]
         iids = [agg.item_ids[i] for i in agg.items]
-        xu, _have_x_row = st.x.get_many(uids)
+        xu, have_x = st.x.get_many(uids)
         yi, have_y = st.y.get_many(iids)
 
         out: list[tuple[str, str]] = []
@@ -94,7 +94,6 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
                 known_lists=[[iids[j]] for j in rows],
             ))
         chol_x = st.xtx.get()
-        have_x = np.any(xu != 0.0, axis=1)
         if chol_x is not None and have_x.any():
             new_yi = np.asarray(fold(chol_x, vals32, yi, xu))
             emit = have_x & np.isfinite(new_yi).all(axis=1)

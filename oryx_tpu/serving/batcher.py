@@ -101,9 +101,13 @@ def host_topk(
 
 
 class _Pending:
-    __slots__ = ("vec", "k", "y", "future", "host_mat", "cosine", "host_norms")
+    __slots__ = (
+        "vec", "k", "y", "future", "host_mat", "cosine", "host_norms",
+        "recall",
+    )
 
-    def __init__(self, vec, k, y, future, host_mat=None, cosine=False, host_norms=None):
+    def __init__(self, vec, k, y, future, host_mat=None, cosine=False,
+                 host_norms=None, recall=1.0):
         self.vec = vec
         self.k = k
         self.y = y
@@ -111,6 +115,7 @@ class _Pending:
         self.host_mat = host_mat
         self.cosine = cosine
         self.host_norms = host_norms
+        self.recall = recall
 
     def resolve_on_host(self, reason: Exception | None = None) -> bool:
         """Host-score this request. Returns True if a result was delivered,
@@ -220,16 +225,19 @@ class TopKBatcher:
         host_mat: np.ndarray | None = None,
         cosine: bool = False,
         host_norms: np.ndarray | None = None,
+        recall: float = 1.0,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Score vec against device matrix y, returning (values, indices)
         for the top-k rows. Blocks until the coalesced dispatch completes.
 
         host_mat (the row-aligned f32 host copy of y) enables degraded
         host-side scoring when the device transport is wedged; host_norms
-        caches its row norms for cosine fallbacks.
+        caches its row norms for cosine fallbacks. recall < 1 selects the
+        approximate device kernel (host fallback stays exact).
         """
         return self.submit_nowait(
-            vec, k, y, host_mat=host_mat, cosine=cosine, host_norms=host_norms
+            vec, k, y, host_mat=host_mat, cosine=cosine,
+            host_norms=host_norms, recall=recall,
         ).result()
 
     def submit_nowait(
@@ -240,13 +248,17 @@ class TopKBatcher:
         host_mat: np.ndarray | None = None,
         cosine: bool = False,
         host_norms: np.ndarray | None = None,
+        recall: float = 1.0,
     ) -> Future:
         """submit() without the wait: returns the Future of (values,
         indices). Deferred endpoints chain post-processing onto it instead
         of parking a worker thread per in-flight request."""
         vec = np.asarray(vec, dtype=np.float32)
         fut: Future = Future()
-        p = _Pending(vec, int(k), y, fut, host_mat, cosine, host_norms)
+        p = _Pending(
+            vec, int(k), y, fut, host_mat, cosine, host_norms,
+            float(recall),
+        )
         with self._cond:
             if self._closed:
                 raise RuntimeError("batcher is closed")
@@ -356,17 +368,17 @@ class TopKBatcher:
 
         from oryx_tpu.ops.als import topk_dot_batch
 
-        groups: dict[tuple[int, int], list[_Pending]] = {}
+        groups: dict[tuple[int, int, float], list[_Pending]] = {}
         for p in batch:
             n = p.y.shape[0]
             kb = min(k_bucket(p.k), n)
-            groups.setdefault((id(p.y), kb), []).append(p)
+            groups.setdefault((id(p.y), kb, p.recall), []).append(p)
 
         self.dispatches += len(groups)
         self.coalesced += len(batch)
 
         launched = []
-        for (_, kb), group in groups.items():
+        for (_, kb, recall), group in groups.items():
             # failures stay inside their group: a bad shape / OOM against
             # one target matrix must not fail requests scoring another
             try:
@@ -377,7 +389,9 @@ class TopKBatcher:
                 xs = np.zeros((padded, y.shape[1]), dtype=np.float32)
                 for i, p in enumerate(group):
                     xs[i] = p.vec
-                vals, idx = topk_dot_batch(jnp.asarray(xs), y, k=kb)
+                vals, idx = topk_dot_batch(
+                    jnp.asarray(xs), y, k=kb, recall=recall
+                )
                 try:
                     vals.copy_to_host_async()
                     idx.copy_to_host_async()

@@ -31,8 +31,8 @@ class WedgeHook:
         self._first_only = block_first_only
         self._timeout = timeout
 
-    def __call__(self, xs, y, k):
+    def __call__(self, xs, y, k, **kwargs):
         self.calls += 1
         if (self.calls == 1 or not self._first_only) and not self.release.is_set():
             self.release.wait(timeout=self._timeout)
-        return self._real(xs, y, k=k)
+        return self._real(xs, y, k=k, **kwargs)

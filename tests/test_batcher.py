@@ -196,3 +196,54 @@ def test_host_topk_cosine_matches_numpy(y):
     order = np.argsort(-ref)[:5]
     assert list(idx) == list(order)
     np.testing.assert_allclose(vals, ref[order], rtol=1e-5)
+
+
+def test_recall_groups_and_approx_path(y):
+    """Requests with different recall targets dispatch in separate groups,
+    and the approx path (exact on CPU) returns correct top-k."""
+    b = TopKBatcher()
+    vec = np.random.default_rng(6).normal(size=8).astype(np.float32)
+    reqs = [
+        _Pending(vec, 5, y, Future(), recall=1.0),
+        _Pending(vec, 5, y, Future(), recall=0.95),
+    ]
+    for item in b._launch(reqs):
+        b._resolve(item)
+    assert b.dispatches == 2  # split by recall
+    dvals, didx = _direct(vec, 5, y)
+    for p in reqs:
+        vals, idx = p.future.result(timeout=5)
+        assert list(idx) == list(didx)  # CPU approx_max_k is exact
+    b.close()
+
+
+def test_serving_model_approx_recall_wired():
+    """oryx.als.approx-recall reaches the model and the batcher dispatch."""
+    from oryx_tpu.apps.als.serving import ALSServingModel, ALSServingModelManager
+    from oryx_tpu.apps.als.state import ALSState
+    from oryx_tpu.common.config import load_config
+
+    import json
+
+    rng = np.random.default_rng(1)
+    cfg = load_config(overlay={"oryx.als.approx-recall": 0.9})
+    mgr = ALSServingModelManager(cfg)
+    # MODEL header then UP rows, as the update topic would deliver them:
+    # the MANAGER must construct its model with the configured recall
+    mgr.consume_key_message(
+        "MODEL",
+        json.dumps({"app": "als", "extensions": {"features": "4"}, "content": {}}),
+    )
+    mgr.consume_key_message("UP", json.dumps(["Y", "i0", [0.1, 0.2, 0.3, 0.4]]))
+    mgr.consume_key_message("UP", json.dumps(["Y", "i1", [0.4, 0.3, 0.2, 0.1]]))
+    mgr.consume_key_message("UP", json.dumps(["X", "u0", [1, 0, 0, 0]]))
+    assert mgr.model is not None
+    assert mgr.model.approx_recall == 0.9
+    out = mgr.model.top_n(np.ones(4, dtype=np.float32), 2)
+    assert len(out) == 2
+    # bad config fails at load, not at serve time
+    with pytest.raises(ValueError, match="approx-recall"):
+        load_config(overlay={"oryx.als.approx-recall": 0.0})
+        from oryx_tpu.apps.als.common import ALSConfig
+
+        ALSConfig.from_config(load_config(overlay={"oryx.als.approx-recall": 0.0}))
