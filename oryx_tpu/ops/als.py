@@ -1158,14 +1158,12 @@ def topk_dot_batch(xs, y, *, k: int, recall: float = 1.0):
     elsewhere. A kernel failure only disables that exact (shapes, k)
     signature — standard serving shapes keep the fast path."""
     n_items = y.shape[0]
-    if recall < 1.0:
-        if xs.dtype != y.dtype:
-            xs = jnp.asarray(xs, dtype=y.dtype)
-        return topk_dot_batch_approx(xs, y, k=k, recall=float(recall))
     if xs.dtype != y.dtype:
         # mixed-precision queries score in the matrix's dtype (the bf16
         # serving view); accumulation is f32 either way
         xs = jnp.asarray(xs, dtype=y.dtype)
+    if recall < 1.0:
+        return topk_dot_batch_approx(xs, y, k=k, recall=float(recall))
     sig = (xs.shape, y.shape, xs.dtype, y.dtype, k)
     if (
         k <= PALLAS_TOPK_MAX_K
