@@ -225,3 +225,20 @@ def test_batch_update_messages_byte_parity():
         y_update_message(ids[j], v[j]) for j in range(5)
     ]
     assert batch_update_messages("X", [], np.zeros((0, 3))) == []
+
+
+def test_factor_store_get_many_matches_get():
+    from oryx_tpu.apps.als.state import ALSState
+
+    rng = np.random.default_rng(4)
+    st = ALSState(3, implicit=True)
+    st.x.bulk_set(["a", "b", "c"], rng.standard_normal((3, 3), dtype=np.float32))
+    mat, present = st.x.get_many(["b", "nope", "a", "b"])
+    assert present.tolist() == [True, False, True, True]
+    np.testing.assert_array_equal(mat[0], st.x.get("b"))
+    np.testing.assert_array_equal(mat[2], st.x.get("a"))
+    np.testing.assert_array_equal(mat[3], st.x.get("b"))
+    np.testing.assert_array_equal(mat[1], np.zeros(3, dtype=np.float32))
+    # empty input
+    mat, present = st.x.get_many([])
+    assert mat.shape == (0, 3) and present.shape == (0,)
