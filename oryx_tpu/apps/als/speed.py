@@ -99,6 +99,11 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
             emit = have_x & np.isfinite(new_yi).all(axis=1)
             rows = np.nonzero(emit)[0]
             out.extend(batch_update_messages(
-                "Y", [iids[j] for j in rows], new_yi[rows]
+                "Y", [iids[j] for j in rows], new_yi[rows],
+                # the reference's Y fold-in message carries the interacting
+                # user as element 4 (["Y",item,vec,[user]],
+                # ALSSpeedModelManager.java:198-220) — kept for wire parity
+                # with reference consumers; ours ignore it for Y
+                known_lists=[[uids[j]] for j in rows],
             ))
         return out

@@ -11,6 +11,44 @@ from oryx_tpu.common.metrics import get_registry
 from oryx_tpu.serving.app import OryxServingException, RawResponse, Request, ServingApp
 
 
+def _ingest_text(req: Request) -> str:
+    """Body text for /ingest: plain text (frontends already undo
+    Content-Encoding: gzip), or every file part of a multipart/form-data
+    upload — parity with the reference's AbstractOryxResource
+    maybeBuffer/maybeDecompress upload handling, which accepts browser
+    form posts of (optionally gzipped) data files."""
+    ctype = req.headers.get("content-type", "")
+    if not ctype.lower().startswith("multipart/form-data"):
+        return req.body_text()
+    import gzip
+    from email import policy
+    from email.parser import BytesParser
+
+    # reuse the stdlib MIME parser by re-wrapping the body with its header
+    raw = (f"Content-Type: {ctype}\r\n\r\n").encode("latin-1") + req.body
+    msg = BytesParser(policy=policy.default).parsebytes(raw)
+    parts = []
+    for part in msg.iter_parts():
+        name = (part.get_filename() or "").lower()
+        if not name:
+            # ordinary form fields (hidden tokens, submit values) are not
+            # data: only FILE parts ingest, like the reference's FileItem
+            # handling
+            continue
+        payload = part.get_payload(decode=True)
+        if payload is None:
+            continue
+        if name.endswith(".gz") or payload[:2] == b"\x1f\x8b":
+            try:
+                payload = gzip.decompress(payload)
+            except (OSError, EOFError):  # EOFError: truncated stream
+                raise OryxServingException(400, f"bad gzip upload: {name}")
+        parts.append(payload.decode("utf-8", errors="replace"))
+    if not parts:
+        raise OryxServingException(400, "no file parts in multipart upload")
+    return "\n".join(parts)
+
+
 def send_input_lines(
     app: ServingApp, text: str, what: str = "data points", required: bool = True
 ) -> int:
@@ -41,7 +79,7 @@ def register(app: ServingApp) -> None:
 
     @app.route("POST", "/ingest")
     def ingest(a: ServingApp, req: Request):
-        n = send_input_lines(a, req.body_text(), "ingest body")
+        n = send_input_lines(a, _ingest_text(req), "ingest body")
         return 200, {"ingested": n}
 
     if app.config.get_bool("oryx.monitoring.metrics", True):

@@ -132,3 +132,99 @@ def test_tls_termination(tmp_path):
         ) as r:
             assert r.status == 200
             assert json.loads(r.read())["word"] == 2
+
+
+def test_ingest_multipart_upload(tmp_path):
+    """/ingest accepts multipart/form-data file uploads, including gzipped
+    parts (reference AbstractOryxResource upload handling)."""
+    import gzip
+
+    from oryx_tpu.api import ServingModelManager
+    from oryx_tpu.bus.broker import get_broker, topics
+    from oryx_tpu.bus.inproc import InProcBroker
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.serving.app import Request, ServingApp
+
+    InProcBroker.reset_all()
+    topics.maybe_create("mem://mp", "OryxInput", partitions=1)
+
+    class Manager(ServingModelManager):
+        def __init__(self, config):
+            self.config = config
+
+        def consume(self, it):
+            pass
+
+        def get_model(self):
+            return None
+
+    cfg = load_config(overlay={
+        "oryx.id": "mp",
+        "oryx.input-topic.broker": "mem://mp",
+        "oryx.update-topic.broker": "mem://mp",
+        "oryx.serving.application-resources": ["oryx_tpu.serving.resources.common"],
+    })
+    from oryx_tpu.bus.api import TopicProducer
+
+    app = ServingApp(cfg, Manager(cfg), TopicProducer(get_broker("mem://mp"), "OryxInput"))
+
+    boundary = "XbOuNdArYx"
+    plain = b"u1,i1,1\nu2,i2,1"
+    gzipped = gzip.compress(b"u3,i3,1")
+    body = (
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="f1"; filename="a.csv"\r\n'
+        "Content-Type: text/csv\r\n\r\n"
+    ).encode() + plain + (
+        f"\r\n--{boundary}\r\n"
+        'Content-Disposition: form-data; name="f2"; filename="b.csv.gz"\r\n'
+        "Content-Type: application/octet-stream\r\n\r\n"
+    ).encode() + gzipped + f"\r\n--{boundary}--\r\n".encode()
+
+    import json
+
+    status, resp, _ = app.dispatch(Request(
+        "POST", "/ingest", {}, {}, body,
+        {"accept": "application/json",
+         "content-type": f"multipart/form-data; boundary={boundary}"},
+    ))
+    assert status == 200, resp
+    assert json.loads(resp)["ingested"] == 3
+    recs = get_broker("mem://mp").read("OryxInput", 0, 0, 10)
+    assert {m for _, _, m in recs} == {"u1,i1,1", "u2,i2,1", "u3,i3,1"}
+    # a plain form field (no filename) must NOT become a data record,
+    # and a truncated gzip part is a 400, not a 500
+    body2 = (
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="token"\r\n\r\n'
+        "notdata\r\n"
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="f"; filename="c.csv"\r\n\r\n'
+        "u4,i4,1\r\n"
+        f"--{boundary}--\r\n"
+    ).encode()
+    status, resp, _ = app.dispatch(Request(
+        "POST", "/ingest", {}, {}, body2,
+        {"accept": "application/json",
+         "content-type": f"multipart/form-data; boundary={boundary}"},
+    ))
+    assert status == 200 and json.loads(resp)["ingested"] == 1
+    trunc = (
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="f"; filename="d.csv.gz"\r\n\r\n'
+    ).encode() + gzip.compress(b"u5,i5,1")[:-4] + f"\r\n--{boundary}--\r\n".encode()
+    status, _, _ = app.dispatch(Request(
+        "POST", "/ingest", {}, {}, trunc,
+        {"accept": "application/json",
+         "content-type": f"multipart/form-data; boundary={boundary}"},
+    ))
+    assert status == 400
+
+    # garbage multipart -> 400
+    status, _, _ = app.dispatch(Request(
+        "POST", "/ingest", {}, {}, b"--x--",
+        {"accept": "application/json",
+         "content-type": "multipart/form-data; boundary=x"},
+    ))
+    assert status == 400
+    InProcBroker.reset_all()
