@@ -35,7 +35,7 @@ def _parse_args(argv):
         "command",
         choices=[
             "batch", "speed", "serving", "setup", "tail", "input",
-            "import-pmml", "loadtest",
+            "import-pmml", "loadtest", "config",
         ],
     )
     p.add_argument("--conf", help="user config file (HOCON-like key paths)")
@@ -216,6 +216,22 @@ def _run_until_interrupt(layer) -> int:
     finally:
         layer.close()
         signal.signal(signal.SIGTERM, stop)
+    return 0
+
+
+def cmd_config(config: Config) -> int:
+    """Print the EFFECTIVE config (defaults + user file + overrides) as
+    flattened key=value lines — the reference's ConfigToProperties surface
+    (deploy/bin/oryx-run.sh:90 pipes it into shell scripts). Globally
+    sorted so diffs between deployments are line diffs."""
+    for path, v in sorted(config.flatten().items()):
+        if isinstance(v, list):
+            v = ",".join(str(x) for x in v)
+        elif v is None:
+            v = ""
+        elif isinstance(v, bool):
+            v = str(v).lower()
+        print(f"{path}={v}")
     return 0
 
 
@@ -480,6 +496,8 @@ def main(argv=None) -> int:
     )
     _apply_platform_env()
     config = _build_config(args)
+    if args.command == "config":
+        return cmd_config(config)
     if args.command == "import-pmml":
         return cmd_import_pmml(config, args.pmml)
     if args.command == "loadtest":

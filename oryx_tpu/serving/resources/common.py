@@ -39,9 +39,13 @@ def _ingest_text(req: Request) -> str:
         if payload is None:
             continue
         if name.endswith(".gz") or payload[:2] == b"\x1f\x8b":
+            import zlib
+
             try:
                 payload = gzip.decompress(payload)
-            except (OSError, EOFError):  # EOFError: truncated stream
+            except (OSError, EOFError, zlib.error):
+                # OSError: bad magic; EOFError: truncated; zlib.error:
+                # corrupt deflate stream
                 raise OryxServingException(400, f"bad gzip upload: {name}")
         parts.append(payload.decode("utf-8", errors="replace"))
     if not parts:

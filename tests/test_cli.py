@@ -260,3 +260,20 @@ oryx.serving.application-resources = ["oryx_tpu.serving.resources.common", "oryx
             sup.wait(timeout=30)
         except subprocess.TimeoutExpired:
             sup.kill()
+
+
+def test_config_subcommand_flattens_effective_config(capsys):
+    """`cli config` prints sorted key=value lines of the EFFECTIVE config
+    (the reference's ConfigToProperties shell surface)."""
+    from oryx_tpu.cli import cmd_config
+    from oryx_tpu.common.config import load_config
+
+    rc = cmd_config(load_config(overlay={"oryx.id": "cfgtest",
+                                         "oryx.serving.api.port": 1234}))
+    assert rc == 0
+    out = capsys.readouterr().out
+    lines = out.strip().splitlines()
+    assert "oryx.id=cfgtest" in lines
+    assert "oryx.serving.api.port=1234" in lines
+    assert "oryx.monitoring.metrics=true" in lines  # booleans lowercase
+    assert lines == sorted(lines)
