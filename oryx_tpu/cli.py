@@ -224,8 +224,12 @@ def cmd_config(config: Config) -> int:
     flattened key=value lines — the reference's ConfigToProperties surface
     (deploy/bin/oryx-run.sh:90 pipes it into shell scripts). Globally
     sorted so diffs between deployments are line diffs."""
+    from oryx_tpu.common.config import _SECRET_RE
+
     for path, v in sorted(config.flatten().items()):
-        if isinstance(v, list):
+        if _SECRET_RE.search(path) and v is not None:
+            v = "*****"  # same redaction as Config.pretty
+        elif isinstance(v, list):
             v = ",".join(str(x) for x in v)
         elif v is None:
             v = ""

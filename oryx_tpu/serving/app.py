@@ -159,6 +159,10 @@ class ServingApp:
         self.model_manager = model_manager
         self.input_producer = input_producer
         self.min_fraction = config.get_float("oryx.serving.min-model-load-fraction", 0.8)
+        # mount point (reference: Tomcat context path, ServingLayer.java);
+        # "" = root. Requests outside the prefix 404 before routing.
+        raw_ctx = (config.get_string("oryx.serving.api.context-path", "/") or "/").strip("/")
+        self.context_path = f"/{raw_ctx}" if raw_ctx else ""
         self.routes: list[_Route] = []
         # routes indexed by literal first path segment; None key holds
         # patterns whose first segment is a parameter (scanned after the
@@ -270,6 +274,15 @@ class ServingApp:
         self._m_requests.inc(method=method, status=str(status))
 
     def _dispatch(self, req: Request):
+        if self.context_path:
+            if req.path == self.context_path:
+                req.path = "/"
+            elif req.path.startswith(self.context_path + "/"):
+                req.path = req.path[len(self.context_path):]
+            else:
+                return _render_error(
+                    404, f"outside context path {self.context_path}", req
+                )
         # Precedence contract: literal-first-segment routes match before
         # parameter-first ones; within each group, registration order wins.
         # (This differs from a pure registration-order scan only when a

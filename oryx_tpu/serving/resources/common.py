@@ -109,6 +109,7 @@ def register(app: ServingApp) -> None:
         model = a.model_manager.get_model()
         frac = model.fraction_loaded() if model is not None else 0.0
         manager = _html.escape(type(a.model_manager).__name__)
+        ctx = a.context_path  # links must stay inside the mount
 
         def table(pairs) -> str:
             return "<table>" + "".join(
@@ -140,7 +141,8 @@ def register(app: ServingApp) -> None:
             f"<p>Model manager: <b>{manager}</b></p>"
             f"<p>Model loaded: <b>{frac:.0%}</b>"
             f"{' (serving)' if frac >= a.min_fraction else ' (warming up)'}</p>"
-            f"<p><a href='/metrics'>metrics</a> &middot; <a href='/ready'>ready</a></p>"
+            f"<p><a href='{ctx}/metrics'>metrics</a> &middot; "
+            f"<a href='{ctx}/ready'>ready</a></p>"
             f"{''.join(sections)}"
             f"<h2>Endpoints</h2><table><tr><th>method</th><th>path</th></tr>"
             f"{rows}</table></body></html>"

@@ -90,11 +90,19 @@ class ServingLayer:
         ctx = None
         if cert:
             # TLS termination in-process (the reference's Tomcat keystore
-            # connector, ServingLayer.java:58-339 — PEM instead of JKS)
+            # connector, ServingLayer.java:58-339 — PEM instead of JKS);
+            # like the reference, TLS binds on secure-port when one is
+            # configured
             import ssl
 
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(cert, key or None)
+            # bind the secure connector on secure-port only when one is
+            # EXPLICITLY configured (default null): a packaged default
+            # would silently clobber `port` for every TLS deployment
+            secure = self.config.get("oryx.serving.api.secure-port", None)
+            if secure:
+                self.port = int(secure)
 
         frontend = self.config.get_string("oryx.serving.api.server", "async")
         if frontend == "async":

@@ -423,3 +423,39 @@ def test_wedge_failover_under_concurrent_http_load(monkeypatch):
                 b.close()
             finally:
                 TopKBatcher._shared = None
+
+
+def test_context_path_mounts_the_app():
+    """oryx.serving.api.context-path prefixes every route (the reference's
+    Tomcat context path); requests outside the prefix 404."""
+    from oryx_tpu.api import ServingModelManager
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.serving.app import Request, ServingApp
+
+    class Manager(ServingModelManager):
+        def __init__(self, config):
+            self.config = config
+
+        def consume(self, it):
+            pass
+
+        def get_model(self):
+            return None
+
+    cfg = load_config(overlay={
+        "oryx.serving.api.context-path": "/oryx",
+        "oryx.serving.application-resources": ["oryx_tpu.serving.resources.common"],
+    })
+    app = ServingApp(cfg, Manager(cfg))
+
+    def get(path):
+        return app.dispatch(
+            Request("GET", path, {}, {}, b"", {"accept": "application/json"})
+        )
+
+    status, _, _ = get("/oryx/ready")
+    assert status == 503  # routed (no model yet) — the prefix worked
+    status, _, _ = get("/ready")
+    assert status == 404  # outside the mount
+    status, body, _ = get("/oryx/metrics")
+    assert status == 200 and b"oryx_serving" in body

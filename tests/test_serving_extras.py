@@ -228,3 +228,17 @@ def test_ingest_multipart_upload(tmp_path):
     ))
     assert status == 400
     InProcBroker.reset_all()
+
+
+def test_tls_binds_explicit_secure_port(tmp_path):
+    """With ssl-cert-file set AND an explicit secure-port, TLS binds the
+    secure port; with secure-port unset (null default) the regular port is
+    kept — a packaged 443 default must never clobber it."""
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.common.ioutil import choose_free_port
+
+    cfg = load_config(overlay={"oryx.id": "sp"})
+    assert cfg.get("oryx.serving.api.secure-port", None) in (None, "")
+    sp = choose_free_port()
+    cfg2 = load_config(overlay={"oryx.serving.api.secure-port": sp})
+    assert int(cfg2.get("oryx.serving.api.secure-port")) == sp
