@@ -188,8 +188,10 @@ def cmd_import_pmml(config: Config, pmml_path: str | None = None) -> int:
     return 0
 
 
-def _apply_platform_env() -> None:
-    """Make JAX_PLATFORMS authoritative for framework processes.
+def _apply_platform_env(config: Config | None = None) -> None:
+    """Make the platform choice authoritative for framework processes:
+    oryx.compute.platform (when not "auto"), overridden by an explicit
+    JAX_PLATFORMS env var (the operator's escape hatch).
 
     Site customizations that pre-register an accelerator PJRT plugin can
     hijack backend resolution so the env var alone is ignored; re-applying
@@ -199,6 +201,10 @@ def _apply_platform_env() -> None:
     import os
 
     platforms = os.environ.get("JAX_PLATFORMS")
+    if not platforms and config is not None:
+        configured = config.get_string("oryx.compute.platform", "auto")
+        if configured and configured != "auto":
+            platforms = configured
     if platforms:
         import jax
 
@@ -498,8 +504,14 @@ def main(argv=None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
-    _apply_platform_env()
     config = _build_config(args)
+    _apply_platform_env(config)
+    seed = config.get("oryx.test.seed", None)
+    if seed is not None:
+        # deterministic-run switch (reference RandomManager sysprop)
+        from oryx_tpu.common.rng import RandomManager
+
+        RandomManager.use_test_seed(int(seed))
     if args.command == "config":
         return cmd_config(config)
     if args.command == "import-pmml":

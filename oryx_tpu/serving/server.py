@@ -55,16 +55,40 @@ class ServingLayer:
         self.app: ServingApp | None = None
 
     def start(self) -> None:
+        # reference parity: the serving layer CREATES missing topics at
+        # startup unless oryx.serving.no-init-topics = true (deployments
+        # where the serving principal lacks admin rights set it and get a
+        # hard error instead)
+        no_init = self.config.get_bool("oryx.serving.no-init-topics", False)
+
+        def ensure(uri: str, topic: str, which: str) -> None:
+            if get_broker(uri).topic_exists(topic):
+                return
+            if no_init:
+                raise RuntimeError(f"topic does not exist: {topic}")
+            partitions = self.config.get_int(
+                f"oryx.{which}-topic.message.partitions", 1
+            )
+            log.info("creating missing topic %s (%d partitions)", topic, partitions)
+            # maybe_create: replicas racing on the same broker must both
+            # win; honor the configured message cap (MODEL publishes are
+            # sized against it)
+            from oryx_tpu.bus.broker import topics
+
+            topics.maybe_create(
+                uri, topic, partitions,
+                max_message_bytes=self.config.get_int(
+                    f"oryx.{which}-topic.message.max-size", 1 << 24
+                ),
+            )
+
+        ensure(self.update_uri, self.update_topic, "update")
         update_broker = get_broker(self.update_uri)
-        if not update_broker.topic_exists(self.update_topic):
-            raise RuntimeError(f"topic does not exist: {self.update_topic}")
 
         input_producer = None
         if not self.read_only:
-            input_broker = get_broker(self.input_uri)
-            if not input_broker.topic_exists(self.input_topic):
-                raise RuntimeError(f"topic does not exist: {self.input_topic}")
-            input_producer = TopicProducer(input_broker, self.input_topic)
+            ensure(self.input_uri, self.input_topic, "input")
+            input_producer = TopicProducer(get_broker(self.input_uri), self.input_topic)
 
         # model listener: replay update topic from earliest forever
         # (ModelManagerListener.java:118-149)

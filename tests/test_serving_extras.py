@@ -242,3 +242,48 @@ def test_tls_binds_explicit_secure_port(tmp_path):
     sp = choose_free_port()
     cfg2 = load_config(overlay={"oryx.serving.api.secure-port": sp})
     assert int(cfg2.get("oryx.serving.api.secure-port")) == sp
+
+
+def test_serving_creates_missing_topics_unless_no_init():
+    """Reference parity: serving creates missing topics at startup; with
+    no-init-topics=true it errors instead."""
+    from oryx_tpu.api import ServingModelManager
+    from oryx_tpu.bus.broker import get_broker
+    from oryx_tpu.bus.inproc import InProcBroker
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.serving.server import ServingLayer
+
+    InProcBroker.reset_all()
+
+    class Manager(ServingModelManager):
+        def __init__(self, config):
+            self.config = config
+
+        def consume(self, it):
+            pass
+
+        def get_model(self):
+            return None
+
+    base = {
+        "oryx.id": "ni",
+        "oryx.input-topic.broker": "mem://ni",
+        "oryx.update-topic.broker": "mem://ni",
+        "oryx.serving.api.port": 0,
+        "oryx.serving.application-resources": ["oryx_tpu.serving.resources.common"],
+    }
+    cfg = load_config(overlay=base)
+    sl = ServingLayer(cfg, model_manager=Manager(cfg))
+    sl.start()  # no topics pre-created: both get made
+    assert get_broker("mem://ni").topic_exists("OryxUpdate")
+    assert get_broker("mem://ni").topic_exists("OryxInput")
+    sl.close()
+
+    InProcBroker.reset_all()
+    cfg2 = load_config(overlay={**base, "oryx.serving.no-init-topics": True})
+    sl2 = ServingLayer(cfg2, model_manager=Manager(cfg2))
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="topic does not exist"):
+        sl2.start()
+    InProcBroker.reset_all()
