@@ -36,6 +36,8 @@ class ALSConfig:
     approx_recall: float
     compute_dtype: str
     checkpoint_interval: int
+    candidate_partitions: int
+    lsh_max_bits_differing: int | None
 
     @staticmethod
     def from_config(config: Config) -> "ALSConfig":
@@ -55,7 +57,26 @@ class ALSConfig:
             approx_recall=_valid_recall(float(g("approx-recall", 1.0))),
             compute_dtype=_valid_compute_dtype(str(g("compute-dtype", "float32"))),
             checkpoint_interval=int(g("checkpoint-interval", 0)),
+            # LSH knobs (the CPU-parity approximate path): 0 = auto
+            # partition count from cores; null = auto Hamming radius
+            candidate_partitions=_valid_nonneg(
+                "candidate-partitions", int(g("candidate-partitions", 0))
+            ),
+            lsh_max_bits_differing=_valid_lsh_bits(g("lsh-max-bits-differing", None)),
         )
+
+
+def _valid_nonneg(key: str, value: int) -> int:
+    """Fail at config load, not on the first /recommend request."""
+    if value < 0:
+        raise ValueError(f"oryx.als.{key} must be >= 0, got {value}")
+    return value
+
+
+def _valid_lsh_bits(raw) -> int | None:
+    if raw is None:
+        return None
+    return _valid_nonneg("lsh-max-bits-differing", int(raw))
 
 
 def _valid_recall(value: float) -> float:

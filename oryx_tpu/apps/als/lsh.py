@@ -52,12 +52,23 @@ def _choose_hash_count(sample_rate: float, num_cores: int) -> tuple[int, int]:
 
 
 class LocalitySensitiveHash:
-    def __init__(self, sample_rate: float, num_features: int, num_cores: int | None = None):
+    def __init__(
+        self,
+        sample_rate: float,
+        num_features: int,
+        num_cores: int | None = None,
+        max_bits_differing: int | None = None,
+    ):
         if num_cores is None:
             import os
 
             num_cores = os.cpu_count() or 1
         num_hashes, bits_differing = _choose_hash_count(sample_rate, num_cores)
+        if max_bits_differing is not None:
+            # explicit oryx.als.lsh-max-bits-differing override of the
+            # derived Hamming-ball radius (wider = more candidate
+            # partitions probed = higher recall, lower speedup)
+            bits_differing = max(0, min(int(max_bits_differing), num_hashes))
         self.max_bits_differing = bits_differing
         log.info(
             "LSH with %d hashes, querying partitions with up to %d bits differing",

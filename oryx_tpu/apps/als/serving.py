@@ -70,6 +70,7 @@ class ALSServingModel(ServingModel):
         sample_rate: float = 1.0,
         num_cores: int | None = None,
         approx_recall: float = 1.0,
+        lsh_max_bits_differing: int | None = None,
     ):
         self.state = state
         # < 1.0: serve via the on-device approximate top-k (the TPU
@@ -85,6 +86,7 @@ class ALSServingModel(ServingModel):
         # scores everything exactly): built lazily at first query
         self.sample_rate = sample_rate
         self._num_cores = num_cores
+        self._lsh_max_bits = lsh_max_bits_differing
         self._lsh = None
         self._partition_view: tuple | None = None  # (mat, ids, parts, version)
         self._partition_built_at = 0.0
@@ -101,7 +103,8 @@ class ALSServingModel(ServingModel):
             with self._sync_lock:
                 if self._lsh is None:
                     self._lsh = LocalitySensitiveHash(
-                        self.sample_rate, self.state.features, self._num_cores
+                        self.sample_rate, self.state.features, self._num_cores,
+                        max_bits_differing=self._lsh_max_bits,
                     )
         view = self._partition_view
         version = self.state.y.get_version()
@@ -445,6 +448,8 @@ class ALSServingModelManager(AbstractServingModelManager):
             self.model = ALSServingModel(
                 state, sample_rate=self.als.sample_rate,
                 approx_recall=self.als.approx_recall,
+                num_cores=(self.als.candidate_partitions or None),
+                lsh_max_bits_differing=self.als.lsh_max_bits_differing,
             )
 
 

@@ -149,3 +149,29 @@ def test_representative_items_one_per_partition():
     part_of = {ids[i]: parts[i] for i in range(len(ids))}
     chosen = [part_of[r] for r in reps]
     assert len(set(chosen)) == len(chosen)
+
+
+def test_lsh_max_bits_override():
+    """oryx.als.lsh-max-bits-differing overrides the derived Hamming-ball
+    radius (clamped to the hash count); null keeps the auto-chooser, and
+    negatives are rejected at config load."""
+    import pytest as _pytest
+
+    from oryx_tpu.apps.als.common import ALSConfig
+    from oryx_tpu.common.config import load_config
+
+    with _pytest.raises(ValueError, match="lsh-max-bits-differing"):
+        ALSConfig.from_config(
+            load_config(overlay={"oryx.als.lsh-max-bits-differing": -5})
+        )
+    with _pytest.raises(ValueError, match="candidate-partitions"):
+        ALSConfig.from_config(
+            load_config(overlay={"oryx.als.candidate-partitions": -4})
+        )
+
+    auto = LocalitySensitiveHash(0.1, 8, num_cores=8)
+    forced = LocalitySensitiveHash(0.1, 8, num_cores=8, max_bits_differing=0)
+    assert forced.max_bits_differing == 0
+    assert forced.num_hashes == auto.num_hashes
+    wide = LocalitySensitiveHash(0.1, 8, num_cores=8, max_bits_differing=99)
+    assert wide.max_bits_differing == wide.num_hashes  # clamped
