@@ -358,6 +358,11 @@ def _bench_http_body() -> None:
     dt = duration
     qps = total / dt
     mean_batch = (b.coalesced - warm_coal) / max(1, b.dispatches - warm_disp)
+    # model memory at this scale, against the reference's heap table
+    # (BASELINE.md "Memory": 1,400 MB heap at 50f x 2M users+items): host
+    # f32 arenas + the bf16 device scoring copy
+    host_mb = (state.x.nbytes() + state.y.nbytes()) / 1e6
+    device_mb = manager.model._y_view_full()[0].nbytes / 1e6
     serving.close()
     scaled = "" if on_accel else f" [CPU-FALLBACK scale: {n_items} items]"
     print(
@@ -381,6 +386,8 @@ def _bench_http_body() -> None:
                 "latency_ms_p50": round(pctl(0.50), 1),
                 "latency_ms_p90": round(pctl(0.90), 1),
                 "latency_ms_p99": round(pctl(0.99), 1),
+                "model_host_mb": round(host_mb, 1),
+                "model_device_mb": round(device_mb, 1),
             }
         )
     )
