@@ -166,3 +166,96 @@ def test_crc32c_matches_known_implementation(data):
         return crc ^ 0xFFFFFFFF
 
     assert _crc32c_py(data) == slow_crc32c(data)
+
+
+# ---------------------------------------------------------------------------
+# HOCON-subset config parser: generated documents with known structure
+# ---------------------------------------------------------------------------
+
+from oryx_tpu.common.config import Config, parse_config  # noqa: E402
+
+
+keys = st.from_regex(r"[a-z][a-z0-9-]{0,10}", fullmatch=True)
+scalars = st.one_of(
+    st.integers(-10**9, 10**9),
+    st.booleans(),
+    st.none(),
+    st.floats(-1e6, 1e6, allow_nan=False).map(lambda f: round(f, 4)),
+    st.from_regex(r"[A-Za-z][A-Za-z0-9_./:@#-]{0,20}", fullmatch=True),
+)
+config_dicts = st.recursive(
+    st.dictionaries(keys, scalars, min_size=1, max_size=4),
+    lambda children: st.dictionaries(
+        keys, scalars | children | st.lists(scalars, max_size=3),
+        min_size=1, max_size=4,
+    ),
+    max_leaves=12,
+)
+
+
+def _render(d, indent=0):
+    """Emit a document in the supported syntax from a known dict."""
+    out = []
+    pad = "  " * indent
+    for k, v in d.items():
+        if isinstance(v, dict):
+            out.append(f"{pad}{k} = {{")
+            out.append(_render(v, indent + 1))
+            out.append(pad + "}")
+        elif isinstance(v, list):
+            items = ", ".join(_scalar_text(x) for x in v)
+            out.append(f"{pad}{k} = [{items}]")
+        else:
+            out.append(f"{pad}{k} = {_scalar_text(v)}")
+    return "\n".join(out)
+
+
+def _scalar_text(v):
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, str):
+        return f'"{v}"'
+    return repr(v)
+
+
+def _normalize(v):
+    # floats that render as integers (e.g. 2.0 -> "2.0") survive; ints stay
+    # ints; everything else round-trips exactly
+    if isinstance(v, dict):
+        return {k: _normalize(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_normalize(x) for x in v]
+    return v
+
+
+@settings(max_examples=200, deadline=None)
+@given(config_dicts)
+def test_hocon_parser_roundtrip(doc):
+    parsed = parse_config(_render(doc))._data
+    assert parsed == _normalize(doc)
+
+
+@settings(max_examples=100, deadline=None)
+@given(config_dicts, config_dicts)
+def test_overlay_deep_merges(base, over):
+    cfg = parse_config(_render(base)).overlay(
+        {  # dotted-path overlay of every leaf of `over`
+            k: v
+            for k, v in _flatten_paths(over).items()
+        }
+    )
+    for path, v in _flatten_paths(over).items():
+        assert cfg.get(path) == v
+
+
+def _flatten_paths(d, prefix=""):
+    out = {}
+    for k, v in d.items():
+        p = f"{prefix}.{k}" if prefix else k
+        if isinstance(v, dict):
+            out.update(_flatten_paths(v, p))
+        else:
+            out[p] = v
+    return out
