@@ -54,18 +54,29 @@ class DistributedConfig:
 
 
 def enable_repo_compile_cache(base_dir: str) -> bool:
-    """Point the persistent compile cache at <base_dir>/.jax_cache —
-    the shared helper behind the benchmark's and the multichip dryrun's
-    repeat-run warm compiles. Returns False (never raises) when the cache
-    cannot be configured: it is an optimization only."""
+    """Point the persistent compile cache at
+    <base_dir>/.jax_cache/<backend> — the shared helper behind the
+    benchmark's and the multichip dryrun's repeat-run warm compiles.
+    Split per backend: entries AOT-compiled under one platform's target
+    features must never be offered to another (observed: CPU bodies
+    loading entries stamped with mismatched machine features, an XLA
+    SIGILL hazard). Returns False (never raises) when the cache cannot be
+    configured: it is an optimization only."""
     import os
 
     try:
         from oryx_tpu.common.config import load_config
 
+        # backend name WITHOUT initializing a backend when the platform is
+        # already pinned (jax_platforms set, e.g. forced-CPU dryrun/bench
+        # bodies). Only unpinned callers fall through to default_backend(),
+        # which initializes — those callers (TPU bench bodies) touch the
+        # device immediately afterwards anyway, and run timeout-bounded.
+        pinned = jax.config.jax_platforms
+        backend = pinned.split(",")[0] if pinned else jax.default_backend()
         return configure_compilation_cache(load_config(overlay={
             "oryx.compute.compilation-cache-dir": os.path.join(
-                base_dir, ".jax_cache"
+                base_dir, ".jax_cache", backend
             )
         }))
     except Exception:  # noqa: BLE001 - never fail the caller over a cache
