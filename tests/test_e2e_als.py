@@ -213,3 +213,65 @@ def test_serving_read_only_mode(tmp_path):
     status, resp = _http("POST", f"{base}/ingest", body=b"u1,i1,1")
     assert status == 405
     serving.close()
+
+
+def test_full_lambda_slice_explicit(tmp_path):
+    """The EXPLICIT-feedback mode through the full stack: ratings train an
+    ALS-WR model (last-wins aggregation, -RMSE eval), serving answers
+    /estimate with rating-scale predictions and /recommend ranks unseen
+    items by predicted rating."""
+    RandomManager.use_test_seed(21)
+    port = choose_free_port()
+    cfg = _make_config(tmp_path, port).overlay({
+        "oryx.als.implicit": False,
+        "oryx.als.hyperparams.lambda": 0.02,
+        "oryx.ml.eval.test-fraction": 0.1,
+    })
+    topics.maybe_create("mem://e2e", "OryxInput", partitions=1)
+    topics.maybe_create("mem://e2e", "OryxUpdate", partitions=1)
+
+    serving = ServingLayer(cfg, model_manager=ALSServingModelManager(cfg))
+    serving.start()
+    base = f"http://127.0.0.1:{serving.port}"
+
+    # structured ratings: users love in-group items (5) and pan the rest (1)
+    rng = np.random.default_rng(4)
+    lines = []
+    ts = 0
+    for u in range(24):
+        g = u % 3
+        for i in range(18):
+            if rng.random() < 0.7:
+                r = 5.0 if i % 3 == g else 1.0
+                ts += 1
+                lines.append(f"u{u},i{i},{r},{1000 + ts}")
+    status, resp = _http("POST", f"{base}/ingest", body="\n".join(lines).encode())
+    assert status == 200, resp
+
+    batch = BatchLayer(cfg, update=ALSUpdate(cfg))
+    batch.ensure_streams()
+    batch._consumer._fetch_pos = {p: 0 for p in batch._consumer._fetch_pos}
+    assert batch.run_generation(timestamp_ms=1_700_000_000_000) == len(lines)
+    batch.close()
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        status, _ = _http("GET", f"{base}/ready")
+        if status == 200:
+            break
+        time.sleep(0.1)
+    assert status == 200, "serving never became ready"
+
+    # estimates discriminate loved vs panned items for u4 (group 1)
+    status, resp = _http("GET", f"{base}/estimate/u4/i1/i0")
+    assert status == 200, resp
+    est = dict(json.loads(resp))
+    assert est["i1"] > est["i0"] + 1.0, est  # in-group ~5 vs out-group ~1
+
+    # recommendations rank unseen in-group items first
+    status, resp = _http("GET", f"{base}/recommend/u4?howMany=3")
+    assert status == 200
+    recs = json.loads(resp)
+    assert int(recs[0][0][1:]) % 3 == 1, recs
+
+    serving.close()
