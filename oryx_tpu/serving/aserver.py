@@ -77,6 +77,8 @@ class AsyncHTTPServer:
         self._thread: threading.Thread | None = None
         self._started = threading.Event()
         self._start_error: BaseException | None = None
+        # live per-connection tasks -> parked-between-requests flag
+        self._conns: dict = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -107,6 +109,30 @@ class AsyncHTTPServer:
     async def _shutdown(self) -> None:
         if self._server is not None:
             self._server.close()
+        # Drain BEFORE wait_closed(): python 3.12's Server.wait_closed
+        # waits for all connection handlers, so waiting first silently
+        # burned close()'s full timeout and abandoned tasks to die noisily
+        # with the loop ("Task was destroyed but it is pending").
+        # Idle keep-alive connections (parked in readuntil) cancel
+        # immediately; BUSY requests get a short grace to finish writing
+        # their response, then cancel too. The sweep loops because a
+        # connection accepted just before close() registers only on its
+        # task's first step.
+        loop = asyncio.get_running_loop()
+        grace_until = loop.time() + 5.0
+        while True:
+            # yield first: a handler task created for a just-accepted
+            # connection registers only on its first step — checking
+            # before yielding would miss it entirely
+            await asyncio.sleep(0)
+            if not self._conns:
+                break
+            past_grace = loop.time() >= grace_until
+            for task, idle in list(self._conns.items()):
+                if past_grace or idle:
+                    task.cancel()
+            await asyncio.wait(list(self._conns), timeout=0.25)
+        if self._server is not None:
             await self._server.wait_closed()
 
     def _run_loop(self) -> None:
@@ -144,6 +170,10 @@ class AsyncHTTPServer:
     async def _handle_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._conns[task] = True  # idle until a request head arrives
+            task.add_done_callback(lambda t: self._conns.pop(t, None))
         try:
             while True:
                 try:
@@ -162,6 +192,8 @@ class AsyncHTTPServer:
                 if len(head) > MAX_HEADER_BYTES:
                     await self._simple_response(writer, 400, b"headers too large")
                     return
+                if task is not None:
+                    self._conns[task] = False  # request in flight
 
                 lines = head.split(b"\r\n")
                 try:
@@ -213,6 +245,8 @@ class AsyncHTTPServer:
                     and version_b != b"HTTP/1.0"
                 )
                 await self._handle_request(writer, method, target, headers, body)
+                if task is not None:
+                    self._conns[task] = True  # parked between requests
                 if not keep_alive:
                     return
         finally:

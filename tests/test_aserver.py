@@ -459,3 +459,46 @@ def test_context_path_mounts_the_app():
     assert status == 404  # outside the mount
     status, body, _ = get("/oryx/metrics")
     assert status == 200 and b"oryx_serving" in body
+
+
+def test_close_cancels_parked_keepalive_connections():
+    """close() must cancel connections parked in readuntil() — abandoned
+    tasks die noisily with the loop ('Task was destroyed but it is
+    pending') and can linger past shutdown."""
+    import http.client
+    import time as _time
+
+    from oryx_tpu.api import ServingModelManager
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.serving.app import ServingApp
+    from oryx_tpu.serving.aserver import AsyncHTTPServer
+
+    class Manager(ServingModelManager):
+        def __init__(self, config):
+            self.config = config
+
+        def consume(self, it):
+            pass
+
+        def get_model(self):
+            return None
+
+    cfg = load_config(overlay={
+        "oryx.serving.application-resources": ["oryx_tpu.serving.resources.common"],
+    })
+    srv = AsyncHTTPServer(ServingApp(cfg, Manager(cfg)), None, 0)
+    srv.start()
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+    try:
+        conn.request("GET", "/metrics")
+        conn.getresponse().read()  # keep-alive: connection stays parked
+        deadline = _time.time() + 5
+        while not srv._conns and _time.time() < deadline:
+            _time.sleep(0.02)
+        assert srv._conns, "connection task never registered"
+        t0 = _time.time()
+    finally:
+        srv.close()
+    assert _time.time() - t0 < 4, "close() hung on a parked connection"
+    assert not srv._conns, "connection tasks leaked past close()"
+    conn.close()

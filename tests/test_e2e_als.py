@@ -225,7 +225,6 @@ def test_full_lambda_slice_explicit(tmp_path):
     cfg = _make_config(tmp_path, port).overlay({
         "oryx.als.implicit": False,
         "oryx.als.hyperparams.lambda": 0.02,
-        "oryx.ml.eval.test-fraction": 0.1,
     })
     topics.maybe_create("mem://e2e", "OryxInput", partitions=1)
     topics.maybe_create("mem://e2e", "OryxUpdate", partitions=1)
