@@ -277,3 +277,28 @@ def test_config_subcommand_flattens_effective_config(capsys):
     assert "oryx.serving.api.port=1234" in lines
     assert "oryx.monitoring.metrics=true" in lines  # booleans lowercase
     assert lines == sorted(lines)
+
+
+def test_apply_platform_env_prefers_env_over_config(monkeypatch):
+    """oryx.compute.platform steers jax when set (not "auto"); an explicit
+    JAX_PLATFORMS env var wins as the operator override."""
+    import jax
+
+    from oryx_tpu.cli import _apply_platform_env
+    from oryx_tpu.common.config import load_config
+
+    before = jax.config.jax_platforms
+    try:
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        _apply_platform_env(load_config(overlay={"oryx.compute.platform": "cpu"}))
+        assert jax.config.jax_platforms == "cpu"
+        # "auto" leaves whatever is configured alone
+        jax.config.update("jax_platforms", "cpu")
+        _apply_platform_env(load_config(overlay={"oryx.compute.platform": "auto"}))
+        assert jax.config.jax_platforms == "cpu"
+        # env var beats config
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        _apply_platform_env(load_config(overlay={"oryx.compute.platform": "tpu"}))
+        assert jax.config.jax_platforms == "cpu"
+    finally:
+        jax.config.update("jax_platforms", before)
