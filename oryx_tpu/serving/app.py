@@ -15,6 +15,8 @@ import re
 import time
 import weakref
 from concurrent.futures import Future
+
+from oryx_tpu.serving.futureutil import try_set_exception, try_set_result
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -59,10 +61,16 @@ def chain_future(
     out: Future = Future()
 
     def _apply(f):
+        # out may already be cancelled: the async frontend's
+        # asyncio.wrap_future cancels it on client disconnect / shutdown
+        # drain — try_set absorbs the lost race instead of raising
+        # InvalidStateError inside a done-callback
         try:
-            out.set_result(fn(f.result()))
+            result = fn(f.result())
         except BaseException as e:  # noqa: BLE001 - carried downstream
-            out.set_exception(e)
+            try_set_exception(out, e)
+            return
+        try_set_result(out, result)
 
     if executor is None:
         future.add_done_callback(_apply)
@@ -76,10 +84,9 @@ def chain_future(
                 # blocked callers hanging — and never run fn inline here,
                 # because the completing thread may be the batcher
                 # dispatcher, which arbitrary fn code could deadlock
-                if not out.done():
-                    out.set_exception(
-                        RuntimeError("post-processing pool is shut down")
-                    )
+                try_set_exception(
+                    out, RuntimeError("post-processing pool is shut down")
+                )
         future.add_done_callback(_bounce)
     return out
 
@@ -259,7 +266,7 @@ class ServingApp:
                 except BaseException as e:  # noqa: BLE001 - boundary
                     out = _render_exception(e, req)
                 self._observe(req, start, out[0])
-                rendered.set_result(out)
+                try_set_result(rendered, out)
 
             resp.future.add_done_callback(_finish)
             return Deferred(rendered)

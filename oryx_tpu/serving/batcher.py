@@ -44,6 +44,8 @@ import threading
 import time
 from concurrent.futures import Future
 
+from oryx_tpu.serving.futureutil import try_set_exception, try_set_result
+
 import numpy as np
 
 log = logging.getLogger(__name__)
@@ -125,21 +127,24 @@ class _Pending:
         if self.future.done():
             return False
         if self.host_mat is None:
-            self.future.set_exception(
-                reason or RuntimeError("device unavailable, no host fallback")
+            try_set_exception(
+                self.future,
+                reason or RuntimeError("device unavailable, no host fallback"),
             )
             return False
         try:
-            self.future.set_result(
+            # a lost try_set race means the wedged dispatcher unwedged
+            # mid-drain and delivered its device result first — that
+            # request succeeded, just not here
+            return try_set_result(
+                self.future,
                 host_topk(
                     self.vec, self.k, self.host_mat, self.cosine,
                     self.host_norms,
-                )
+                ),
             )
-            return True
         except Exception as e:  # pragma: no cover - defensive
-            if not self.future.done():
-                self.future.set_exception(e)
+            try_set_exception(self.future, e)
             return False
 
 
@@ -339,8 +344,7 @@ class TopKBatcher:
                 # the whole batch, not kill the thread with futures pending
                 log.exception("batcher launch failed")
                 for p in batch:
-                    if not p.future.done():
-                        p.future.set_exception(e)
+                    try_set_exception(p.future, e)
                 launched = []
             for item in inflight:
                 self._resolve(item)
@@ -400,9 +404,10 @@ class TopKBatcher:
                 launched.append((group, kb, vals, idx))
             except Exception as e:
                 log.exception("batcher group dispatch failed (k=%d)", kb)
+                # the watchdog's drain may be host-resolving these same
+                # futures concurrently — a lost race must not propagate
                 for p in group:
-                    if not p.future.done():
-                        p.future.set_exception(e)
+                    try_set_exception(p.future, e)
         return launched
 
     def _resolve(self, item: tuple[list[_Pending], int, object, object]) -> None:
@@ -413,14 +418,14 @@ class TopKBatcher:
             for i, p in enumerate(group):
                 k_eff = min(p.k, kb)
                 # the watchdog may have host-resolved this request while the
-                # fetch above sat on a wedged transport
-                if not p.future.done():
-                    p.future.set_result((vals[i, :k_eff], idx[i, :k_eff]))
+                # fetch above sat on a wedged transport — and may win the
+                # race BETWEEN a done() check and the set; try_set absorbs
+                # the lost race instead of failing the rest of the group
+                try_set_result(p.future, (vals[i, :k_eff], idx[i, :k_eff]))
         except Exception as e:
             log.exception("batcher group resolve failed (k=%d)", kb)
             for p in group:
-                if not p.future.done():
-                    p.future.set_exception(e)
+                try_set_exception(p.future, e)
 
     # -- watchdog: wedged-transport failover -------------------------------
 

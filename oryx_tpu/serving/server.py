@@ -55,21 +55,40 @@ class ServingLayer:
         self.app: ServingApp | None = None
 
     def start(self) -> None:
-        # reference parity: the serving layer CREATES missing topics at
-        # startup unless oryx.serving.no-init-topics = true (deployments
-        # where the serving principal lacks admin rights set it and get a
-        # hard error instead)
+        # Fail fast on missing topics: the reference serving layer never
+        # creates topics (its no-init-topics flag only gates the test-only
+        # ModelManagerListener path, ServingLayer.java:283) — a typo'd topic
+        # name must error at startup, not silently serve an empty topic.
+        # oryx.serving.init-topics = true opts in to auto-creation for
+        # single-binary/dev deployments (a deliberate deviation, logged
+        # loudly); no-init-topics = true additionally forbids it outright.
         no_init = self.config.get_bool("oryx.serving.no-init-topics", False)
+        init_topics = (
+            self.config.get_bool("oryx.serving.init-topics", False)
+            and not no_init
+        )
 
         def ensure(uri: str, topic: str, which: str) -> None:
             if get_broker(uri).topic_exists(topic):
                 return
-            if no_init:
-                raise RuntimeError(f"topic does not exist: {topic}")
+            if not init_topics:
+                hint = (
+                    "topic creation is forbidden by oryx.serving."
+                    "no-init-topics = true; create it out of band"
+                    if no_init
+                    else "create it first (`python -m oryx_tpu.cli setup`) "
+                    "or set oryx.serving.init-topics = true to let the "
+                    "serving layer create it"
+                )
+                raise RuntimeError(f"topic does not exist: {topic} ({hint})")
+            log.warning(
+                "AUTO-CREATING missing %s topic %s on %s "
+                "(oryx.serving.init-topics = true; the reference serving "
+                "layer would fail fast here)", which, topic, uri,
+            )
             partitions = self.config.get_int(
                 f"oryx.{which}-topic.message.partitions", 1
             )
-            log.info("creating missing topic %s (%d partitions)", topic, partitions)
             # maybe_create: replicas racing on the same broker must both
             # win; honor the configured message cap (MODEL publishes are
             # sized against it)
@@ -123,10 +142,20 @@ class ServingLayer:
             ctx.load_cert_chain(cert, key or None)
             # bind the secure connector on secure-port only when one is
             # EXPLICITLY configured (default null): a packaged default
-            # would silently clobber `port` for every TLS deployment
+            # would silently clobber `port` for every TLS deployment.
+            # DIVERGENCE from the reference (ServingLayer.java:215), which
+            # binds secure-port (default 443) whenever a keystore is
+            # configured — see docs/parity.md; warn so reference configs
+            # relying on that default notice the changed bind port.
             secure = self.config.get("oryx.serving.api.secure-port", None)
             if secure:
                 self.port = int(secure)
+            else:
+                log.warning(
+                    "TLS enabled without oryx.serving.api.secure-port: "
+                    "binding the secure connector on port %d (the reference "
+                    "would bind secure-port's default 443 here)", self.port,
+                )
 
         frontend = self.config.get_string("oryx.serving.api.server", "async")
         if frontend == "async":

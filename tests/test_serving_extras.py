@@ -245,8 +245,9 @@ def test_tls_binds_explicit_secure_port(tmp_path):
 
 
 def test_serving_creates_missing_topics_unless_no_init():
-    """Reference parity: serving creates missing topics at startup; with
-    no-init-topics=true it errors instead."""
+    """Fail-fast default (the reference serving layer never creates
+    topics): a missing topic errors at startup; init-topics=true opts in
+    to auto-creation; no-init-topics=true forbids it even then."""
     from oryx_tpu.api import ServingModelManager
     from oryx_tpu.bus.broker import get_broker
     from oryx_tpu.bus.inproc import InProcBroker
@@ -272,18 +273,31 @@ def test_serving_creates_missing_topics_unless_no_init():
         "oryx.serving.api.port": 0,
         "oryx.serving.application-resources": ["oryx_tpu.serving.resources.common"],
     }
-    cfg = load_config(overlay=base)
+    import pytest as _pytest
+
+    # default: fail fast on the missing topic, like the reference
+    cfg0 = load_config(overlay=base)
+    sl0 = ServingLayer(cfg0, model_manager=Manager(cfg0))
+    with _pytest.raises(RuntimeError, match="topic does not exist"):
+        sl0.start()
+
+    InProcBroker.reset_all()
+    cfg = load_config(overlay={**base, "oryx.serving.init-topics": True})
     sl = ServingLayer(cfg, model_manager=Manager(cfg))
-    sl.start()  # no topics pre-created: both get made
+    sl.start()  # explicit opt-in: both topics get made
     assert get_broker("mem://ni").topic_exists("OryxUpdate")
     assert get_broker("mem://ni").topic_exists("OryxInput")
     sl.close()
 
     InProcBroker.reset_all()
-    cfg2 = load_config(overlay={**base, "oryx.serving.no-init-topics": True})
+    cfg2 = load_config(
+        overlay={
+            **base,
+            "oryx.serving.init-topics": True,
+            "oryx.serving.no-init-topics": True,
+        }
+    )
     sl2 = ServingLayer(cfg2, model_manager=Manager(cfg2))
-    import pytest as _pytest
-
     with _pytest.raises(RuntimeError, match="topic does not exist"):
         sl2.start()
     InProcBroker.reset_all()

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Poll TPU health in killable subprocesses; append timestamped lines to .tpu_health.log.
+# A wedged axon tunnel hangs any device op (even import, via sitecustomize), so the
+# probe always runs under timeout in a fresh process.
+LOG="${1:-/root/repo/.tpu_health.log}"
+INTERVAL="${2:-240}"
+while true; do
+  ts=$(date -u +%FT%TZ)
+  out=$(timeout 45 python -c 'import jax,jax.numpy as jnp; x=jnp.ones((512,512),jnp.bfloat16); (x@x).block_until_ready(); d=jax.devices()[0]; print(d.platform)' 2>&1)
+  rc=$?
+  if [ $rc -eq 0 ]; then
+    echo "$ts HEALTHY $(echo "$out" | tail -1)" >> "$LOG"
+  else
+    echo "$ts WEDGED rc=$rc" >> "$LOG"
+  fi
+  sleep "$INTERVAL"
+done
