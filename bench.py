@@ -6,15 +6,24 @@ x 1M-item model, measure requests/sec of top-10 recommend). Reference best
 case from docs/docs/performance.html: 437 qps at 50 features x 1M items
 WITH LSH (sampleRate 0.3, 32-core Xeon); vs_baseline = measured qps / 437.
 
-Resilience (round-1 lesson): the real-TPU transport on the bench host can
-wedge hard enough that jax.devices() hangs forever in C code — recovery is
-impossible in-process. So the orchestration here never imports jax itself:
-it probes the backend in a killable subprocess (bounded time, retried),
-runs the measured body in a subprocess, and falls back to a forced-CPU run
-if the accelerator is unusable. The ONE JSON line is printed on every path,
-carrying an "error" field when degraded.
+Resilience (round-1 and round-2 lessons): the real-TPU transport on the
+bench host can wedge hard enough that jax.devices() hangs forever in C
+code — recovery is impossible in-process, and outages last hours with
+healthy windows between. So the orchestration here never imports jax
+itself: every backend touch is a killable subprocess. It probes the
+accelerator on an interval across the whole ORYX_BENCH_BUDGET_S budget
+(default 3 h) and runs the full suite inside any healthy window; a
+forced-CPU suite is captured early as the safety artifact and stands
+only if no window ever opens. Degraded runs are labeled honestly: the
+metric name carries the TRUE measured scale plus a _cpu suffix, and
+vs_baseline is null whenever the configuration doesn't match the row the
+baseline was measured at.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+MFU fields (round-2 verdict #2): training and serving report analytic
+FLOPs (ops/flops.py) over wall-clock and the chip's dense-bf16 peak.
+
+Prints progress JSON lines, then ONE final line: {"metric", "value",
+"unit", "vs_baseline", ...}; the driver parses the last parseable line.
 """
 
 from __future__ import annotations
@@ -28,7 +37,35 @@ import tempfile
 import time
 
 BASELINE_QPS = 437.0  # reference best case, BASELINE.md
+BASELINE_CONFIG = (1_000_000, 50)  # (items, features) behind that 437 qps
 HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _items_label(n: int) -> str:
+    if n >= 1_000_000 and n % 1_000_000 == 0:
+        return f"{n // 1_000_000}M"
+    if n >= 1_000 and n % 1_000 == 0:
+        return f"{n // 1_000}k"
+    return str(n)
+
+
+def _metric_name(base: str, n_items: int, features: int, platform: str) -> str:
+    """Metric names carry the TRUE measured scale, and a _cpu suffix on
+    the degraded path — a fallback run must never wear a TPU metric's
+    name (round-2 verdict)."""
+    name = f"{base}_{_items_label(n_items)}_items_{features}f"
+    if platform == "cpu":
+        name += "_cpu"
+    return name
+
+
+def _vs_baseline(qps: float, n_items: int, features: int) -> float | None:
+    """qps / 437 ONLY when the run matches the configuration the baseline
+    was measured at (1M items x 50 features); otherwise null — a 100k-item
+    fallback divided by a 1M-item baseline is not a comparison."""
+    if (n_items, features) != BASELINE_CONFIG:
+        return None
+    return round(qps / BASELINE_QPS, 2)
 
 
 def _enable_compile_cache() -> None:
@@ -155,14 +192,24 @@ def _bench_body() -> None:
         f"{shootout}",
         file=sys.stderr,
     )
+    from oryx_tpu.ops.flops import device_peak_flops, mfu, topk_score_flops
+
+    peak = device_peak_flops("bfloat16")
+    kernel_mfu = mfu(qps * topk_score_flops(1, n_items, features), peak)
     out = {
-        "metric": "als_recommend_throughput_1M_items_50f",
+        "metric": _metric_name(
+            "als_recommend_kernel_qps", n_items, features, platform
+        ),
         "value": round(qps, 1),
         "unit": "qps",
-        "vs_baseline": round(qps / BASELINE_QPS, 2),
+        "vs_baseline": _vs_baseline(qps, n_items, features),
         "platform": platform,
         "batch": batch,
         "n_items": n_items,
+        # achieved FLOP/s over chip dense-bf16 peak: 2·I·F per request
+        # (ops/flops.py); null off-TPU where no honest peak is known
+        "mfu": round(kernel_mfu, 4) if kernel_mfu is not None else None,
+        "peak_flops": peak,
     }
     if pallas_ms is not None:
         out["kernel_pallas_ms"] = round(pallas_ms, 2)
@@ -371,13 +418,22 @@ def _bench_http_body() -> None:
         f"{platform}{scaled}",
         file=sys.stderr,
     )
+    from oryx_tpu.ops.flops import device_peak_flops, mfu, topk_score_flops
+
+    peak = device_peak_flops("bfloat16")
+    # end-to-end MFU: device FLOPs actually demanded by the HTTP request
+    # stream (2·I·F per request) over chip peak — the gap between this and
+    # the kernel-loop MFU is the host/HTTP tier's cost
+    http_mfu = mfu(qps * topk_score_flops(1, n_items, features), peak)
     print(
         json.dumps(
             {
-                "metric": "als_recommend_http_qps_1M_items_50f",
+                "metric": _metric_name(
+                    "als_recommend_http_qps", n_items, features, platform
+                ),
                 "value": round(qps, 1),
                 "unit": "qps",
-                "vs_baseline": round(qps / BASELINE_QPS, 2),
+                "vs_baseline": _vs_baseline(qps, n_items, features),
                 "platform": platform,
                 "n_items": n_items,
                 "clients": n_clients,
@@ -388,6 +444,8 @@ def _bench_http_body() -> None:
                 "latency_ms_p99": round(pctl(0.99), 1),
                 "model_host_mb": round(host_mb, 1),
                 "model_device_mb": round(device_mb, 1),
+                "mfu": round(http_mfu, 4) if http_mfu is not None else None,
+                "peak_flops": peak,
             }
         )
     )
@@ -404,11 +462,13 @@ def _bench_train_body() -> None:
     (which also measures the quality cost of the cap=1024 padded-list
     truncation vs the reference's use-everything semantics).
     """
-    import numpy as np
     import jax
 
-    from oryx_tpu.ml.evaluate import auc_mean_per_user
-    from oryx_tpu.ops.als import aggregate_interactions, train_als
+    # shared harness (oryx_tpu/ml/quality.py): the nightly quality gate
+    # runs the SAME build+eval, so the bf16 singularity guard can't
+    # regress between bench runs; the Spark baseline runner consumes the
+    # same synthesized dataset for a like-for-like speedup ratio
+    from oryx_tpu.ml.quality import build_and_evaluate
 
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
@@ -418,96 +478,12 @@ def _bench_train_body() -> None:
         n_users, n_items, nnz = 6_000, 3_700, 1_000_000
     features, iterations = 50, 10
 
-    rng = np.random.default_rng(7)
-    # Zipf-ish item popularity + log-normal user activity (MovieLens shape)
-    # PLUS planted latent structure: users and items carry genres, and most
-    # of a user's interactions stay inside their genre. Without structure
-    # the held-out AUC hovers near the popularity baseline and says nothing
-    # about model quality; with it, a well-trained model must clear ~0.8,
-    # so the reported AUC is a real quality signal (including the quality
-    # cost, if any, of the cap=1024 padded-list truncation).
-    n_genres, in_genre_p = 32, 0.8
-    item_w = 1.0 / np.power(np.arange(1, n_items + 1), 0.9)
-    item_w /= item_w.sum()
-    user_w = rng.lognormal(0.0, 1.1, n_users)
-    user_w /= user_w.sum()
-    item_genre = rng.integers(0, n_genres, n_items)
-    user_genre = rng.integers(0, n_genres, n_users)
-    users = rng.choice(n_users, size=nnz, p=user_w).astype(np.int64)
-    items = rng.choice(n_items, size=nnz, p=item_w).astype(np.int64)
-    # redraw the in-genre portion from the user's own genre, popularity-
-    # weighted within it (one vectorized choice per genre)
-    in_genre = rng.random(nnz) < in_genre_p
-    ug = user_genre[users]
-    for g in range(n_genres):
-        rows = np.nonzero(in_genre & (ug == g))[0]
-        pool = np.nonzero(item_genre == g)[0]
-        if rows.size == 0 or pool.size == 0:
-            continue
-        w = item_w[pool] / item_w[pool].sum()
-        items[rows] = rng.choice(pool, size=rows.size, p=w)
-    values = rng.choice(
-        [0.5, 1, 1.5, 2, 2.5, 3, 3.5, 4, 4.5, 5], size=nnz
-    ).astype(np.float64)
-
-    # ~2% holdout for AUC
-    test_mask = rng.random(nnz) < 0.02
-    tr = ~test_mask
-
-    t0 = time.perf_counter()
-    data = aggregate_interactions(users[tr], items[tr], values[tr], implicit=True)
-    t_agg = time.perf_counter() - t0
-    timings: dict = {}
-    model = train_als(
-        data,
-        features=features,
-        lam=0.01,
-        alpha=1.0,
-        iterations=iterations,
-        implicit=True,
-        # MXU-native einsum inputs; quality-neutral (AUC 0.947 bf16 vs
-        # 0.939 f32 on this generator at the 1M fallback scale) and the
-        # held-out AUC below keeps that claim measured every run
-        compute_dtype="bfloat16",
-        timings=timings,
+    rep = build_and_evaluate(
+        n_users, n_items, nnz, features=features, iterations=iterations,
+        lam=0.01, alpha=1.0, compute_dtype="bfloat16", seed=7,
     )
-    build_s = time.perf_counter() - t0
-
-    # NaN factors would silently zero the AUC (NaN comparisons are all
-    # False) — make the failure mode a first-class diagnostic instead
-    x_np = np.asarray(model.x, dtype=np.float32)
-    y_np = np.asarray(model.y, dtype=np.float32)
-    nan_rows = int(
-        np.isnan(x_np).any(axis=1).sum() + np.isnan(y_np).any(axis=1).sum()
-    )
-
-    # AUC on a user sample (full per-user python loop would dominate the
-    # bench; 2000 users gives a +/-0.005 CI on the mean)
-    uid_to_row = {u: j for j, u in enumerate(model.user_ids)}
-    iid_to_row = {i: j for j, i in enumerate(model.item_ids)}
-    tu_all, ti_all = users[test_mask], items[test_mask]
-    known: dict[int, set[int]] = {}
-    tu, ti = [], []
-    sample_users = set(
-        rng.choice(np.unique(tu_all), size=min(2000, len(np.unique(tu_all))), replace=False).tolist()
-    )
-    for u, i in zip(tu_all, ti_all):
-        if u not in sample_users:
-            continue
-        ur, ir = uid_to_row.get(str(u)), iid_to_row.get(str(i))
-        if ur is None or ir is None:
-            continue
-        tu.append(ur)
-        ti.append(ir)
-    # known (training) items for the sampled users, to exclude as negatives
-    smp = np.isin(users, np.fromiter(sample_users, dtype=np.int64)) & tr
-    for u, i in zip(users[smp], items[smp]):
-        ur, ir = uid_to_row.get(str(u)), iid_to_row.get(str(i))
-        if ur is not None and ir is not None:
-            known.setdefault(ur, set()).add(ir)
-    auc = auc_mean_per_user(
-        model.x, model.y, np.asarray(tu, dtype=np.int64), np.asarray(ti, dtype=np.int64), known
-    )
+    build_s, t_agg, auc = rep.build_s, rep.agg_s, rep.auc
+    nan_rows, timings = rep.nan_rows, rep.timings
 
     scaled = "" if on_accel else f" [CPU-FALLBACK scale: {nnz} interactions]"
     print(
@@ -516,10 +492,29 @@ def _bench_train_body() -> None:
         f"on {platform}{scaled}",
         file=sys.stderr,
     )
+    from oryx_tpu.ops.flops import device_peak_flops, mfu
+
+    # the trainer runs its dominant einsums in bf16 (compute_dtype above)
+    peak = device_peak_flops("bfloat16")
+    train_flops = timings.get("train_flops")
+    train_s = timings.get("train_s") or 0.0
+    train_mfu = (
+        mfu(train_flops / train_s, peak)
+        if train_flops and train_s > 0
+        else None
+    )
+    metric = (
+        "als_build_seconds_ml25m_shape"
+        if nnz == 25_000_000
+        else "als_build_seconds_"
+        + _items_label(nnz)
+        + "_interactions"
+        + ("_cpu" if platform == "cpu" else "")
+    )
     print(
         json.dumps(
             {
-                "metric": "als_build_seconds_ml25m_shape",
+                "metric": metric,
                 "value": round(build_s, 1),
                 "unit": "s",
                 "platform": platform,
@@ -531,7 +526,11 @@ def _bench_train_body() -> None:
                 "agg_s": round(t_agg, 1),
                 "lists_s": round(timings.get("lists_s", 0.0), 1),
                 "compile_s": round(timings.get("compile_s", 0.0), 1),
-                "train_s": round(timings.get("train_s", 0.0), 1),
+                "train_s": round(train_s, 1),
+                # analytic einsum FLOPs (ops/als.py timings) over train_s
+                # and chip peak; null off-TPU
+                "train_flops": train_flops,
+                "mfu": round(train_mfu, 4) if train_mfu is not None else None,
             }
         )
     )
@@ -682,12 +681,21 @@ def _bench_scale_body() -> None:
                 return (n + batch) / (time.perf_counter() - t0), comp
 
             qps, compile_s = timed_qps(1.0)
+            from oryx_tpu.ops.flops import (
+                device_peak_flops, mfu, topk_score_flops,
+            )
+
+            row_mfu = mfu(
+                qps * topk_score_flops(1, n_items, features),
+                device_peak_flops("bfloat16"),
+            )
             row = {
                 "items": n_items, "features": features,
                 "qps": round(qps, 1),
                 "baseline_lsh_qps": base_lsh,
                 "baseline_exact_qps": base_exact,
                 "compile_s": round(compile_s, 1),
+                "mfu": round(row_mfu, 4) if row_mfu is not None else None,
             }
             if base_lsh:
                 row["vs_lsh_baseline"] = round(qps / base_lsh, 1)
@@ -857,12 +865,14 @@ def _run_bench(
     body: str = "_bench_http_body",
     force_cpu: bool = False,
     allow_partial: bool = False,
-) -> dict | None:
-    """Run a bench body in a subprocess; return its parsed JSON or None.
+) -> tuple[str, dict | None]:
+    """Run a bench body in a subprocess; return (status, parsed JSON).
 
-    allow_partial: parse the last complete JSON line even if the body was
-    killed or crashed — for bodies that emit cumulative progress lines
-    (the scaling sweep), a wedge mid-way must not discard finished rows.
+    status is "ok", "timeout" (SIGKILLed at the cap — on the accelerator
+    path this means the transport wedged mid-stage) or "failed". A
+    "timeout"/"failed" can still carry a dict when allow_partial: bodies
+    that emit cumulative progress lines (the scaling sweep) keep their
+    finished rows across a mid-sweep wedge.
     """
     code = (
         (_FORCE_CPU_PREFIX if force_cpu else "")
@@ -871,133 +881,351 @@ def _run_bench(
     )
     rc, stdout, stderr = _run_subprocess(code, env, timeout)
     sys.stderr.write(stderr)
-    if rc is None and not allow_partial:
-        print("bench body timed out", file=sys.stderr)
-        return None
-    if rc is not None and rc != 0 and not allow_partial:
-        print(f"bench body failed rc={rc}", file=sys.stderr)
-        return None
+    status = "ok" if rc == 0 else ("timeout" if rc is None else "failed")
+    if status != "ok":
+        print(f"bench body {body}: {status}", file=sys.stderr)
+        if not allow_partial:
+            return status, None
     for line in reversed(stdout.splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
-                return json.loads(line)
+                return status, json.loads(line)
             except json.JSONDecodeError:
                 continue
-    return None
+    return status, None
+
+
+def _merge_kernel(result: dict, kernel: dict) -> None:
+    result["kernel_qps"] = kernel.get("value")
+    for extra in (
+        "kernel_pallas_ms", "kernel_xla_ms", "pallas_speedup",
+        "kernel_approx_ms",
+    ):
+        if extra in kernel:
+            result[extra] = kernel[extra]
+    if kernel.get("mfu") is not None:
+        result["kernel_mfu"] = kernel["mfu"]
+
+
+def _merge_train(result: dict, train: dict) -> None:
+    result["als_build_seconds"] = train.get("value")
+    result["als_build_auc"] = train.get("auc")
+    result["als_build_interactions"] = train.get("interactions")
+    for part in ("agg_s", "lists_s", "compile_s", "train_s"):
+        if part in train:
+            result[f"als_build_{part}"] = train[part]
+    if train.get("factor_nan_rows"):
+        result["als_factor_nan_rows"] = train["factor_nan_rows"]
+    if train.get("mfu") is not None:
+        result["train_mfu"] = train["mfu"]
+    if train.get("train_flops") is not None:
+        result["train_flops"] = train["train_flops"]
+
+
+def _merge_speed(result: dict, speed: dict) -> None:
+    result["speed_events_per_sec"] = speed.get("value")
+
+
+def _merge_kmeans_rdf(result: dict, kr: dict) -> None:
+    result["kmeans_build_seconds"] = kr.get("kmeans_seconds")
+    result["rdf_build_seconds"] = kr.get("rdf_seconds")
+
+
+def _merge_scaling(result: dict, sc: dict) -> None:
+    if sc.get("rows"):
+        result["scaling"] = sc["rows"]
+
+
+_SUITE_STAGES = (
+    # (body, stage cap seconds, allow_partial, merge)
+    ("_bench_body", 300, False, _merge_kernel),
+    ("_bench_train_body", 600, False, _merge_train),
+    ("_bench_speed_body", 300, False, _merge_speed),
+    ("_bench_kmeans_rdf_body", 420, False, _merge_kmeans_rdf),
+    ("_bench_scale_body", 900, True, _merge_scaling),
+)
+
+# worst-case wall-clock of a full suite on a cold accelerator: the stage
+# caps above + the 420s primary; a healthy TPU window must be at least
+# this far from the global deadline to be worth entering
+_SUITE_BUDGET = 420 + sum(s[1] for s in _SUITE_STAGES)
+
+
+def _run_suite(
+    env: dict, *, force_cpu: bool, deadline: float, errors: list[str]
+) -> tuple[dict | None, bool]:
+    """Run the full measured sequence (HTTP primary, then kernel / train /
+    speed / kmeans+rdf / scaling), merged into one dict.
+
+    Returns (result, wedged). On the accelerator path a stage TIMEOUT
+    means the transport wedged mid-suite: abort immediately so the caller
+    can resume waiting for a healthy window, instead of letting every
+    remaining stage burn its own cap against a dead device.
+    """
+    left = lambda cap: max(30.0, min(cap, deadline - time.monotonic()))
+    tag = "cpu" if force_cpu else "accel"
+    granted = left(420)
+    status, result = _run_bench(env, timeout=granted, force_cpu=force_cpu)
+    if result is None:
+        errors.append(f"http bench ({tag}) {status}")
+        # a stage killed because the global deadline clamped its cap is
+        # budget exhaustion, not a transport wedge — don't send the
+        # caller back to the wait loop over it
+        wedge = status == "timeout" and not force_cpu and granted >= 419
+        return None, wedge
+    for body, cap, allow_partial, merge in _SUITE_STAGES:
+        granted = left(cap)
+        status, out = _run_bench(
+            env, timeout=granted, body=body, force_cpu=force_cpu,
+            allow_partial=allow_partial,
+        )
+        if out is not None:
+            merge(result, out)
+        if status != "ok":
+            if status == "timeout" and granted < cap - 1:
+                errors.append(f"{body} ({tag}) budget-exhausted")
+                result["suite_aborted_at"] = body
+                return result, False
+            errors.append(f"{body} ({tag}) {status}")
+            if status == "timeout" and not force_cpu:
+                result["suite_aborted_at"] = body
+                return result, True
+    return result, False
+
+
+def _attach_spark_baseline(result: dict, deadline: float) -> None:
+    """BASELINE.md demands a measured Spark-MLlib denominator for the
+    >=20x training target. Three paths, in order: a previously measured
+    number via ORYX_SPARK_BASELINE_S (from tools/spark_baseline.py on a
+    Spark-capable host); a live run when pyspark is importable and budget
+    remains; otherwise record the blocker explicitly so the ratio reads
+    as unmeasured, never as implied."""
+    build_s = result.get("als_build_seconds")
+    nnz = result.get("als_build_interactions")
+    env_s = os.environ.get("ORYX_SPARK_BASELINE_S")
+    if env_s:
+        spark_s = float(env_s)
+        # the ratio is only honest at matching scale: a 25M Spark
+        # wall-clock over a 1M CPU-fallback build would inflate the
+        # speedup ~25x (ORYX_SPARK_BASELINE_INTERACTIONS records the
+        # scale the Spark number was measured at; runner default 25M)
+        spark_nnz = int(
+            os.environ.get("ORYX_SPARK_BASELINE_INTERACTIONS", "25000000")
+        )
+        result["spark_baseline_seconds"] = spark_s
+        result["spark_baseline_interactions"] = spark_nnz
+        result["spark_baseline_source"] = "ORYX_SPARK_BASELINE_S"
+        if build_s and nnz == spark_nnz:
+            result["speedup_vs_mllib"] = round(spark_s / build_s, 1)
+        else:
+            result["speedup_vs_mllib"] = None
+        return
+    try:
+        import pyspark  # noqa: F401 - availability probe only
+    except ImportError:
+        result["spark_baseline"] = {
+            "status": "unmeasured",
+            "reason": "pyspark is not installed and this host has no "
+            "package egress; run tools/spark_baseline.py on a "
+            "Spark-capable host (same synthesized dataset, the "
+            "reference's exact ALS.trainImplicit call) and pass the "
+            "result via ORYX_SPARK_BASELINE_S",
+        }
+        result["speedup_vs_mllib"] = None
+        return
+    if not nnz or time.monotonic() + 600 > deadline:
+        result["spark_baseline"] = {
+            "status": "unmeasured",
+            "reason": "pyspark present but no budget left for a "
+            "like-for-like run; use tools/spark_baseline.py",
+        }
+        result["speedup_vs_mllib"] = None
+        return
+    cap = min(3600.0, deadline - time.monotonic() - 60)
+    rc, stdout, stderr = _run_subprocess(
+        f"import runpy, sys; sys.argv = ['spark_baseline', "
+        f"'--interactions', '{nnz}']; "
+        f"runpy.run_path({os.path.join(HERE, 'tools', 'spark_baseline.py')!r}, "
+        f"run_name='__main__')",
+        _cpu_env(),
+        cap,
+    )
+    sys.stderr.write(stderr[-2000:])
+    parsed = None
+    for line in reversed(stdout.splitlines()):
+        if line.strip().startswith("{"):
+            try:
+                parsed = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+    if parsed and parsed.get("value"):
+        result["spark_baseline_seconds"] = parsed["value"]
+        result["spark_baseline_source"] = "live"
+        if build_s:
+            result["speedup_vs_mllib"] = round(parsed["value"] / build_s, 1)
+    else:
+        result["spark_baseline"] = {
+            "status": "failed",
+            "reason": f"live pyspark run rc={rc}",
+        }
+        result["speedup_vs_mllib"] = None
 
 
 def main() -> None:
+    """Emit ONE final JSON line (progress lines precede it; the driver
+    parses the LAST parseable line, so a kill mid-run still leaves the
+    best artifact so far on record).
+
+    Round-3 orchestration (round-2 verdict #1): the tunneled TPU wedges
+    for hours with healthy windows between. Two probe attempts then CPU
+    was round 2's answer; now we PERSIST — probe on an interval across
+    the whole budget (ORYX_BENCH_BUDGET_S, default 3h), run the full
+    accelerator suite inside any healthy window, and only let the
+    forced-CPU artifact (captured early, honestly labeled *_cpu with
+    vs_baseline null) stand if no window ever opens.
+    """
+    t0 = time.monotonic()
+    budget = float(os.environ.get("ORYX_BENCH_BUDGET_S", "10800"))
+    poll_s = float(os.environ.get("ORYX_BENCH_POLL_S", "60"))
+    deadline = t0 + budget
     errors: list[str] = []
-    deadline = time.monotonic() + 3000  # overall wall-clock budget: the
-    # stage caps (probes + http + kernel + train + speed + kmeans/rdf +
-    # scaling sweep) sum to ~2700s worst case on a cold accelerator; the
-    # budget must cover that sum or the floor in left() starves the late
-    # stages into guaranteed 30s SIGKILLs
-    left = lambda cap: max(30.0, min(cap, deadline - time.monotonic()))
-
-    # 1. try the default platform (real TPU on the bench host), with retries
-    #    — round 1 showed a single wedged init attempt, so retry before
-    #    giving up on the accelerator entirely.
     default_env = dict(os.environ)
-    platform = None
-    for attempt in range(2):
-        platform = _probe_backend(default_env, timeout=left(120))
-        if platform is not None:
-            break
-        errors.append(f"backend probe attempt {attempt + 1} failed/hung")
-        time.sleep(5)
+    probes = 0
+    healthy_probes = 0
 
-    result = None
-    env_used = default_env
-    forced = False
-    if platform is not None:
-        result = _run_bench(default_env, timeout=left(420))
-        if result is None:
-            errors.append(f"bench on '{platform}' failed")
-
-    # 2. CPU fallback: always produces a number, flagged as degraded
-    if result is None:
-        errors.append("falling back to forced-CPU run")
-        env_used, forced = _cpu_env(), True
-        result = _run_bench(env_used, timeout=left(300), force_cpu=True)
-
-    # secondary: raw kernel throughput (device ceiling, no HTTP layer)
-    if result is not None:
-        kernel = _run_bench(
-            env_used, timeout=left(300), body="_bench_body", force_cpu=forced
+    def probe() -> str | None:
+        nonlocal probes, healthy_probes
+        probes += 1
+        p = _probe_backend(
+            default_env, timeout=min(120.0, max(30.0, deadline - time.monotonic()))
         )
-        if kernel is not None:
-            result["kernel_qps"] = kernel.get("value")
-            for extra in (
-                "kernel_pallas_ms", "kernel_xla_ms", "pallas_speedup",
-                "kernel_approx_ms",
-            ):
-                if extra in kernel:
-                    result[extra] = kernel[extra]
+        if p is not None:
+            healthy_probes += 1
+        return p
 
-    # training north star: ALS build at ML-25M shape (BASELINE.json)
-    if result is not None:
-        train = _run_bench(
-            env_used, timeout=left(600), body="_bench_train_body", force_cpu=forced
-        )
-        if train is not None:
-            result["als_build_seconds"] = train.get("value")
-            result["als_build_auc"] = train.get("auc")
-            result["als_build_interactions"] = train.get("interactions")
-            for part in ("agg_s", "lists_s", "compile_s", "train_s"):
-                if part in train:
-                    result[f"als_build_{part}"] = train[part]
-            if train.get("factor_nan_rows"):
-                result["als_factor_nan_rows"] = train["factor_nan_rows"]
-        else:
-            errors.append("training bench failed")
-
-    # speed tier: micro-batch fold-in throughput
-    if result is not None:
-        speed = _run_bench(
-            env_used, timeout=left(300), body="_bench_speed_body", force_cpu=forced
-        )
-        if speed is not None:
-            result["speed_events_per_sec"] = speed.get("value")
-        else:
-            errors.append("speed bench failed")
-
-    # the other two model families: k-means + forest build wall-clocks
-    if result is not None:
-        kr = _run_bench(
-            env_used, timeout=left(420), body="_bench_kmeans_rdf_body",
-            force_cpu=forced,
-        )
-        if kr is not None:
-            result["kmeans_build_seconds"] = kr.get("kmeans_seconds")
-            result["rdf_build_seconds"] = kr.get("rdf_seconds")
-        else:
-            errors.append("kmeans/rdf bench failed")
-
-    # the reference's full (items x features) serving grid, exact scoring
-    if result is not None:
-        sc = _run_bench(
-            env_used, timeout=left(600), body="_bench_scale_body",
-            force_cpu=forced, allow_partial=True,
-        )
-        if sc is not None and sc.get("rows"):
-            result["scaling"] = sc["rows"]
-        else:
-            errors.append("scaling sweep failed")
-
-    if result is None:
-        result = {
-            "metric": "als_recommend_http_qps_1M_items_50f",
-            "value": 0.0,
-            "unit": "qps",
-            "vs_baseline": 0.0,
+    def finish(result: dict, forced: bool) -> None:
+        result["tpu_wait"] = {
+            "probes": probes,
+            "healthy_probes": healthy_probes,
+            "waited_s": round(time.monotonic() - t0),
+            "budget_s": round(budget),
         }
-        errors.append("cpu fallback also failed")
+        try:
+            _attach_spark_baseline(result, deadline)
+        except Exception as e:  # noqa: BLE001 - never lose the artifact
+            errors.append(f"spark baseline attach failed: {e}")
+        if forced:
+            errors.append(
+                "no healthy accelerator window in budget; forced-CPU artifact"
+            )
+        if errors:
+            # dedupe while keeping order: hours of polling can repeat the
+            # same wedge message hundreds of times
+            seen: dict[str, int] = {}
+            for e in errors:
+                seen[e] = seen.get(e, 0) + 1
+            result["error"] = "; ".join(
+                e if n == 1 else f"{e} (x{n})" for e, n in seen.items()
+            )
+        print(json.dumps(result), flush=True)
 
-    if errors:
-        result["error"] = "; ".join(errors)
-    print(json.dumps(result))
+    # 1. accelerator first: if the tunnel is healthy right now, don't burn
+    #    time on the CPU fallback at all
+    accel_failures = 0  # non-wedge crashes on a healthy device: a real
+    # bug, not an outage — retrying it all budget long helps nobody
+    platform = probe()
+    if platform is not None and platform != "cpu":
+        result, wedged = _run_suite(
+            default_env, force_cpu=False, deadline=deadline, errors=errors
+        )
+        if result is not None and not wedged:
+            finish(result, forced=False)
+            return
+        if result is None and not wedged:
+            accel_failures += 1
+        best_tpu = result  # possibly partial (wedged mid-suite)
+    else:
+        if platform == "cpu":
+            # no accelerator attached at all — the forced-CPU run IS the
+            # honest platform; skip the wait loop
+            result, _ = _run_suite(
+                _cpu_env(), force_cpu=True, deadline=deadline, errors=errors
+            )
+            finish(result or {"metric": "als_recommend_http_qps", "value": 0.0,
+                              "unit": "qps", "vs_baseline": None}, forced=False)
+            return
+        errors.append("initial backend probe failed/hung")
+        best_tpu = None
+
+    # 2. safety artifact: the forced-CPU suite, honestly labeled, printed
+    #    as an interim line so even a driver kill mid-wait leaves a
+    #    parseable, truthful artifact on record
+    cpu_errors: list[str] = []
+    cpu_deadline = min(deadline, time.monotonic() + 1500)
+    cpu_result, _ = _run_suite(
+        _cpu_env(), force_cpu=True, deadline=cpu_deadline, errors=cpu_errors
+    )
+    if cpu_result is not None:
+        interim = dict(cpu_result)
+        interim["interim"] = True
+        interim["error"] = "; ".join(
+            errors + cpu_errors + ["interim CPU artifact; waiting for a "
+                                   "healthy accelerator window"]
+        )
+        print(json.dumps(interim), flush=True)
+    else:
+        errors.extend(cpu_errors)
+
+    # 3. persist: poll for a healthy window for the rest of the budget,
+    #    keeping enough headroom to actually run the suite in it
+    # entering with less than the full _SUITE_BUDGET is fine — late
+    # windows still capture the leading stages, and deadline-clamped
+    # stages are labeled budget-exhausted (not wedged) by _run_suite —
+    # but below ~2 stages' worth there is nothing left worth measuring
+    while (
+        accel_failures < 2
+        and time.monotonic() + max(600.0, 0.2 * _SUITE_BUDGET) < deadline
+    ):
+        time.sleep(poll_s)
+        platform = probe()
+        if platform is None or platform == "cpu":
+            continue
+        print(
+            f"healthy accelerator window after {round(time.monotonic() - t0)}s "
+            f"({probes} probes) — running suite", file=sys.stderr,
+        )
+        result, wedged = _run_suite(
+            default_env, force_cpu=False, deadline=deadline, errors=errors
+        )
+        if result is not None and not wedged:
+            finish(result, forced=False)
+            return
+        if result is None and not wedged:
+            accel_failures += 1
+            continue
+        if result is not None and (
+            best_tpu is None or len(result) >= len(best_tpu)
+        ):
+            best_tpu = result  # keep the most complete partial
+        errors.append("suite wedged mid-run; resuming wait")
+
+    # 4. deadline: best partial accelerator artifact beats the CPU one
+    if best_tpu is not None:
+        best_tpu["partial"] = True
+        finish(best_tpu, forced=False)
+    elif cpu_result is not None:
+        # the standing artifact must carry the CPU suite's own stage
+        # errors, not just the wait-loop's (they explain missing fields)
+        errors.extend(e for e in cpu_errors if e not in errors)
+        finish(cpu_result, forced=True)
+    else:
+        finish(
+            {"metric": "als_recommend_http_qps", "value": 0.0, "unit": "qps",
+             "vs_baseline": None},
+            forced=True,
+        )
 
 
 if __name__ == "__main__":

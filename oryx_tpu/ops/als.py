@@ -467,6 +467,20 @@ def train_als(
             # AOT lower/compile so the one-time XLA compile is measured
             # apart from the compute it amortizes into
             timings["lists_s"] = _time.perf_counter() - t_mark
+            # analytic FLOPs of the whole build (dominant einsum terms
+            # only — ops/flops.py): benchmarks divide by train_s and the
+            # chip peak for an honest MFU figure
+            from oryx_tpu.ops.flops import als_halfstep_flops
+
+            flops_half_u = sum(
+                als_halfstep_flops(b[1].shape[0], b[1].shape[1], features, 0)
+                for b in u_buckets
+            ) + 2.0 * n_i_pad * features * features
+            flops_half_i = sum(
+                als_halfstep_flops(b[1].shape[0], b[1].shape[1], features, 0)
+                for b in i_buckets
+            ) + 2.0 * n_u_pad * features * features
+            timings["train_flops"] = iterations * (flops_half_u + flops_half_i)
             t_mark = _time.perf_counter()
             compiled = als_train_bucketed_jit.lower(*args, **kwargs).compile()
             timings["compile_s"] = _time.perf_counter() - t_mark

@@ -195,6 +195,22 @@ class AsyncHTTPServer:
                 if task is not None:
                     self._conns[task] = False  # request in flight
 
+                if head == b"PRI * HTTP/2.0\r\n\r\n":
+                    # HTTP/2 with prior knowledge (also the path ALPN-
+                    # negotiated h2-over-TLS arrives on): consume the
+                    # rest of the 24-byte preface and hand over
+                    from oryx_tpu.serving.http2 import Http2Connection
+
+                    rest = await asyncio.wait_for(
+                        reader.readexactly(6), timeout=READ_TIMEOUT
+                    )
+                    if rest != b"SM\r\n\r\n":
+                        return
+                    await Http2Connection(self, reader, writer).run(
+                        preface_read=True
+                    )
+                    return
+
                 lines = head.split(b"\r\n")
                 try:
                     method_b, target_b, version_b = lines[0].split(b" ", 2)
@@ -240,6 +256,30 @@ class AsyncHTTPServer:
                     ):
                         return
 
+                connection_opts = {
+                    t.strip().lower()
+                    for t in headers.get("connection", "").split(",")
+                }
+                if (
+                    "upgrade" in connection_opts
+                    and headers.get("upgrade", "").lower() == "h2c"
+                    and "http2-settings" in headers
+                ):
+                    # h2c upgrade (RFC 7540 §3.2): 101, then serve the
+                    # original request as stream 1 over h2
+                    from oryx_tpu.serving.http2 import Http2Connection
+
+                    writer.write(
+                        b"HTTP/1.1 101 Switching Protocols\r\n"
+                        b"Connection: Upgrade\r\nUpgrade: h2c\r\n\r\n"
+                    )
+                    await writer.drain()
+                    await Http2Connection(
+                        self, reader, writer,
+                        upgraded_request=(method, target, headers, body),
+                    ).run(preface_read=False)
+                    return
+
                 keep_alive = (
                     headers.get("connection", "").lower() != "close"
                     and version_b != b"HTTP/1.0"
@@ -256,35 +296,32 @@ class AsyncHTTPServer:
             except Exception:
                 pass
 
-    async def _handle_request(
+    async def _process(
         self,
-        writer: asyncio.StreamWriter,
         method: str,
         target: str,
         headers: dict[str, str],
         body: bytes,
-    ) -> None:
+    ) -> tuple[int, bytes, str, tuple[tuple[str, str], ...]]:
+        """Auth + gzip-decode + route dispatch, shared by the HTTP/1.1
+        loop and the HTTP/2 streams (serving/http2.py): returns (status,
+        payload, content-type, extra response headers)."""
         if self.auth is not None:
             verdict = self.auth.check(method, target, headers.get("authorization"))
             if verdict is not True:
-                payload = b'{"status":401,"error":"unauthorized"}'
-                await self._write_response(
-                    writer,
+                return (
                     401,
-                    payload,
+                    b'{"status":401,"error":"unauthorized"}',
                     "application/json",
-                    method,
-                    extra=(("WWW-Authenticate", verdict),),
+                    (("WWW-Authenticate", verdict),),
                 )
-                return
 
         split = urlsplit(target)
         if headers.get("content-encoding", "").lower() == "gzip" and body:
             try:
                 body = gzip.decompress(body)
             except OSError:
-                await self._simple_response(writer, 400, b"bad gzip body")
-                return
+                return 400, b"bad gzip body", "text/plain", ()
         req = Request(
             method=method,
             path=split.path,
@@ -307,10 +344,22 @@ class AsyncHTTPServer:
         except Exception:  # pragma: no cover - dispatch renders its own 500s
             log.exception("dispatch failed")
             status, payload, ctype = 500, b"internal error", "text/plain"
+        return status, payload, ctype, ()
 
+    async def _handle_request(
+        self,
+        writer: asyncio.StreamWriter,
+        method: str,
+        target: str,
+        headers: dict[str, str],
+        body: bytes,
+    ) -> None:
+        status, payload, ctype, extra = await self._process(
+            method, target, headers, body
+        )
         gzip_ok = "gzip" in headers.get("accept-encoding", "").lower()
         await self._write_response(
-            writer, status, payload, ctype, method, gzip_ok=gzip_ok
+            writer, status, payload, ctype, method, gzip_ok=gzip_ok, extra=extra
         )
 
     async def _write_response(

@@ -193,6 +193,11 @@ class TopKBatcher:
         self.coalesced = 0
         self.host_fallbacks = 0
         self.device_failovers = 0
+        # analytic FLOPs dispatched to the device (2·B·I·F per group,
+        # ops/flops.py): rate(oryx_topk_flops_total) / oryx_device_peak_flops
+        # is the serving MFU over any scrape interval
+        self.flops_scored = 0.0
+        self._peak_flops: float | None | type(...) = ...  # lazy, cached
 
     def register_gauges(self) -> None:
         """Expose the batcher's counters as callback gauges on the global
@@ -217,8 +222,40 @@ class TopKBatcher:
             ("oryx_topk_device_down",
              "1 while top-k serving is on the degraded host path",
              lambda: 1.0 if self._device_down.is_set() else 0.0),
+            ("oryx_topk_flops_total",
+             "analytic FLOPs dispatched to device top-k scoring "
+             "(rate over oryx_device_peak_flops = serving MFU)",
+             lambda: float(self.flops_scored)),
+            ("oryx_device_peak_flops",
+             "dense bf16 peak FLOP/s of the serving chip (0 when unknown "
+             "or not a TPU)",
+             lambda: float(self._device_peak() or 0.0)),
         ):
             reg.gauge(name, help_text).set_function(fn)
+
+    def _device_peak(self) -> float | None:
+        # NEVER resolve this on the scrape path: jax.devices() initializes
+        # the backend, and on a wedged remote transport that hangs forever
+        # — a /metrics GET must not be able to wedge the server (verified
+        # the hard way on this host). _note_device() fills it in from an
+        # array that is already on-device at dispatch time.
+        return None if self._peak_flops is ... else self._peak_flops
+
+    def _note_device(self, y) -> None:
+        if self._peak_flops is not ...:
+            return
+        try:
+            d = next(iter(y.devices()))
+            if getattr(d, "platform", "") == "tpu":
+                from oryx_tpu.ops.flops import peak_flops_for_kind
+
+                self._peak_flops = peak_flops_for_kind(
+                    getattr(d, "device_kind", "") or ""
+                )
+            else:
+                self._peak_flops = None
+        except Exception:  # non-jax stub matrices in tests
+            self._peak_flops = None
 
     # -- public API --------------------------------------------------------
 
@@ -389,6 +426,8 @@ class TopKBatcher:
                 y = group[0].y
                 self._last_y = y  # recovery probes re-test against this
                 b = len(group)
+                self.flops_scored += 2.0 * b * y.shape[0] * y.shape[1]
+                self._note_device(y)
                 padded = _next_pow2(b)
                 xs = np.zeros((padded, y.shape[1]), dtype=np.float32)
                 for i, p in enumerate(group):

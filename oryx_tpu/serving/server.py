@@ -128,6 +128,7 @@ class ServingLayer:
 
         self.app = ServingApp(self.config, self.model_manager, input_producer)
         auth = make_authenticator(self.config)
+        frontend = self.config.get_string("oryx.serving.api.server", "async")
         cert = self.config.get_string("oryx.serving.api.ssl-cert-file", None)
         key = self.config.get_string("oryx.serving.api.ssl-key-file", None)
         ctx = None
@@ -140,6 +141,17 @@ class ServingLayer:
 
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(cert, key or None)
+            if frontend == "async":
+                try:
+                    # advertise h2 via ALPN (the reference's Tomcat
+                    # connector does the same, ServingLayer.java:229); a
+                    # client that negotiates h2 sends the connection
+                    # preface, which the async frontend detects. The
+                    # threaded frontend can't speak h2, so advertising it
+                    # there would break every h2-capable TLS client.
+                    ctx.set_alpn_protocols(["h2", "http/1.1"])
+                except NotImplementedError:  # pragma: no cover - old ssl
+                    pass
             # bind the secure connector on secure-port only when one is
             # EXPLICITLY configured (default null): a packaged default
             # would silently clobber `port` for every TLS deployment.
@@ -157,7 +169,6 @@ class ServingLayer:
                     "would bind secure-port's default 443 here)", self.port,
                 )
 
-        frontend = self.config.get_string("oryx.serving.api.server", "async")
         if frontend == "async":
             from oryx_tpu.serving.aserver import AsyncHTTPServer
 

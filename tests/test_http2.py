@@ -1,0 +1,255 @@
+"""HTTP/2 frontend: negotiation and stream semantics (round-2 verdict #6).
+
+The reference's Tomcat connector upgrades to h2 (ServingLayer.java:229
+addUpgradeProtocol(new Http2Protocol())); the asyncio frontend implements
+RFC 7540 + 7541 from scratch (serving/http2.py, serving/hpack.py).
+
+Fidelity comes from TWO client sides: curl/nghttp2 (a real, independent
+h2 stack — prior knowledge, h2c upgrade, POST bodies, and ALPN over TLS)
+and a raw-socket client driving interleaved streams to prove actual
+multiplexing onto the deferred dispatch path.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import socket
+import ssl
+import struct
+import subprocess
+
+import pytest
+
+from tests.test_aserver import _config, _setup_bus, _wait_ready
+from oryx_tpu.serving.server import ServingLayer
+
+curl = shutil.which("curl")
+needs_curl = pytest.mark.skipif(curl is None, reason="curl not available")
+
+
+def _curl(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [curl, "-s", "-i", "--max-time", "20", *args],
+        capture_output=True,
+        text=True,
+        timeout=30,
+    )
+
+
+@needs_curl
+def test_prior_knowledge_negotiation():
+    bus = "mem://h2pk"
+    _setup_bus(bus)
+    with ServingLayer(_config(bus, "async")) as sl:
+        _wait_ready(sl.port)
+        r = _curl(
+            "--http2-prior-knowledge",
+            f"http://127.0.0.1:{sl.port}/distinct",
+        )
+        assert r.returncode == 0, r.stderr
+        assert r.stdout.startswith("HTTP/2 200"), r.stdout[:200]
+        body = r.stdout.replace("\r\n", "\n").rsplit("\n\n", 1)[-1]
+        assert json.loads(body.strip())["word"] == 2
+
+        # a second, fresh curl against the same server (this curl
+        # 7.88.1 has the known h2 connection-REUSE client bug — reuse
+        # and true multiplexing are proven by the raw-socket tests
+        # below instead)
+        r = _curl(
+            "--http2-prior-knowledge",
+            f"http://127.0.0.1:{sl.port}/ready",
+        )
+        assert r.stdout.startswith("HTTP/2 200")
+
+
+@needs_curl
+def test_h2c_upgrade():
+    """curl --http2 on cleartext sends Upgrade: h2c; the response must
+    come back 101 + HTTP/2 on stream 1."""
+    bus = "mem://h2up"
+    _setup_bus(bus)
+    with ServingLayer(_config(bus, "async")) as sl:
+        _wait_ready(sl.port)
+        r = _curl("--http2", f"http://127.0.0.1:{sl.port}/distinct")
+        assert "101 Switching Protocols" in r.stdout, r.stdout[:300]
+        assert "HTTP/2 200" in r.stdout
+        body = r.stdout.replace("\r\n", "\n").rsplit("\n\n", 1)[-1]
+        assert json.loads(body.strip())["word"] == 2
+
+
+@needs_curl
+def test_h2_post_body_and_404():
+    bus = "mem://h2post"
+    _setup_bus(bus)
+    with ServingLayer(_config(bus, "async")) as sl:
+        _wait_ready(sl.port)
+        r = _curl(
+            "--http2-prior-knowledge",
+            "-X", "POST", "--data-binary", "hello h2 ingest",
+            f"http://127.0.0.1:{sl.port}/add/w",
+        )
+        assert r.stdout.startswith("HTTP/2 2"), r.stdout[:200]
+        r404 = _curl(
+            "--http2-prior-knowledge",
+            f"http://127.0.0.1:{sl.port}/no-such-endpoint",
+        )
+        assert r404.stdout.startswith("HTTP/2 404")
+
+
+@needs_curl
+def test_alpn_h2_over_tls(tmp_path):
+    if shutil.which("openssl") is None:
+        pytest.skip("openssl not available")
+    cert, key = tmp_path / "c.pem", tmp_path / "k.pem"
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(key), "-out", str(cert), "-days", "1",
+            "-subj", "/CN=localhost",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    bus = "mem://h2tls"
+    _setup_bus(bus)
+    cfg = _config(
+        bus, "async",
+        **{
+            "oryx.serving.api.ssl-cert-file": str(cert),
+            "oryx.serving.api.ssl-key-file": str(key),
+        },
+    )
+    with ServingLayer(cfg) as sl:
+        # TLS handshake readiness: poll with a plain connect
+        import time
+
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                with socket.create_connection(("127.0.0.1", sl.port), 2):
+                    break
+            except OSError:
+                time.sleep(0.1)
+        # ALPN check straight from the ssl module: the server must offer h2
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        ctx.set_alpn_protocols(["h2", "http/1.1"])
+        with socket.create_connection(("127.0.0.1", sl.port), 5) as raw:
+            with ctx.wrap_socket(raw, server_hostname="localhost") as tls:
+                assert tls.selected_alpn_protocol() == "h2"
+        r = _curl(
+            "--http2", "-k", f"https://127.0.0.1:{sl.port}/distinct"
+        )
+        assert r.stdout.startswith("HTTP/2 200"), r.stdout[:200]
+
+
+def _read_frame(sock_file):
+    head = sock_file.read(9)
+    assert len(head) == 9
+    length = int.from_bytes(head[:3], "big")
+    ftype, flags = head[3], head[4]
+    sid = int.from_bytes(head[5:9], "big") & 0x7FFFFFFF
+    return ftype, flags, sid, sock_file.read(length)
+
+
+def _frame(ftype, flags, sid, payload=b""):
+    return (
+        struct.pack(">I", len(payload))[1:]
+        + bytes([ftype, flags])
+        + struct.pack(">I", sid)
+        + payload
+    )
+
+
+def test_multiplexed_streams_raw():
+    """Two GETs opened back-to-back before reading any response: both
+    must complete on one connection — the h2 layer dispatches each
+    stream as its own task on the shared deferred path."""
+    from oryx_tpu.serving.hpack import Decoder, encode
+
+    bus = "mem://h2mux"
+    _setup_bus(bus)
+    with ServingLayer(_config(bus, "async")) as sl:
+        _wait_ready(sl.port)
+        with socket.create_connection(("127.0.0.1", sl.port), 10) as s:
+            s.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
+            s.sendall(_frame(0x4, 0, 0))  # empty SETTINGS
+            f = s.makefile("rb")
+
+            def req(sid, path):
+                block = encode(
+                    [
+                        (b":method", b"GET"),
+                        (b":scheme", b"http"),
+                        (b":path", path.encode()),
+                        (b":authority", b"localhost"),
+                    ]
+                )
+                # END_STREAM | END_HEADERS
+                s.sendall(_frame(0x1, 0x1 | 0x4, sid, block))
+
+            req(1, "/distinct")
+            req(3, "/ready")
+
+            dec = Decoder()
+            got: dict[int, dict] = {}
+            bodies: dict[int, bytes] = {}
+            ended: set[int] = set()
+            while len(ended) < 2:
+                ftype, flags, sid, payload = _read_frame(f)
+                if ftype == 0x4 and not flags & 0x1:
+                    s.sendall(_frame(0x4, 0x1, 0))  # ack server SETTINGS
+                elif ftype == 0x1:
+                    got[sid] = dict(dec.decode(payload))
+                    if flags & 0x1:
+                        ended.add(sid)
+                elif ftype == 0x0:
+                    bodies[sid] = bodies.get(sid, b"") + payload
+                    if flags & 0x1:
+                        ended.add(sid)
+            assert got[1][b":status"] == b"200"
+            assert got[3][b":status"] == b"200"
+            assert json.loads(bodies[1])["word"] == 2
+            # GOAWAY for a clean close
+            s.sendall(_frame(0x7, 0, 0, struct.pack(">II", 0, 0)))
+
+
+def test_rst_stream_cancels_cleanly():
+    """A reset stream must not poison the connection: a follow-up request
+    on the same connection still completes."""
+    from oryx_tpu.serving.hpack import Decoder, encode
+
+    bus = "mem://h2rst"
+    _setup_bus(bus)
+    with ServingLayer(_config(bus, "async")) as sl:
+        _wait_ready(sl.port)
+        with socket.create_connection(("127.0.0.1", sl.port), 10) as s:
+            s.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
+            s.sendall(_frame(0x4, 0, 0))
+            f = s.makefile("rb")
+            block = encode(
+                [
+                    (b":method", b"GET"),
+                    (b":scheme", b"http"),
+                    (b":path", b"/distinct"),
+                    (b":authority", b"localhost"),
+                ]
+            )
+            s.sendall(_frame(0x1, 0x5, 1, block))
+            s.sendall(_frame(0x3, 0, 1, struct.pack(">I", 0x8)))  # RST CANCEL
+            s.sendall(_frame(0x1, 0x5, 3, block))
+            dec = Decoder()
+            status3 = None
+            while status3 is None:
+                ftype, flags, sid, payload = _read_frame(f)
+                if ftype == 0x4 and not flags & 0x1:
+                    s.sendall(_frame(0x4, 0x1, 0))
+                elif ftype == 0x1:
+                    hdrs = dict(dec.decode(payload))
+                    if sid == 3:
+                        status3 = hdrs[b":status"]
+                elif ftype == 0x0 and sid == 1:
+                    pass  # stream 1 may have raced its response out
+            assert status3 == b"200"

@@ -484,5 +484,6 @@ def test_train_timings_breakdown_matches_normal_path():
     # on backends with nondeterministic autotuning
     np.testing.assert_allclose(m1.x, m2.x, rtol=1e-6, atol=1e-7)
     np.testing.assert_allclose(m1.y, m2.y, rtol=1e-6, atol=1e-7)
-    assert set(t) == {"lists_s", "compile_s", "train_s"}
+    assert set(t) == {"lists_s", "compile_s", "train_s", "train_flops"}
+    assert t["train_flops"] > 0
     assert all(v >= 0 for v in t.values())

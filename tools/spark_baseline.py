@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Measure the Spark-MLlib ALS baseline for BASELINE.md's north-star ratio.
+
+The reference delegates batch training to Spark MLlib and publishes no
+wall-clock numbers (docs/docs/performance.html, "Batch Layer"); the
+target "ALS build at MovieLens-25M scale >= 20x faster than Spark-MLlib"
+therefore needs a freshly measured denominator. This runner executes the
+reference's exact training call — `new ALS().setRank(features)
+.setIterations(iterations).setLambda(lambda).setImplicitPrefs(true)
+.setAlpha(alpha)` (reference ALSUpdate.java:140-151) — via
+pyspark.mllib.recommendation.ALS.trainImplicit on the SAME synthesized
+dataset (oryx_tpu/ml/synth.py, same seed) the TPU bench trains on.
+
+Usage (any host with pyspark; the TPU bench host has no egress to
+install it, so this ships as a runner + instructions):
+
+    pip install pyspark
+    python tools/spark_baseline.py                    # full ML-25M shape
+    python tools/spark_baseline.py --interactions 1000000   # smoke
+    python tools/spark_baseline.py --master 'local[32]'
+
+Prints ONE JSON line:
+    {"metric": "spark_mllib_als_build_seconds", "value": N, ...}
+Feed that value to bench.py via ORYX_SPARK_BASELINE_S=<N> to populate
+speedup_vs_mllib in the bench artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--users", type=int, default=162_000)
+    ap.add_argument("--items", type=int, default=59_000)
+    ap.add_argument("--interactions", type=int, default=25_000_000)
+    ap.add_argument("--features", type=int, default=50)
+    ap.add_argument("--iterations", type=int, default=10)
+    ap.add_argument("--lam", type=float, default=0.01)
+    ap.add_argument("--alpha", type=float, default=1.0)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument(
+        "--master", default=f"local[{os.cpu_count() or 8}]",
+        help="Spark master (default: local[all cores] — the closest "
+        "single-host analogue to the reference's YARN deployment)",
+    )
+    args = ap.parse_args()
+
+    try:
+        from pyspark import SparkConf, SparkContext
+        from pyspark.mllib.recommendation import ALS, Rating
+    except ImportError:
+        print(
+            json.dumps(
+                {
+                    "metric": "spark_mllib_als_build_seconds",
+                    "value": None,
+                    "unit": "s",
+                    "error": "pyspark not installed on this host "
+                    "(pip install pyspark, then rerun)",
+                }
+            )
+        )
+        return 2
+
+    from oryx_tpu.ml.synth import synthesize_interactions
+
+    print(
+        f"synthesizing {args.interactions} interactions "
+        f"({args.users}x{args.items}, seed {args.seed})...",
+        file=sys.stderr,
+    )
+    users, items, values = synthesize_interactions(
+        args.users, args.items, args.interactions, seed=args.seed
+    )
+
+    conf = (
+        SparkConf()
+        .setAppName("oryx-mllib-als-baseline")
+        .setMaster(args.master)
+        # mirror the reference's serialization choice (common defaults in
+        # oryx deployments); everything else stays stock so the number is
+        # "Spark as the reference shipped it", not a tuned Spark
+        .set("spark.serializer", "org.apache.spark.serializer.KryoSerializer")
+    )
+    sc = SparkContext(conf=conf)
+    sc.setCheckpointDir("/tmp/oryx-spark-checkpoint")
+    try:
+        # ship the data in slices to keep driver memory bounded
+        n_slices = max(8, (args.interactions // 2_000_000) or 8)
+        triples = list(
+            zip(users.tolist(), items.tolist(), values.tolist())
+        )
+        ratings = sc.parallelize(triples, n_slices).map(
+            lambda t: Rating(int(t[0]), int(t[1]), float(t[2]))
+        )
+        ratings.cache()
+        ratings.count()  # materialize before the timed region
+
+        t0 = time.perf_counter()
+        # the reference's exact call: rank/iterations/lambda/implicit/alpha
+        # per ALSUpdate.java:140-151 (checkpointInterval 5 likewise)
+        model = ALS.trainImplicit(
+            ratings,
+            rank=args.features,
+            iterations=args.iterations,
+            lambda_=args.lam,
+            alpha=args.alpha,
+        )
+        # force factor materialization — ALS.run is lazy until the factor
+        # RDDs are computed
+        n_u = model.userFeatures().count()
+        n_i = model.productFeatures().count()
+        build_s = time.perf_counter() - t0
+    finally:
+        sc.stop()
+
+    print(
+        json.dumps(
+            {
+                "metric": "spark_mllib_als_build_seconds",
+                "value": round(build_s, 1),
+                "unit": "s",
+                "interactions": args.interactions,
+                "features": args.features,
+                "iterations": args.iterations,
+                "implicit": True,
+                "alpha": args.alpha,
+                "lambda": args.lam,
+                "users_factored": n_u,
+                "items_factored": n_i,
+                "master": args.master,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
