@@ -35,8 +35,36 @@ def _parse_args(argv):
         "command",
         choices=[
             "batch", "speed", "serving", "setup", "tail", "input",
-            "import-pmml", "loadtest", "config",
+            "import-pmml", "loadtest", "config", "pod",
         ],
+    )
+    p.add_argument(
+        "--compute", type=int, default=1,
+        help="pod: total jax.distributed compute (batch) processes in the "
+        "pod across all hosts",
+    )
+    p.add_argument(
+        "--local-start", type=int, default=None,
+        help="pod: first compute process index THIS host runs (default: "
+        "0 — single-host pod runs all of them)",
+    )
+    p.add_argument(
+        "--local-count", type=int, default=None,
+        help="pod: how many compute processes this host runs (default: "
+        "all of --compute)",
+    )
+    p.add_argument(
+        "--coordinator",
+        help="pod: host:port of compute process 0's coordinator (default: "
+        "127.0.0.1:<free port>, valid only for a single-host pod)",
+    )
+    p.add_argument(
+        "--speed", action="store_true",
+        help="pod: also run a speed-layer process on this host",
+    )
+    p.add_argument(
+        "--serving", action="store_true",
+        help="pod: also run a serving-layer process on this host",
     )
     p.add_argument("--conf", help="user config file (HOCON-like key paths)")
     p.add_argument(
@@ -391,6 +419,165 @@ def _supervise_serving_replicas(config: Config, n_procs: int, argv: list[str]) -
     return rc_out
 
 
+def cmd_pod(config: Config, args, raw_argv: list[str]) -> int:
+    """Multi-host pod launcher — the analogue of the reference's
+    oryx-run.sh spark-submit/YARN assembly (deploy/bin/oryx-run.sh:
+    199-235), with the cluster plane replaced by a jax.distributed
+    process group whose global mesh spans the compute processes.
+
+    One command per host brings up that host's slice of the pod:
+
+      host0$ python -m oryx_tpu.cli pod --conf oryx.conf --compute 4 \\
+                 --local-start 0 --local-count 2 \\
+                 --coordinator host0:8476 --serving
+      host1$ python -m oryx_tpu.cli pod --conf oryx.conf --compute 4 \\
+                 --local-start 2 --local-count 2 --coordinator host0:8476
+
+    Compute processes run the batch layer SPMD: each joins the process
+    group (cmd_batch -> init_distributed), and the app updates build
+    their training mesh over the whole pod (mesh_from_config). The
+    speed/serving tiers stay host-local single processes wired only by
+    the shared broker — exactly the reference topology, where only the
+    Spark batch job spans the cluster and the serving tier scales by
+    replicas. Children are supervised: SIGTERM/SIGINT fan out, and any
+    compute member dying tears the pod down (a jax.distributed group is
+    not elastic — a lost member wedges the collectives, so fail fast).
+
+    Single-host default (no --local-*/--coordinator): all compute
+    processes plus the optional tiers run here with an auto-picked
+    coordinator port — the smoke topology
+    (tests/test_pod_cli.py) and the single-TPU-host deployment.
+    """
+    import os
+    import subprocess
+
+    n_compute = max(1, args.compute)
+    local_start = args.local_start if args.local_start is not None else 0
+    local_count = (
+        args.local_count if args.local_count is not None else n_compute
+    )
+    if local_start + local_count > n_compute:
+        raise SystemExit(
+            f"pod: local range [{local_start}, {local_start + local_count})"
+            f" exceeds --compute {n_compute}"
+        )
+    coordinator = args.coordinator
+    if coordinator is None:
+        if local_start != 0 or local_count != n_compute:
+            raise SystemExit(
+                "pod: --coordinator is required when this host runs only "
+                "part of the pod (process 0's host must be reachable)"
+            )
+        from oryx_tpu.common.ioutil import choose_free_port
+
+        coordinator = f"127.0.0.1:{choose_free_port()}"
+
+    # child command = this exact invocation minus the pod-only flags,
+    # with the role substituted — so --conf/--set/env all carry through
+    base_flags: list[str] = []
+    skip_next = False
+    pod_flags = {
+        "--compute", "--local-start", "--local-count", "--coordinator",
+    }
+    for tok in raw_argv:
+        if skip_next:
+            skip_next = False
+            continue
+        if tok == "pod":
+            continue
+        if tok in pod_flags:
+            skip_next = True
+            continue
+        if tok.split("=", 1)[0] in pod_flags or tok in ("--speed", "--serving"):
+            continue
+        base_flags.append(tok)
+
+    def spawn(role: str, extra_sets: list[str]) -> subprocess.Popen:
+        cmd = [sys.executable, "-m", "oryx_tpu.cli", role, *base_flags]
+        for kv in extra_sets:
+            cmd += ["--set", kv]
+        return subprocess.Popen(cmd, env=dict(os.environ))
+
+    children: list[tuple[str, subprocess.Popen]] = []
+    for pid_idx in range(local_start, local_start + local_count):
+        children.append(
+            (
+                f"compute-{pid_idx}",
+                spawn(
+                    "batch",
+                    [
+                        f"oryx.compute.distributed.coordinator-address={coordinator}",
+                        f"oryx.compute.distributed.num-processes={n_compute}",
+                        f"oryx.compute.distributed.process-id={pid_idx}",
+                    ],
+                ),
+            )
+        )
+    # speed/serving do NOT join the compute group: force the distributed
+    # block back to single-process or init_distributed would park them
+    # waiting to be counted as group members
+    solo = [
+        "oryx.compute.distributed.coordinator-address=null",
+        "oryx.compute.distributed.num-processes=1",
+        "oryx.compute.distributed.process-id=0",
+    ]
+    if args.speed:
+        children.append(("speed", spawn("speed", solo)))
+    if args.serving:
+        children.append(("serving", spawn("serving", solo)))
+
+    print(
+        f"pod: compute {local_start}..{local_start + local_count - 1} of "
+        f"{n_compute} @ {coordinator}"
+        + (" + speed" if args.speed else "")
+        + (" + serving" if args.serving else ""),
+        flush=True,
+    )
+
+    stopping = False
+
+    def shut(*_):
+        nonlocal stopping
+        stopping = True
+        for _, c in children:
+            if c.poll() is None:
+                c.terminate()
+
+    prev_term = signal.signal(signal.SIGTERM, shut)
+    rc = 0
+    try:
+        while True:
+            alive = [(n, c) for n, c in children if c.poll() is None]
+            if not alive:
+                break
+            for name, c in children:
+                code = c.poll()
+                if code not in (None, 0) and not stopping:
+                    print(
+                        f"pod: {name} exited rc={code} — tearing down",
+                        file=sys.stderr, flush=True,
+                    )
+                    rc = 1
+                    shut()
+                    break
+            time.sleep(0.3)
+    except KeyboardInterrupt:
+        shut()
+    finally:
+        for _, c in children:
+            try:
+                c.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                c.kill()
+                c.wait()
+        signal.signal(signal.SIGTERM, prev_term)
+    if rc == 0 and any(
+        c.returncode not in (0, -signal.SIGTERM.value) for _, c in children
+    ) and not stopping:
+        rc = 1
+    return rc
+
+
 def cmd_loadtest(config: Config, args) -> int:
     """Replay request paths against a running serving layer at a target
     rate and report throughput + latency percentiles — the operational
@@ -518,6 +705,10 @@ def main(argv=None) -> int:
         return cmd_import_pmml(config, args.pmml)
     if args.command == "loadtest":
         return cmd_loadtest(config, args)
+    if args.command == "pod":
+        return cmd_pod(
+            config, args, list(argv if argv is not None else sys.argv[1:])
+        )
     if args.command == "serving":
         # replica children re-run this exact command line minus the
         # subcommand token (argparse accepts options BEFORE the

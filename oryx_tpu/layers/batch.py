@@ -29,6 +29,28 @@ from oryx_tpu.layers.watchdog import running_seconds, start_wedge_watchdog
 log = logging.getLogger(__name__)
 
 
+class _NullProducer:
+    """Update-topic sink for non-leader pod members: they participate in
+    the collective training but must not double-publish MODEL/UP
+    messages (cli.py pod; see the leader note in BatchLayer.__init__)."""
+
+    def __init__(self, topic: str):
+        self._topic = topic
+
+    @property
+    def topic(self) -> str:
+        return self._topic
+
+    def send(self, key, message) -> None:
+        pass
+
+    def send_batch(self, records) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
 class BatchLayer:
     def __init__(self, config: Config, update: BatchLayerUpdate | None = None):
         self.config = config
@@ -40,6 +62,23 @@ class BatchLayer:
         self.interval_sec = config.get_int("oryx.batch.streaming.generation-interval-sec")
         self.data_dir = strip_scheme(config.get_string("oryx.batch.storage.data-dir"))
         self.model_dir = strip_scheme(config.get_string("oryx.batch.storage.model-dir"))
+        # Pod members (cli.py pod): every compute process consumes the
+        # FULL input stream (brokers here don't split partitions within a
+        # group), so all members train the same data in lockstep and the
+        # mesh collectives line up. Only the leader (process 0) owns the
+        # canonical storage dirs and the update-topic publishes; the
+        # others keep their writes in per-process subdirs and publish
+        # nothing — the analogue of Spark executors computing while only
+        # the driver writes results.
+        from oryx_tpu.parallel.distributed import DistributedConfig
+
+        dc = DistributedConfig.from_config(config)
+        self.is_leader = dc.num_processes <= 1 or dc.process_id == 0
+        if not self.is_leader:
+            import os as _os
+
+            self.data_dir = _os.path.join(self.data_dir, f"proc-{dc.process_id}")
+            self.model_dir = _os.path.join(self.model_dir, f"proc-{dc.process_id}")
         self.max_age_data = config.get_int("oryx.batch.storage.max-age-data-hours", -1)
         self.max_age_model = config.get_int("oryx.batch.storage.max-age-model-hours", -1)
         if update is not None:
@@ -110,7 +149,10 @@ class BatchLayer:
         # back to the log END, so a crash before the first generation commit
         # would otherwise re-resolve to a LATER end and drop the gap
         self._consumer.commit()
-        self._producer = TopicProducer(update_broker, self.update_topic)
+        if self.is_leader:
+            self._producer = TopicProducer(update_broker, self.update_topic)
+        else:
+            self._producer = _NullProducer(self.update_topic)
 
     def run_generation(self, timestamp_ms: int | None = None) -> int:
         """Execute one batch generation synchronously; returns the number of
