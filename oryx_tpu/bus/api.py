@@ -133,19 +133,50 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
                         raise StopIteration
                     backoff = min(backoff * 2, _POLL_BACKOFF_MAX_S)
 
-    def poll_available(self) -> list[KeyMessage]:
+    def end_offsets(self) -> dict[int, int]:
+        """Current per-partition end offsets — the raw material for a
+        pod-wide agreed generation window (layers/batch.py)."""
+        return dict(enumerate(self._broker.end_offsets(self._topic)))
+
+    def poll_available(
+        self, up_to: dict[int, int] | None = None
+    ) -> list[KeyMessage]:
         """Non-blocking drain of everything currently in the log — the
         micro-batch read used by layer generation loops. Drained records
-        count as delivered."""
+        count as delivered.
+
+        up_to bounds the drain per partition (exclusive): records at or
+        beyond the bound stay unconsumed for the next call. Pod members
+        pass the leader's end-offset snapshot so every member's
+        generation window holds the SAME records even though their
+        timers fire at different moments."""
         out: list[KeyMessage] = []
+        keep: list[tuple[int, int, KeyMessage]] = []
         for p, off, km in self._buffer[self._buf_i :]:
+            if up_to is not None and off >= up_to.get(p, 0):
+                keep.append((p, off, km))
+                continue
             self._delivered_pos[p] = off + 1
             out.append(km)
-        self._buffer = []
+        self._buffer = keep
         self._buf_i = 0
         for p in list(self._fetch_pos.keys()):
+            limit = None if up_to is None else up_to.get(p, 0)
             while True:
-                recs = self._broker.read(self._topic, p, self._fetch_pos[p], self._max_poll)
+                if limit is not None and self._fetch_pos[p] >= limit:
+                    break
+                n = self._max_poll
+                if limit is not None:
+                    n = min(n, limit - self._fetch_pos[p])
+                recs = self._broker.read(self._topic, p, self._fetch_pos[p], n)
+                if limit is not None:
+                    # offsets may be sparse (compacted kafka logs): drop
+                    # anything the window excludes and pin the position
+                    past = [r for r in recs if r[0] >= limit]
+                    recs = [r for r in recs if r[0] < limit]
+                    if past and not recs:
+                        self._fetch_pos[p] = limit
+                        break
                 if not recs:
                     break
                 self._fetch_pos[p] = recs[-1][0] + 1

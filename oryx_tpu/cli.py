@@ -456,6 +456,11 @@ def cmd_pod(config: Config, args, raw_argv: list[str]) -> int:
     local_count = (
         args.local_count if args.local_count is not None else n_compute
     )
+    if local_start < 0 or local_count < 1:
+        raise SystemExit(
+            f"pod: --local-start must be >= 0 and --local-count >= 1 "
+            f"(got {local_start}, {local_count})"
+        )
     if local_start + local_count > n_compute:
         raise SystemExit(
             f"pod: local range [{local_start}, {local_start + local_count})"
@@ -552,7 +557,13 @@ def cmd_pod(config: Config, args, raw_argv: list[str]) -> int:
                 break
             for name, c in children:
                 code = c.poll()
-                if code not in (None, 0) and not stopping:
+                if code is None or stopping:
+                    continue
+                # ANY compute member exiting — even rc 0 (e.g. someone
+                # SIGTERMed one child directly) — must tear the pod down:
+                # a jax.distributed group is not elastic, and the
+                # survivors would wedge in the next collective forever
+                if code != 0 or name.startswith("compute-"):
                     print(
                         f"pod: {name} exited rc={code} — tearing down",
                         file=sys.stderr, flush=True,

@@ -74,11 +74,18 @@ class BatchLayer:
 
         dc = DistributedConfig.from_config(config)
         self.is_leader = dc.num_processes <= 1 or dc.process_id == 0
+        self._pod_member = dc.num_processes > 1
         if not self.is_leader:
             import os as _os
 
             self.data_dir = _os.path.join(self.data_dir, f"proc-{dc.process_id}")
             self.model_dir = _os.path.join(self.model_dir, f"proc-{dc.process_id}")
+            # own consumer group per non-leader: sharing the leader's
+            # group would let a faster member's offset commit advance
+            # past records the leader has not persisted yet (input loss
+            # on restart), and on kafka:// a shared group would split
+            # partitions when every member must see the full stream
+            self.group = f"{self.group}-proc{dc.process_id}"
         self.max_age_data = config.get_int("oryx.batch.storage.max-age-data-hours", -1)
         self.max_age_model = config.get_int("oryx.batch.storage.max-age-model-hours", -1)
         if update is not None:
@@ -154,6 +161,38 @@ class BatchLayer:
         else:
             self._producer = _NullProducer(self.update_topic)
 
+    def _pod_window(self, ts: int) -> tuple[int, "dict[int, int] | None"]:
+        """Agree the generation boundary pod-wide. Members' timers fire at
+        different moments, and an unsynchronized poll_available() would
+        hand each member a DIFFERENT record set — mismatched factor
+        shapes under the pod mesh wedge the (non-elastic) collectives.
+        So every member allgathers (timestamp, end offsets) and adopts
+        the leader's row: same window, same split timestamp, everywhere.
+        The allgather doubles as the generation barrier that aligns the
+        members' cadence. Single-process: no-op."""
+        if not self._pod_member:
+            return ts, None
+        import jax
+
+        if jax.process_count() <= 1:
+            return ts, None
+        import numpy as np
+
+        from oryx_tpu.parallel.distributed import host_allgather
+
+        ends = self._consumer.end_offsets()
+        parts = sorted(ends)
+        vals = [ts] + [ends[p] for p in parts]
+        # hi/lo 32-bit lanes: jax without x64 silently truncates int64
+        # arrays to int32, and a millisecond timestamp (or a mature kafka
+        # offset) does not fit — observed as negative generation ids
+        local = np.asarray(
+            [[v >> 32, v & 0xFFFFFFFF] for v in vals], dtype=np.uint32
+        )
+        lead = host_allgather(local)[0].astype(np.int64)
+        agreed = [int(hi) << 32 | int(lo) for hi, lo in lead]
+        return agreed[0], {p: agreed[i + 1] for i, p in enumerate(parts)}
+
     def run_generation(self, timestamp_ms: int | None = None) -> int:
         """Execute one batch generation synchronously; returns the number of
         new records processed. Public so tests and manual/one-shot builds
@@ -161,7 +200,8 @@ class BatchLayer:
         if self._consumer is None:
             self.ensure_streams()
         ts = timestamp_ms if timestamp_ms is not None else int(time.time() * 1000)
-        new_data = self._consumer.poll_available()
+        ts, up_to = self._pod_window(ts)
+        new_data = self._consumer.poll_available(up_to=up_to)
         past_data = load_all_data(self.data_dir)
         if new_data or past_data:
             self._gen_started = time.monotonic()

@@ -43,6 +43,22 @@ class MLUpdate(BatchLayerUpdate):
         self.eval_parallelism = config.get_int("oryx.ml.eval.parallelism", 1)
         self.threshold = config.get("oryx.ml.eval.threshold", None)
         self.max_message_size = config.get_int("oryx.update-topic.message.max-size", 1 << 24)
+        from oryx_tpu.parallel.distributed import DistributedConfig
+
+        self._pod = DistributedConfig.from_config(config).enabled
+        if self._pod and self.eval_parallelism != 1:
+            # pod members train candidates over the SHARED mesh: parallel
+            # builds would launch each candidate's collectives in
+            # thread-scheduling order, which differs across members and
+            # deadlocks the group — candidates must run serially, in the
+            # same order, everywhere
+            log.warning(
+                "pod member: forcing oryx.ml.eval.parallelism=1 "
+                "(was %d) — parallel candidate builds would interleave "
+                "pod collectives differently on different members",
+                self.eval_parallelism,
+            )
+            self.eval_parallelism = 1
 
     # ---- hooks an app implements -----------------------------------------
 
@@ -99,6 +115,13 @@ class MLUpdate(BatchLayerUpdate):
         if not data:
             log.info("no data at generation %d; skipping model build", timestamp_ms)
             return
+        if self._pod:
+            # every pod member must draw the SAME random split, the same
+            # hyperparam combos, and the same factor-init keys, or the
+            # lockstep collective training diverges. The generation
+            # timestamp is already pod-agreed (BatchLayer._pod_window),
+            # so it seeds one shared deterministic stream per generation.
+            RandomManager.use_test_seed(timestamp_ms & 0x7FFFFFFF)
         train, test = self.split_train_test(data)
         if not train:
             train, test = data, []

@@ -147,6 +147,30 @@ def test_poll_available_microbatch(broker):
     assert it.poll_available() == []
 
 
+def test_poll_available_bounded_window(broker):
+    """up_to bounds the drain per partition (exclusive) — pod members use
+    the leader's end-offset snapshot so every member's generation window
+    holds the same records (layers/batch.py _pod_window)."""
+    broker.create_topic("W", partitions=1)
+    it = ConsumeDataIterator(broker, "W", start="earliest")
+    for i in range(8):
+        broker.send("W", None, f"m{i}")
+    ends = it.end_offsets()
+    assert ends == {0: 8}
+    # window agreed at offset 5: exactly m0..m4, nothing more
+    got = it.poll_available(up_to={0: 5})
+    assert [m.message for m in got] == [f"m{i}" for i in range(5)]
+    assert it.poll_available(up_to={0: 5}) == []
+    # a partition missing from the window yields nothing (conservative)
+    assert it.poll_available(up_to={}) == []
+    # the rest arrives once the window advances
+    got2 = it.poll_available(up_to={0: 8})
+    assert [m.message for m in got2] == [f"m{i}" for i in range(5, 8)]
+    # unbounded drain still works afterwards
+    broker.send("W", None, "m8")
+    assert [m.message for m in it.poll_available()] == ["m8"]
+
+
 def test_topic_admin_helpers(tmp_path):
     uri = f"file://{tmp_path}/bus2"
     topics.maybe_create(uri, "A", partitions=2)
