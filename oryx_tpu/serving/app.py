@@ -130,6 +130,7 @@ class _Route:
     method: str
     pattern: re.Pattern
     handler: Callable[["ServingApp", Request], Any]
+    nonblocking: bool = False
 
 
 def _compile(pattern: str) -> re.Pattern:
@@ -175,6 +176,9 @@ class ServingApp:
         # patterns whose first segment is a parameter (scanned after the
         # group). Dispatch touches ~2 candidate routes instead of all.
         self._route_index: dict[str | None, list[_Route]] = {}
+        self.fast_segments: set[str] = set()
+        self._slow_segments: set[str] = set()
+        self._wildcard_blocking = False
         # app modules append (title, fn(app) -> rows) callbacks here; the
         # generic /console renders each as its own table — the equivalent
         # of the reference's per-app Console subclasses (e.g. als/Console.java)
@@ -209,14 +213,48 @@ class ServingApp:
                 raise ValueError(f"resource module {mod_name} has no register(app)")
             register(self)
 
-    def route(self, method: str, pattern: str):
+    def route(self, method: str, pattern: str, nonblocking: bool = False):
+        """Register a handler. nonblocking=True declares the handler does
+        no blocking work (state lookups + submit_nowait only) — the async
+        frontend then runs it INLINE on the event loop instead of paying
+        two thread hops through the worker pool per request (measured
+        ~25% of the per-request server cost on the serving hot path)."""
         def deco(fn):
-            r = _Route(method.upper(), _compile(pattern), fn)
+            r = _Route(method.upper(), _compile(pattern), fn, nonblocking)
             self.routes.append(r)
-            self._route_index.setdefault(_first_literal(pattern), []).append(r)
+            seg = _first_literal(pattern)
+            self._route_index.setdefault(seg, []).append(r)
+            # a first segment is "fast" only while EVERY route under it is
+            # nonblocking: one blocking sibling poisons the whole segment
+            # (the frontend decides before matching the exact route)
+            if seg is None:
+                # param-first routes are match candidates for EVERY path,
+                # so a blocking one disables fast dispatch entirely
+                if not nonblocking:
+                    self._wildcard_blocking = True
+            elif nonblocking and seg not in self._slow_segments:
+                self.fast_segments.add(seg)
+            else:
+                self._slow_segments.add(seg)
+                self.fast_segments.discard(seg)
             return fn
 
         return deco
+
+    def is_fast(self, path: str) -> bool:
+        """True when every route that could match `path` is marked
+        nonblocking — the async frontend may dispatch inline. Applies the
+        same context-path strip as _dispatch so the segment examined is
+        the one routing will actually use."""
+        if self._wildcard_blocking:
+            return False
+        if self.context_path:
+            if path.startswith(self.context_path + "/"):
+                path = path[len(self.context_path):]
+            else:
+                return False  # context root / outside-context: not hot paths
+        first = path.lstrip("/").split("/", 1)[0]
+        return first in self.fast_segments
 
     # -- helpers resources use (AbstractOryxResource equivalents) ----------
 

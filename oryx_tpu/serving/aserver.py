@@ -332,9 +332,15 @@ class AsyncHTTPServer:
         )
         loop = asyncio.get_running_loop()
         try:
-            resp = await loop.run_in_executor(
-                self._pool, self.app.dispatch_nowait, req
-            )
+            if self.app.is_fast(split.path):
+                # every route under this segment is declared nonblocking
+                # (state lookups + submit_nowait only): dispatch inline on
+                # the event loop, skipping two thread hops per request
+                resp = self.app.dispatch_nowait(req)
+            else:
+                resp = await loop.run_in_executor(
+                    self._pool, self.app.dispatch_nowait, req
+                )
             if isinstance(resp, Deferred):
                 # deferred endpoints (device-batched top-k) complete on the
                 # event loop: the worker thread is already free, so in-flight
@@ -362,6 +368,10 @@ class AsyncHTTPServer:
             writer, status, payload, ctype, method, gzip_ok=gzip_ok, extra=extra
         )
 
+    # (status, ctype) -> precomputed header prefix; statuses and content
+    # types are a tiny closed set, so this never grows unbounded
+    _prefix_cache: dict = {}
+
     async def _write_response(
         self,
         writer: asyncio.StreamWriter,
@@ -372,14 +382,16 @@ class AsyncHTTPServer:
         gzip_ok: bool = False,
         extra: tuple[tuple[str, str], ...] = (),
     ) -> None:
-        status_line = _COMMON_STATUS.get(status) or f"{status} Status".encode()
-        parts = [
-            b"HTTP/1.1 ",
-            status_line,
-            b"\r\nContent-Type: ",
-            ctype.encode("latin-1"),
-            b"\r\nVary: Accept-Encoding",
-        ]
+        prefix = self._prefix_cache.get((status, ctype))
+        if prefix is None:
+            status_line = _COMMON_STATUS.get(status) or f"{status} Status".encode()
+            prefix = (
+                b"HTTP/1.1 " + status_line + b"\r\nContent-Type: "
+                + ctype.encode("latin-1") + b"\r\nVary: Accept-Encoding"
+            )
+            if len(self._prefix_cache) < 512:
+                self._prefix_cache[(status, ctype)] = prefix
+        parts = [prefix]
         if gzip_ok and len(payload) >= 1024:
             payload = gzip.compress(payload, compresslevel=5)
             parts.append(b"\r\nContent-Encoding: gzip")

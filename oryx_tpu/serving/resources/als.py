@@ -80,6 +80,10 @@ def _user_vector_or_404(model, user: str) -> np.ndarray:
 def register(app: ServingApp) -> None:
     # -- recommend family --------------------------------------------------
 
+    # NOT nonblocking: the plan path can rebuild the device view (full Y
+    # copy + staged upload under _sync_lock after a model update) or run
+    # host LSH scoring — both far too heavy for inline event-loop
+    # dispatch. The worker-pool hop stays.
     @app.route("GET", "/recommend/{userID}")
     def recommend(a: ServingApp, req: Request):
         model = _model(a)

@@ -71,12 +71,12 @@ def send_input_lines(
 
 
 def register(app: ServingApp) -> None:
-    @app.route("GET", "/ready")
+    @app.route("GET", "/ready", nonblocking=True)
     def ready(a: ServingApp, req: Request):
         a.get_serving_model()  # raises 503 if not ready
         return 200, {"ready": True}
 
-    @app.route("HEAD", "/ready")
+    @app.route("HEAD", "/ready", nonblocking=True)
     def ready_head(a: ServingApp, req: Request):
         a.get_serving_model()
         return 200, None

@@ -301,3 +301,84 @@ def test_serving_creates_missing_topics_unless_no_init():
     with _pytest.raises(RuntimeError, match="topic does not exist"):
         sl2.start()
     InProcBroker.reset_all()
+
+
+def test_nonblocking_fast_segments():
+    """Routes marked nonblocking make their first segment eligible for
+    inline event-loop dispatch; one blocking sibling poisons the segment."""
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.serving.app import ServingApp
+
+    class Mgr:
+        def __init__(self):
+            self.config = None
+
+        def consume(self, it):
+            pass
+
+        def get_model(self):
+            return None
+
+    cfg = load_config(
+        overlay={
+            "oryx.id": "fast",
+            "oryx.serving.application-resources": [
+                "oryx_tpu.serving.resources.common",
+            ],
+        }
+    )
+    app = ServingApp(cfg, Mgr(), None)
+    assert app.is_fast("/ready")          # marked nonblocking
+    assert not app.is_fast("/ingest")     # blocking POST
+    assert not app.is_fast("/nonexistent")
+
+    @app.route("GET", "/fastpath/{x}", nonblocking=True)
+    def fast(a, req):
+        return 200, {"x": req.params["x"]}
+
+    assert app.is_fast("/fastpath/abc")
+
+    @app.route("POST", "/fastpath/{x}")  # blocking sibling poisons it
+    def slow(a, req):
+        return 200, None
+
+    assert not app.is_fast("/fastpath/abc")
+
+    # a blocking param-first route matches ANY path: fast dispatch off
+    assert app.is_fast("/ready")
+    @app.route("GET", "/{anything}")
+    def wildcard(a, req):
+        return 200, None
+
+    assert not app.is_fast("/ready")
+
+
+def test_fast_segments_respect_context_path():
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.serving.app import ServingApp
+
+    class Mgr:
+        def __init__(self):
+            self.config = None
+
+        def consume(self, it):
+            pass
+
+        def get_model(self):
+            return None
+
+    cfg = load_config(
+        overlay={
+            "oryx.id": "ctx",
+            "oryx.serving.api.context-path": "/api",
+            "oryx.serving.application-resources": [
+                "oryx_tpu.serving.resources.common",
+            ],
+        }
+    )
+    app = ServingApp(cfg, Mgr(), None)
+    # the wire path includes the context prefix; is_fast must strip it
+    # the same way _dispatch does
+    assert app.is_fast("/api/ready")
+    assert not app.is_fast("/ready")      # outside the context: 404 path
+    assert not app.is_fast("/api/ingest")
