@@ -451,8 +451,8 @@ def _bench_http_body() -> None:
     # (BASELINE.md "Memory": 1,400 MB heap at 50f x 2M users+items): host
     # f32 arenas + the bf16 device scoring copy
     host_mb = (state.x.nbytes() + state.y.nbytes()) / 1e6
-    device_mb = manager.model._y_view_full()[0].nbytes / 1e6
     y_dev = manager.model._y_view_full()[0]
+    device_mb = y_dev.nbytes / 1e6
     serving.close()
 
     # HTTP-tier efficiency, apples to apples: the kernel loop at the SAME

@@ -419,6 +419,24 @@ class KafkaBroker(Broker):
 
     # -- group offsets (the ZooKeeper-store analogue) ----------------------
 
+    # the group coordinator can move between brokers mid-session (broker
+    # restart, __consumer_offsets partition leadership change); the old
+    # node answers 16 NOT_COORDINATOR / 15 COORDINATOR_NOT_AVAILABLE /
+    # 14 LOAD_IN_PROGRESS until rediscovery
+    _COORD_RETRY_ERRS = frozenset({14, 15, 16})
+
+    def _coordinator_retry(self, attempt, tries: int = 3):
+        """Run attempt(); on a coordinator-movement error re-resolve (the
+        FindCoordinator in _coordinator() runs fresh each call) and retry
+        with a short backoff."""
+        for i in range(tries):
+            try:
+                return attempt()
+            except KafkaError as e:
+                if e.code not in self._COORD_RETRY_ERRS or i == tries - 1:
+                    raise
+                time.sleep(0.05 * (i + 1))
+
     def commit_offsets(self, group: str, topic: str, offsets: Mapping[int, int]) -> None:
         body = (
             Writer()
@@ -435,14 +453,17 @@ class KafkaBroker(Broker):
             )
             .done()
         )
-        r = self._coordinator(group).request(API_OFFSET_COMMIT, 2, body)
-        for _ in range(r.i32()):
-            r.string()
+        def attempt() -> None:
+            r = self._coordinator(group).request(API_OFFSET_COMMIT, 2, body)
             for _ in range(r.i32()):
-                r.i32()
-                err = r.i16()
-                if err != ERR_NONE:
-                    raise KafkaError(err, "offset_commit")
+                r.string()
+                for _ in range(r.i32()):
+                    r.i32()
+                    err = r.i16()
+                    if err != ERR_NONE:
+                        raise KafkaError(err, "offset_commit")
+
+        self._coordinator_retry(attempt)
 
     def get_offsets(self, group: str, topic: str) -> dict[int, int]:
         n_parts = self.num_partitions(topic)
@@ -457,23 +478,26 @@ class KafkaBroker(Broker):
             )
             .done()
         )
-        r = self._coordinator(group).request(API_OFFSET_FETCH, 1, body)
-        out: dict[int, int] = {}
-        for _ in range(r.i32()):
-            r.string()
+        def attempt() -> dict[int, int]:
+            r = self._coordinator(group).request(API_OFFSET_FETCH, 1, body)
+            out: dict[int, int] = {}
             for _ in range(r.i32()):
-                p = r.i32()
-                off = r.i64()
-                r.string()  # metadata
-                err = r.i16()
-                if err != ERR_NONE:
-                    # a transient coordinator error must NOT read as "no
-                    # committed offset" — start='committed' consumers would
-                    # silently skip to the log end and drop the gap
-                    raise KafkaError(err, "offset_fetch")
-                if off >= 0:
-                    out[p] = off
-        return out
+                r.string()
+                for _ in range(r.i32()):
+                    p = r.i32()
+                    off = r.i64()
+                    r.string()  # metadata
+                    err = r.i16()
+                    if err != ERR_NONE:
+                        # a transient coordinator error must NOT read as "no
+                        # committed offset" — start='committed' consumers would
+                        # silently skip to the log end and drop the gap
+                        raise KafkaError(err, "offset_fetch")
+                    if off >= 0:
+                        out[p] = off
+            return out
+
+        return self._coordinator_retry(attempt)
 
     def close(self) -> None:
         with self._meta_lock:

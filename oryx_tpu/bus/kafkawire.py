@@ -10,7 +10,8 @@ integration tests (framework/kafka-util src/test .../LocalKafkaBroker.java).
 
 Record batches are magic-v2 (the only format modern brokers accept for
 produce): varint/zigzag record fields, CRC32C over attributes..end.
-Compression is not emitted; gzip-compressed inbound batches are decoded.
+Compression is not emitted; gzip- and snappy-compressed (raw or
+xerial-framed) inbound batches are decoded.
 """
 
 from __future__ import annotations
@@ -202,6 +203,87 @@ class Reader:
 
 
 # ---------------------------------------------------------------------------
+# snappy (RFC-less: google/snappy format description + the xerial stream
+# framing the Java Kafka client wraps it in) — pure-python DECODER so
+# compressed batches from foreign JVM/librdkafka producers are readable
+# without a native dependency. Compression on our own produce path stays
+# off (uncompressed batches; the broker accepts either).
+# ---------------------------------------------------------------------------
+
+_XERIAL_MAGIC = b"\x82SNAPPY\x00"
+
+
+def _snappy_block_decompress(data: bytes) -> bytes:
+    """One raw snappy block: uvarint uncompressed length, then
+    literal/copy tagged elements."""
+    ulen = 0
+    shift = 0
+    pos = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated snappy preamble")
+        b = data[pos]
+        pos += 1
+        ulen |= (b & 0x7F) << shift
+        shift += 7
+        if not b & 0x80:
+            break
+    out = bytearray()
+    while pos < len(data):
+        tag = data[pos]
+        pos += 1
+        ttype = tag & 3
+        if ttype == 0:  # literal
+            ln = tag >> 2
+            if ln >= 60:
+                nb = ln - 59
+                ln = int.from_bytes(data[pos:pos + nb], "little")
+                pos += nb
+            ln += 1
+            out += data[pos:pos + ln]
+            pos += ln
+            continue
+        if ttype == 1:  # copy, 1-byte offset
+            ln = ((tag >> 2) & 0x7) + 4
+            off = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif ttype == 2:  # copy, 2-byte offset
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(data[pos:pos + 2], "little")
+            pos += 2
+        else:  # copy, 4-byte offset
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(data[pos:pos + 4], "little")
+            pos += 4
+        if off == 0 or off > len(out):
+            raise ValueError("bad snappy copy offset")
+        if off >= ln:  # non-overlapping: one slice
+            out += out[len(out) - off:len(out) - off + ln]
+        else:  # overlapping run: byte-wise (RLE-style copies)
+            for _ in range(ln):
+                out.append(out[-off])
+    if len(out) != ulen:
+        raise ValueError(f"snappy length mismatch: {len(out)} != {ulen}")
+    return bytes(out)
+
+
+def snappy_decompress(data: bytes) -> bytes:
+    """Raw snappy block, or the xerial-framed stream
+    (magic + 2 version ints, then [i32 length][block] chunks) that the
+    Java Kafka client's SnappyOutputStream writes."""
+    if data[: len(_XERIAL_MAGIC)] == _XERIAL_MAGIC:
+        pos = len(_XERIAL_MAGIC) + 8  # version + compat ints
+        out = bytearray()
+        while pos + 4 <= len(data):
+            (n,) = struct.unpack_from(">i", data, pos)
+            pos += 4
+            out += _snappy_block_decompress(data[pos:pos + n])
+            pos += n
+        return bytes(out)
+    return _snappy_block_decompress(data)
+
+
+# ---------------------------------------------------------------------------
 # record batch v2
 # ---------------------------------------------------------------------------
 
@@ -256,8 +338,9 @@ def decode_record_batches(
     """Concatenated record batches -> [(absolute offset, key, value), ...].
 
     Tolerates a trailing partial batch (brokers may return one at the end
-    of a fetch response). Handles magic v2; gzip-compressed v2 batches are
-    decompressed; other compressions raise.
+    of a fetch response). Handles magic v2; gzip- and snappy-compressed
+    (raw or xerial-framed) v2 batches are decompressed; lz4/zstd raise
+    (no stdlib codec, no native deps in this image).
     """
     out: list[tuple[int, bytes | None, bytes | None]] = []
     r = Reader(data)
@@ -286,7 +369,11 @@ def decode_record_batches(
             import gzip as _gzip
 
             payload = _gzip.decompress(payload)
+        elif codec == 2:  # snappy (raw or xerial-framed)
+            payload = snappy_decompress(payload)
         elif codec != 0:
+            # 3 = lz4, 4 = zstd: no stdlib codec and no native deps in
+            # this image — configure such producers to gzip/snappy/none
             raise ValueError(f"unsupported compression codec {codec}")
         pr = Reader(payload)
         for _ in range(n_records):
