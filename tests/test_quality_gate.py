@@ -10,9 +10,11 @@ build at reduced sweeps on CPU, env-gated:
 
     ORYX_NIGHTLY=1 python -m pytest tests/test_quality_gate.py -q
 
-Floors: AUC >= 0.87 — the round-2 25M healthy runs measured ~0.90 at 10
-sweeps (README), and a NaN-poisoned or guard-shredded build lands far
-below (a zeroed factor row scores 0 everywhere).
+Floors: AUC >= 0.87 — measured 0.9019 on this host (2026-07-30, full
+25M shape, 3 sweeps, bf16, CPU, 108 s end-to-end, nan_rows 0), matching
+the round-2 healthy-window ~0.90 at 10 sweeps; a NaN-poisoned or
+guard-shredded build lands far below (a zeroed factor row scores 0
+everywhere).
 nan_rows == 0 always — the guard must REPAIR (jitter-retry), and any row
 it zeroes re-enters the next half-sweep, so a persistent NaN/zeroed row
 in the final factors means the guard regressed.
