@@ -90,6 +90,11 @@ def _parse_args(argv):
         help="loadtest: concurrent client connections (default 32)",
     )
     p.add_argument(
+        "--http2", action="store_true",
+        help="loadtest: speak HTTP/2 (prior knowledge on cleartext, ALPN "
+        "over TLS) instead of HTTP/1.1",
+    )
+    p.add_argument(
         "--pmml",
         help="PMML file to import (import-pmml): published to the update "
         "topic as a MODEL so running speed/serving layers pick it up",
@@ -589,6 +594,117 @@ def cmd_pod(config: Config, args, raw_argv: list[str]) -> int:
     return rc
 
 
+class _H2LoadConn:
+    """Minimal HTTP/2 prior-knowledge (or ALPN-TLS) client for
+    `loadtest --http2`: one in-flight stream at a time — the same
+    closed-loop-per-worker semantics as the HTTP/1.1 path — reusing the
+    serving tier's own HPACK codec (serving/hpack.py)."""
+
+    def __init__(self, host: str, port: int, tls_ctx=None):
+        import socket as _socket
+        import struct as _struct
+
+        from oryx_tpu.serving.hpack import Decoder, encode
+
+        self._struct = _struct
+        s = _socket.create_connection((host, port), timeout=60)
+        s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        if tls_ctx is not None:
+            s = tls_ctx.wrap_socket(s, server_hostname=host)
+            if s.selected_alpn_protocol() != "h2":
+                s.close()
+                raise ConnectionError(
+                    "server did not negotiate h2 over TLS (ALPN: "
+                    f"{s.selected_alpn_protocol()!r}) — drop --http2 or "
+                    "point at an h2-capable endpoint"
+                )
+        self._s = s
+        self._f = s.makefile("rb", buffering=1 << 16)
+        self._dec = Decoder()
+        self._encode = encode
+        self._authority = f"{host}:{port}".encode()
+        self._scheme = b"https" if tls_ctx is not None else b"http"
+        self._sid = -1
+        s.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
+        self._frame(0x4, 0, 0)  # empty SETTINGS
+
+    def _frame(self, ftype: int, flags: int, sid: int, payload: bytes = b"") -> None:
+        self._s.sendall(
+            self._struct.pack(">I", len(payload))[1:]
+            + bytes([ftype, flags])
+            + self._struct.pack(">I", sid)
+            + payload
+        )
+
+    def _read_frame(self):
+        head = self._f.read(9)
+        if len(head) < 9:
+            raise ConnectionError("connection closed")
+        ln = int.from_bytes(head[:3], "big")
+        payload = self._f.read(ln)
+        if len(payload) < ln:
+            raise ConnectionError("truncated frame")
+        return head[3], head[4], int.from_bytes(head[5:9], "big") & 0x7FFFFFFF, payload
+
+    def get(self, path: str) -> int:
+        self._sid += 2
+        sid = self._sid
+        block = self._encode(
+            [
+                (b":method", b"GET"),
+                (b":scheme", self._scheme),
+                (b":path", path.encode()),
+                (b":authority", self._authority),
+            ]
+        )
+        self._frame(0x1, 0x5, sid, block)  # END_STREAM | END_HEADERS
+        status = 0
+        while True:
+            ftype, flags, fsid, payload = self._read_frame()
+            if ftype == 0x4:  # SETTINGS
+                if not flags & 0x1:
+                    self._frame(0x4, 0x1, 0)
+            elif ftype == 0x1:  # HEADERS
+                end_stream = bool(flags & 0x1)  # CONTINUATION never carries it
+                while not flags & 0x4:  # collect CONTINUATIONs
+                    ct, flags, csid, cp = self._read_frame()
+                    if ct != 0x9 or csid != fsid:
+                        raise ConnectionError("bad CONTINUATION")
+                    payload += cp
+                # decode EVERY block in wire order (dynamic-table sync),
+                # not just our stream's
+                hdrs = dict(self._dec.decode(payload))
+                if fsid == sid:
+                    status = int(hdrs.get(b":status", b"0"))
+                    if end_stream:
+                        return status
+            elif ftype == 0x0:  # DATA
+                end_stream = bool(flags & 0x1)
+                if payload:
+                    # replenish BOTH windows: the connection's (or long
+                    # runs stall at 64KB cumulative) and the stream's (or
+                    # any single response > 64KB deadlocks the server
+                    # mid-body against the default initial window)
+                    inc = self._struct.pack(">I", len(payload))
+                    self._frame(0x8, 0, 0, inc)
+                    if not end_stream:
+                        self._frame(0x8, 0, fsid, inc)
+                if fsid == sid and end_stream:
+                    return status
+            elif ftype == 0x7:  # GOAWAY
+                raise ConnectionError("server sent GOAWAY")
+            elif ftype == 0x3 and fsid == sid:  # RST_STREAM
+                raise ConnectionError("stream reset")
+            elif ftype == 0x6 and not flags & 0x1:  # PING
+                self._frame(0x6, 0x1, 0, payload)
+
+    def close(self) -> None:
+        try:
+            self._s.close()
+        except OSError:
+            pass
+
+
 def cmd_loadtest(config: Config, args) -> int:
     """Replay request paths against a running serving layer at a target
     rate and report throughput + latency percentiles — the operational
@@ -626,18 +742,53 @@ def cmd_loadtest(config: Config, args) -> int:
     # open-loop schedule: worker w fires request j at its (j*n+w)/rate slot
     rate = args.rate
 
+    class _H1Conn:
+        def __init__(self):
+            self._c = (
+                http.client.HTTPSConnection(host, port, timeout=60)
+                if tls
+                else http.client.HTTPConnection(host, port, timeout=60)
+            )
+
+        def get(self, path: str) -> int:
+            self._c.request("GET", path)
+            r = self._c.getresponse()
+            r.read()
+            return r.status
+
+        def close(self) -> None:
+            self._c.close()
+
     def connect():
-        if tls:
-            return http.client.HTTPSConnection(host, port, timeout=60)
-        return http.client.HTTPConnection(host, port, timeout=60)
+        if getattr(args, "http2", False):
+            ctx = None
+            if tls:
+                import ssl
+
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+                ctx.set_alpn_protocols(["h2"])
+            return _H2LoadConn(host, port, ctx)
+        return _H1Conn()
 
     def worker(w: int) -> None:
-        conn = connect()
+        # the h2 client connects eagerly in __init__ (preface+SETTINGS);
+        # a refused connect must count as an error and retry, not kill
+        # the worker with {"requests": 0, "errors": 0} as the epitaph
+        conn = None
         j = 0
         while True:
             now = time.perf_counter()
             if now >= stop_at:
                 break
+            if conn is None:
+                try:
+                    conn = connect()
+                except Exception:
+                    errors[w] += 1
+                    time.sleep(0.1)
+                    continue
             due = now
             if rate > 0:
                 due = t_start + (j * n_workers + w) / rate
@@ -651,19 +802,17 @@ def cmd_loadtest(config: Config, args) -> int:
             # percentiles instead of silently shrinking offered load
             t0 = min(due, time.perf_counter()) if rate > 0 else time.perf_counter()
             try:
-                conn.request("GET", path)
-                r = conn.getresponse()
-                r.read()
-                if r.status == 200:
+                if conn.get(path) == 200:
                     lat_ms[w].append((time.perf_counter() - t0) * 1000)
                 else:
                     errors[w] += 1
             except Exception:
                 errors[w] += 1
                 conn.close()
-                conn = connect()
+                conn = None  # reconnect (with error accounting) next loop
             j += 1
-        conn.close()
+        if conn is not None:
+            conn.close()
 
     threads = [threading.Thread(target=worker, args=(w,)) for w in range(n_workers)]
     for t in threads:

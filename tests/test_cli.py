@@ -170,6 +170,54 @@ def test_loadtest_command(tmp_path):
     assert report["qps"] <= 260
 
 
+def test_loadtest_http2(tmp_path):
+    """loadtest --http2 drives the serving layer over HTTP/2 prior
+    knowledge using the in-repo HPACK/frame client."""
+    import io
+    import json as _json
+    from contextlib import redirect_stdout
+
+    from oryx_tpu.bus.broker import get_broker
+    from oryx_tpu.cli import main as cli_main
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.serving.server import ServingLayer
+
+    bus = "mem://clilt2"
+    broker = get_broker(bus)
+    for t in ("OryxInput", "OryxUpdate"):
+        if not broker.topic_exists(t):
+            broker.create_topic(t, 1)
+    broker.send("OryxUpdate", "MODEL", _json.dumps({"word": 7}))
+    cfg = load_config(overlay={
+        "oryx.input-topic.broker": bus,
+        "oryx.update-topic.broker": bus,
+        "oryx.serving.api.port": 0,
+        "oryx.serving.model-manager-class": "oryx_tpu.apps.example.serving.ExampleServingModelManager",
+        "oryx.serving.application-resources": [
+            "oryx_tpu.serving.resources.common",
+            "oryx_tpu.serving.resources.example",
+        ],
+    })
+    paths = tmp_path / "paths.txt"
+    paths.write_text("/distinct\n/ready\n")
+    with ServingLayer(cfg) as sl:
+        time.sleep(0.3)
+        out = io.StringIO()
+        with redirect_stdout(out):
+            rc = cli_main([
+                "loadtest", "--http2",
+                "--url", f"http://127.0.0.1:{sl.port}",
+                "--paths", str(paths),
+                "--duration", "2",
+                "--workers", "4",
+            ])
+    assert rc == 0
+    report = _json.loads(out.getvalue().strip().splitlines()[-1])
+    assert report["errors"] == 0
+    assert report["requests"] > 20
+    assert report["latency_ms"]["p50"] > 0
+
+
 def test_serving_replicas_share_port(tmp_path):
     """oryx.serving.api.processes=2: the CLI supervises two full serving
     replicas on ONE port via SO_REUSEPORT over a file:// broker; requests
