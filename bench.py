@@ -1052,6 +1052,11 @@ def _run_suite(
         )
         if out is not None:
             merge(result, out)
+            # cumulative interim line after EVERY completed stage: if the
+            # DRIVER's own deadline kills this process mid-suite (e.g. a
+            # healthy window opened late), the finished stages survive as
+            # the last parseable line instead of dying with the process
+            print(json.dumps({**result, "interim": True}), flush=True)
         if status != "ok":
             if status == "timeout" and granted < cap - 1:
                 errors.append(f"{body} ({tag}) budget-exhausted")
