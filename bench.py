@@ -695,6 +695,7 @@ def _bench_scale_body() -> None:
     import jax.numpy as jnp
 
     from oryx_tpu.ops.als import topk_dot_batch
+    from oryx_tpu.ops.flops import device_peak_flops, mfu, topk_score_flops
 
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
@@ -751,10 +752,6 @@ def _bench_scale_body() -> None:
                 return (n + batch) / (time.perf_counter() - t0), comp
 
             qps, compile_s = timed_qps(1.0)
-            from oryx_tpu.ops.flops import (
-                device_peak_flops, mfu, topk_score_flops,
-            )
-
             row_mfu = mfu(
                 qps * topk_score_flops(1, n_items, features),
                 device_peak_flops("bfloat16"),

@@ -197,7 +197,7 @@ class TopKBatcher:
         # ops/flops.py): rate(oryx_topk_flops_total) / oryx_device_peak_flops
         # is the serving MFU over any scrape interval
         self.flops_scored = 0.0
-        self._peak_flops: float | None | type(...) = ...  # lazy, cached
+        self._peak_flops = ...  # Ellipsis = not yet resolved (see _note_device)
 
     def register_gauges(self) -> None:
         """Expose the batcher's counters as callback gauges on the global

@@ -31,6 +31,7 @@ import gzip
 import logging
 import struct
 
+from oryx_tpu.serving.aserver import MAX_BODY_BYTES
 from oryx_tpu.serving.hpack import Decoder as HpackDecoder
 from oryx_tpu.serving.hpack import HpackError, encode as hpack_encode
 
@@ -369,8 +370,6 @@ class Http2Connection:
                 raise ConnectionError2(PROTOCOL_ERROR, "bad padding")
             payload = payload[: len(payload) - pad]
         st.body += payload
-        from oryx_tpu.serving.aserver import MAX_BODY_BYTES
-
         if len(st.body) > MAX_BODY_BYTES:
             self.streams.pop(sid, None)
             await self._send_frame(

@@ -66,6 +66,19 @@ def _wait_ready(port: int, scheme="http", context=None):
     raise TimeoutError("serving layer never became ready")
 
 
+class _StubManager:
+    """Model-manager stub for tests that only exercise startup/routing."""
+
+    def __init__(self, config=None):
+        self.config = config
+
+    def consume(self, it):
+        pass
+
+    def get_model(self):
+        return None
+
+
 def test_gzip_response_and_console():
     port = _free_port()
     _setup_bus("mem://extras1")
@@ -309,16 +322,6 @@ def test_nonblocking_fast_segments():
     from oryx_tpu.common.config import load_config
     from oryx_tpu.serving.app import ServingApp
 
-    class Mgr:
-        def __init__(self):
-            self.config = None
-
-        def consume(self, it):
-            pass
-
-        def get_model(self):
-            return None
-
     cfg = load_config(
         overlay={
             "oryx.id": "fast",
@@ -327,7 +330,7 @@ def test_nonblocking_fast_segments():
             ],
         }
     )
-    app = ServingApp(cfg, Mgr(), None)
+    app = ServingApp(cfg, _StubManager(cfg), None)
     assert app.is_fast("/ready")          # marked nonblocking
     assert not app.is_fast("/ingest")     # blocking POST
     assert not app.is_fast("/nonexistent")
@@ -357,16 +360,6 @@ def test_fast_segments_respect_context_path():
     from oryx_tpu.common.config import load_config
     from oryx_tpu.serving.app import ServingApp
 
-    class Mgr:
-        def __init__(self):
-            self.config = None
-
-        def consume(self, it):
-            pass
-
-        def get_model(self):
-            return None
-
     cfg = load_config(
         overlay={
             "oryx.id": "ctx",
@@ -376,7 +369,7 @@ def test_fast_segments_respect_context_path():
             ],
         }
     )
-    app = ServingApp(cfg, Mgr(), None)
+    app = ServingApp(cfg, _StubManager(cfg), None)
     # the wire path includes the context prefix; is_fast must strip it
     # the same way _dispatch does
     assert app.is_fast("/api/ready")
