@@ -10,8 +10,10 @@ integration tests (framework/kafka-util src/test .../LocalKafkaBroker.java).
 
 Record batches are magic-v2 (the only format modern brokers accept for
 produce): varint/zigzag record fields, CRC32C over attributes..end.
-Compression is not emitted; gzip- and snappy-compressed (raw or
-xerial-framed) inbound batches are decoded.
+Compression is not emitted; inbound batches are decoded for every
+codec real producers use — gzip and snappy (raw or xerial-framed) in
+pure python, lz4-frame and zstd through the host's canonical C
+libraries (bus/compress.py ctypes bindings).
 """
 
 from __future__ import annotations
@@ -338,9 +340,9 @@ def decode_record_batches(
     """Concatenated record batches -> [(absolute offset, key, value), ...].
 
     Tolerates a trailing partial batch (brokers may return one at the end
-    of a fetch response). Handles magic v2; gzip- and snappy-compressed
-    (raw or xerial-framed) v2 batches are decompressed; lz4/zstd raise
-    (no stdlib codec, no native deps in this image).
+    of a fetch response). Handles magic v2; gzip/snappy (pure python)
+    and lz4/zstd (system-library ctypes bindings, bus/compress.py)
+    compressed v2 batches are decompressed.
     """
     out: list[tuple[int, bytes | None, bytes | None]] = []
     r = Reader(data)
@@ -371,9 +373,15 @@ def decode_record_batches(
             payload = _gzip.decompress(payload)
         elif codec == 2:  # snappy (raw or xerial-framed)
             payload = snappy_decompress(payload)
+        elif codec == 3:  # lz4 frame
+            from oryx_tpu.bus.compress import lz4f_decompress
+
+            payload = lz4f_decompress(payload)
+        elif codec == 4:  # zstd
+            from oryx_tpu.bus.compress import zstd_decompress
+
+            payload = zstd_decompress(payload)
         elif codec != 0:
-            # 3 = lz4, 4 = zstd: no stdlib codec and no native deps in
-            # this image — configure such producers to gzip/snappy/none
             raise ValueError(f"unsupported compression codec {codec}")
         pr = Reader(payload)
         for _ in range(n_records):

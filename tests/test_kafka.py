@@ -446,7 +446,9 @@ def _snappy_compress_literals(data: bytes) -> bytes:
     return bytes(out)
 
 
-@pytest.mark.parametrize("codec_name", ["gzip", "snappy_raw", "snappy_xerial"])
+@pytest.mark.parametrize(
+    "codec_name", ["gzip", "snappy_raw", "snappy_xerial", "lz4", "zstd"]
+)
 def test_compressed_foreign_batches_decode(kafka, codec_name):
     import gzip as _gzip
 
@@ -456,7 +458,7 @@ def test_compressed_foreign_batches_decode(kafka, codec_name):
         batch = _foreign_batch(recs, 1, _gzip.compress)
     elif codec_name == "snappy_raw":
         batch = _foreign_batch(recs, 2, _snappy_compress_literals)
-    else:
+    elif codec_name == "snappy_xerial":
         def xerial(data: bytes) -> bytes:
             blk = _snappy_compress_literals(data)
             return (
@@ -464,6 +466,22 @@ def test_compressed_foreign_batches_decode(kafka, codec_name):
                 + struct.pack(">i", len(blk)) + blk
             )
         batch = _foreign_batch(recs, 2, xerial)
+    elif codec_name == "lz4":
+        # compressed with the CANONICAL system liblz4 — the same library
+        # real producers link
+        from oryx_tpu.bus.compress import CodecUnavailable, lz4f_compress
+
+        try:
+            batch = _foreign_batch(recs, 3, lz4f_compress)
+        except CodecUnavailable:
+            pytest.skip("liblz4 not on this host")
+    else:
+        from oryx_tpu.bus.compress import CodecUnavailable, zstd_compress
+
+        try:
+            batch = _foreign_batch(recs, 4, zstd_compress)
+        except CodecUnavailable:
+            pytest.skip("libzstd not on this host")
     # splice into the log like a foreign producer's append, after some
     # uncompressed records from OUR producer (mixed-codec log)
     kafka.send("FOREIGN-" + codec_name, "pre", "existing")
@@ -582,3 +600,51 @@ def test_snappy_decoder_property_roundtrip():
     with _pytest.raises(ValueError):
         # copy reaching before the start of output
         _snappy_block_decompress(bytes([4, ((4 - 1) << 2) | 2]) + struct.pack("<H", 9))
+
+
+def test_lz4_zstd_bindings_edge_cases():
+    """System-codec bindings: multi-block and big-block lz4 frames (the
+    4MB-block case flushes buffered output with zero source consumed —
+    a naive no-progress check rejects it), and hostile zstd declared
+    sizes fail cleanly instead of attempting the allocation."""
+    import ctypes
+    import ctypes.util
+
+    from oryx_tpu.bus.compress import (
+        CodecUnavailable, lz4f_compress, lz4f_decompress,
+        zstd_compress, zstd_decompress,
+    )
+
+    try:
+        blob = bytes(range(256)) * 12_000  # ~3MB, multi-block at defaults
+        assert lz4f_decompress(lz4f_compress(blob)) == blob
+        assert zstd_decompress(zstd_compress(blob)) == blob
+    except CodecUnavailable:
+        pytest.skip("system codec libraries absent")
+
+    # 4MB-block frame (blockSizeID 7), built with the canonical library
+    lib = ctypes.CDLL(ctypes.util.find_library("lz4"))
+
+    class Prefs(ctypes.Structure):
+        _fields_ = [
+            ("blockSizeID", ctypes.c_int), ("blockMode", ctypes.c_int),
+            ("contentChecksumFlag", ctypes.c_int), ("frameType", ctypes.c_int),
+            ("contentSize", ctypes.c_ulonglong), ("dictID", ctypes.c_uint),
+            ("blockChecksumFlag", ctypes.c_int),
+            ("compressionLevel", ctypes.c_int), ("autoFlush", ctypes.c_uint),
+            ("favorDecSpeed", ctypes.c_uint), ("reserved", ctypes.c_uint * 3),
+        ]
+
+    prefs = Prefs()
+    prefs.blockSizeID = 7
+    data = b"xy" * 700_000
+    lib.LZ4F_compressFrameBound.restype = ctypes.c_size_t
+    cap = lib.LZ4F_compressFrameBound(len(data), ctypes.byref(prefs))
+    dst = ctypes.create_string_buffer(cap)
+    lib.LZ4F_compressFrame.restype = ctypes.c_size_t
+    n = lib.LZ4F_compressFrame(dst, cap, data, len(data), ctypes.byref(prefs))
+    assert lz4f_decompress(dst.raw[:n]) == data
+
+    # hostile zstd: absurd declared content size -> ValueError, no alloc
+    with pytest.raises(ValueError):
+        zstd_decompress(b"\x28\xb5\x2f\xfd" + b"\x64" + b"\xff" * 8)
