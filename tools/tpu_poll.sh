@@ -1,15 +1,24 @@
 #!/bin/bash
-# Poll TPU health in killable subprocesses; append timestamped lines to .tpu_health.log.
-# A wedged axon tunnel hangs any device op (even import, via sitecustomize), so the
-# probe always runs under timeout in a fresh process.
+# Poll TPU health in killable subprocesses; append timestamped lines to
+# .tpu_health.log. On the FIRST healthy probe, automatically fire one full
+# bench run (lockfile-guarded) so a healthy window is never wasted waiting
+# for a human: artifacts land in .tpu_window_bench.{out,err}.
 LOG="${1:-/root/repo/.tpu_health.log}"
 INTERVAL="${2:-240}"
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+LOCK="$REPO/.tpu_window_bench.lock"
 while true; do
   ts=$(date -u +%FT%TZ)
   out=$(timeout 45 python -c 'import jax,jax.numpy as jnp; x=jnp.ones((512,512),jnp.bfloat16); (x@x).block_until_ready(); d=jax.devices()[0]; print(d.platform)' 2>&1)
   rc=$?
   if [ $rc -eq 0 ]; then
     echo "$ts HEALTHY $(echo "$out" | tail -1)" >> "$LOG"
+    if mkdir "$LOCK" 2>/dev/null; then
+      echo "$ts HEALTHY -> launching window bench" >> "$LOG"
+      (cd "$REPO" && ORYX_BENCH_BUDGET_S=3000 timeout 3300 python bench.py \
+        > "$REPO/.tpu_window_bench.out" 2> "$REPO/.tpu_window_bench.err"; \
+       echo "$(date -u +%FT%TZ) window bench rc=$?" >> "$LOG") &
+    fi
   else
     echo "$ts WEDGED rc=$rc" >> "$LOG"
   fi
