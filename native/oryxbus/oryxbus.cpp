@@ -11,6 +11,7 @@
 //
 // Exposed to Python via ctypes (oryx_tpu/bus/native.py). Build: `make` here.
 
+#include <array>
 #include <cerrno>
 #include <cstdint>
 #include <cstdlib>
@@ -248,3 +249,49 @@ int64_t oryxbus_parse_interactions(const char* buf, int64_t len,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// CRC-32C (Castagnoli) — the Kafka record-batch checksum. The SSE4.2 CRC32
+// instruction does ~15 GB/s; the Python slicing-by-8 fallback manages tens
+// of MB/s, which turns a 16MB MODEL publish into tens of milliseconds of
+// checksum alone. Runtime-dispatched: the hardware path is compiled with a
+// per-function target attribute and only taken when the CPU reports SSE4.2.
+// ---------------------------------------------------------------------------
+
+#if defined(__x86_64__)  // crc32di is 64-bit only; i386 would not compile
+__attribute__((target("sse4.2")))
+static uint32_t crc32c_hw(const uint8_t* data, size_t n, uint32_t crc) {
+  uint64_t c = crc ^ 0xFFFFFFFFu;
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    uint64_t v;
+    __builtin_memcpy(&v, data + i, 8);
+    c = __builtin_ia32_crc32di(c, v);
+  }
+  uint32_t c32 = static_cast<uint32_t>(c);
+  for (; i < n; ++i) c32 = __builtin_ia32_crc32qi(c32, data[i]);
+  return c32 ^ 0xFFFFFFFFu;
+}
+#endif
+
+static uint32_t crc32c_sw(const uint8_t* data, size_t n, uint32_t crc) {
+  static const auto table = [] {
+    std::array<uint32_t, 256> t{};
+    for (uint32_t m = 0; m < 256; ++m) {
+      uint32_t c = m;
+      for (int k = 0; k < 8; ++k) c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+      t[m] = c;
+    }
+    return t;
+  }();
+  uint32_t c = crc ^ 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+extern "C" uint32_t oryxbus_crc32c(const uint8_t* data, size_t n, uint32_t crc) {
+#if defined(__x86_64__)
+  if (__builtin_cpu_supports("sse4.2")) return crc32c_hw(data, n, crc);
+#endif
+  return crc32c_sw(data, n, crc);
+}

