@@ -1,0 +1,90 @@
+"""HPACK codec: RFC 7541 vectors + property sweeps.
+
+The wire-facing decoder must survive arbitrary header sets round-tripped
+through our stateless encoder, Huffman-coded strings from the RFC's own
+examples, and corrupted inputs failing loudly (HpackError) instead of
+desyncing silently.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from oryx_tpu.serving.hpack import (
+    Decoder, HpackError, STATIC_TABLE, decode_int, encode, encode_int,
+    huffman_decode,
+)
+
+
+def test_rfc7541_huffman_vectors():
+    # C.4.x / C.6.x request+response strings
+    cases = [
+        ("f1e3c2e5f23a6ba0ab90f4ff", b"www.example.com"),
+        ("a8eb10649cbf", b"no-cache"),
+        ("25a849e95ba97d7f", b"custom-key"),
+        ("25a849e95bb8e8b4bf", b"custom-value"),
+        ("6402", b"302"),
+        ("aec3771a4b", b"private"),
+        ("d07abe941054d444a8200595040b8166e082a62d1bff", b"Mon, 21 Oct 2013 20:13:21 GMT"),
+        ("9d29ad171863c78f0b97c8e9ae82ae43d3", b"https://www.example.com"),
+    ]
+    for hex_in, want in cases:
+        assert huffman_decode(bytes.fromhex(hex_in)) == want
+
+
+def test_integer_coding_roundtrip():
+    for prefix in (4, 5, 6, 7):
+        for v in (0, 1, (1 << prefix) - 2, (1 << prefix) - 1, (1 << prefix),
+                  127, 128, 255, 300, 16384, 10_000_000):
+            data = encode_int(v, prefix)
+            got, pos = decode_int(data, 0, prefix)
+            assert got == v and pos == len(data), (prefix, v)
+
+
+def test_property_roundtrip_random_header_sets():
+    rng = random.Random(7)
+    static_names = [n for n, _ in STATIC_TABLE]
+    for _ in range(200):
+        headers = []
+        for _ in range(rng.randrange(0, 12)):
+            if rng.random() < 0.4:
+                name = rng.choice(static_names)
+            else:
+                name = bytes(
+                    rng.randrange(0x21, 0x7F) for _ in range(rng.randrange(1, 20))
+                ).lower()
+            value = bytes(
+                rng.randrange(0, 256) for _ in range(rng.randrange(0, 200))
+            )
+            headers.append((name, value))
+        assert Decoder().decode(encode(headers)) == headers
+
+
+def test_corruption_raises_not_desyncs():
+    block = encode([(b":status", b"200"), (b"x-a", b"b" * 100)])
+    for cut in (1, len(block) // 2, len(block) - 1):
+        with pytest.raises((HpackError, EOFError, IndexError)):
+            Decoder().decode(block[:cut] + b"\x7f\xff\xff\xff\xff\xff")
+    # oversized table-size update beyond the settings cap
+    with pytest.raises(HpackError):
+        Decoder(max_table_size=256).decode(bytes([0x3F, 0xE1, 0xFF, 0x03]))
+
+
+def test_dynamic_table_eviction():
+    d = Decoder(max_table_size=64)  # tiny: ~1 entry (32B overhead each)
+    # two literal-with-incremental-indexing entries; the first must evict
+    def lit_inc(name: bytes, value: bytes) -> bytes:
+        out = bytearray([0x40])
+        out += encode_int(len(name), 7) + name
+        out += encode_int(len(value), 7) + value
+        return bytes(out)
+
+    d.decode(lit_inc(b"aaaa", b"1111"))
+    d.decode(lit_inc(b"bbbb", b"2222"))
+    assert len(d._dyn) == 1 and d._dyn[0] == (b"bbbb", b"2222")
+    # indexed reference to the surviving entry (static size + 1)
+    idx = len(STATIC_TABLE) + 1
+    got = d.decode(encode_int(idx, 7, 0x80))
+    assert got == [(b"bbbb", b"2222")]
