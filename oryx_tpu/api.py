@@ -37,6 +37,18 @@ def _dispatch_update(handler, km: KeyMessage) -> None:
     also walks MODEL-REFs whose artifacts were TTL-pruned long ago, and
     every sleep here multiplies across that history. Parse/validation
     errors are deterministic and never retried."""
+    if km.key == "MODEL-CHUNK":
+        # framework-level artifact transfer (common/artifact.py
+        # ArtifactRelay): assembled here so every app manager can resolve
+        # a MODEL-REF without a shared filesystem; app handlers never see
+        # the chunks
+        from oryx_tpu.common.artifact import artifact_relay
+
+        try:
+            artifact_relay().offer(km.message)
+        except Exception:
+            _log.exception("ignoring bad MODEL-CHUNK message")
+        return
     retries = 3 if km.key in ("MODEL", "MODEL-REF") else 0
     for attempt in range(retries + 1):
         try:

@@ -189,13 +189,20 @@ class ALSUpdate(MLUpdate):
 
     def publish_model(self, model: ModelArtifact, model_path: str, producer: TopicProducer) -> None:
         """Publish a tensor-free skeleton; factor rows stream separately
-        (the reference's skeleton-PMML-with-extensions pattern)."""
+        (the reference's skeleton-PMML-with-extensions pattern). An
+        oversized skeleton ships its bytes as bus chunks ahead of the
+        MODEL-REF so other hosts resolve it with no shared mount."""
+        from oryx_tpu.common.artifact import publish_model_ref
+
         skeleton = ModelArtifact("als", dict(model.extensions), {})
         serialized = skeleton.to_string()
         if len(serialized.encode("utf-8")) <= self.max_message_size:
             producer.send("MODEL", serialized)
         else:
-            producer.send("MODEL-REF", model_path)
+            publish_model_ref(
+                producer, serialized, model_path, self.max_message_size,
+                transfer=self.artifact_transfer,
+            )
 
     def publish_additional_model_data(
         self, model: ModelArtifact, model_path: str, producer: TopicProducer

@@ -424,6 +424,33 @@ def _supervise_serving_replicas(config: Config, n_procs: int, argv: list[str]) -
     return rc_out
 
 
+def _pod_child_flags(raw_argv: list[str]) -> list[str]:
+    """Rebuild a child command line from the pod invocation: drop the
+    'pod' SUBCOMMAND token (first bare occurrence only — a later
+    legitimate flag value that happens to be "pod", e.g. --conf pod,
+    must survive) and the pod-only flags with their values."""
+    base_flags: list[str] = []
+    skip_next = False
+    seen_subcommand = False
+    pod_flags = {
+        "--compute", "--local-start", "--local-count", "--coordinator",
+    }
+    for tok in raw_argv:
+        if skip_next:
+            skip_next = False  # the dropped pod-flag's value
+            continue
+        if tok == "pod" and not seen_subcommand:
+            seen_subcommand = True
+            continue
+        if tok in pod_flags:
+            skip_next = True
+            continue
+        if tok.split("=", 1)[0] in pod_flags or tok in ("--speed", "--serving"):
+            continue
+        base_flags.append(tok)
+    return base_flags
+
+
 def cmd_pod(config: Config, args, raw_argv: list[str]) -> int:
     """Multi-host pod launcher — the analogue of the reference's
     oryx-run.sh spark-submit/YARN assembly (deploy/bin/oryx-run.sh:
@@ -484,23 +511,7 @@ def cmd_pod(config: Config, args, raw_argv: list[str]) -> int:
 
     # child command = this exact invocation minus the pod-only flags,
     # with the role substituted — so --conf/--set/env all carry through
-    base_flags: list[str] = []
-    skip_next = False
-    pod_flags = {
-        "--compute", "--local-start", "--local-count", "--coordinator",
-    }
-    for tok in raw_argv:
-        if skip_next:
-            skip_next = False
-            continue
-        if tok == "pod":
-            continue
-        if tok in pod_flags:
-            skip_next = True
-            continue
-        if tok.split("=", 1)[0] in pod_flags or tok in ("--speed", "--serving"):
-            continue
-        base_flags.append(tok)
+    base_flags = _pod_child_flags(raw_argv)
 
     def spawn(role: str, extra_sets: list[str]) -> subprocess.Popen:
         cmd = [sys.executable, "-m", "oryx_tpu.cli", role, *base_flags]

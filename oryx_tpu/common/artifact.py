@@ -149,9 +149,232 @@ class ModelArtifact:
 def read_artifact_from_update(key: str, message: str) -> ModelArtifact:
     """Decode a MODEL (inline artifact) or MODEL-REF (path) update message —
     the consumer-side counterpart of the size cutover at the reference's
-    MLUpdate.java:212-231 / AppPMMLUtils.readPMMLFromUpdateKeyMessage."""
+    MLUpdate.java:212-231 / AppPMMLUtils.readPMMLFromUpdateKeyMessage.
+
+    MODEL-REF resolution is cross-host capable: the local path wins when it
+    exists (shared mount / same host), otherwise the bus-chunked copy
+    assembled by the ArtifactRelay stands in — the reference reads the
+    path through a shared Hadoop FileSystem (AppPMMLUtils.java:261-275,
+    FileSystem.get), which has no equivalent here without HDFS."""
     if key == "MODEL":
         return ModelArtifact.from_string(message)
     if key == "MODEL-REF":
-        return ModelArtifact.read(message)
+        return ModelArtifact.read(artifact_relay().resolve(message))
     raise ValueError(f"not a model update key: {key}")
+
+
+# -- bus-chunked MODEL-REF transfer (no shared filesystem required) --------
+
+CHUNK_KEY = "MODEL-CHUNK"
+
+
+class ArtifactRelay:
+    """Assembles MODEL-CHUNK messages into a local artifact cache so any
+    consumer on any host can resolve a MODEL-REF without a shared mount.
+
+    The publisher emits the oversized artifact's exact serialized form as
+    N b64 chunks (each under the update topic's max message size) just
+    before the MODEL-REF line; replaying consumers (serving/speed read the
+    update topic from earliest) re-assemble them on every restart, which
+    is the same replay contract the reference relies on for UP messages.
+    Requires the update topic's publish order (single partition, the
+    reference's own convention for ordered model updates)."""
+
+    # un-assembled chunks of refs OTHER than the one currently arriving;
+    # the in-flight ref itself is never evicted — its transient memory
+    # floor is one artifact's serialized size, the same cost the
+    # publisher paid to send it
+    MAX_PENDING_BYTES = 1 << 29  # 512 MB
+    # materialized artifacts kept on disk per process; replay (consumers
+    # read the update topic from earliest on every restart) re-walks all
+    # historical models, and without a cap the cache would accrete every
+    # oversized artifact ever published
+    MAX_CACHED = 8
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        # ref -> {"n": int, "sha": str | None, "chunks": {i: bytes}}
+        self._pending: dict[str, dict] = {}
+        self._cache: dict[str, Path] = {}
+        self._cache_root: Path | None = None
+
+    def _root(self) -> Path:
+        if self._cache_root is None:
+            import os
+            import tempfile
+
+            # STABLE per-user root (not a fresh mkdtemp per process):
+            # cache dirs are keyed by ref, so a restart's replay rewrites
+            # the same paths instead of accreting a new copy of history
+            # in a new directory every time
+            root = Path(tempfile.gettempdir()) / (
+                f"oryx-artifact-cache-{os.getuid()}"
+            )
+            root.mkdir(mode=0o700, parents=True, exist_ok=True)
+            self._cache_root = root
+        return self._cache_root
+
+    def offer(self, message: str) -> None:
+        """Ingest one MODEL-CHUNK message; materializes the artifact into
+        the local cache when the last chunk arrives."""
+        import hashlib
+
+        d = json.loads(message)
+        ref, i, n = str(d["ref"]), int(d["i"]), int(d["n"])
+        if not (0 <= i < n):
+            raise ValueError(f"bad chunk index {i}/{n}")
+        data = base64.b64decode(d["data"])
+        with self._lock:
+            ent = self._pending.setdefault(
+                ref, {"n": n, "sha": d.get("sha"), "chunks": {}}
+            )
+            if ent["n"] != n or (
+                d.get("sha") is not None and d["sha"] != ent["sha"]
+            ):
+                # a republish changed the chunking OR the bytes (same
+                # count, new content after a publisher restart): restart
+                # the assembly — mixing streams would fail verification
+                # forever
+                ent = self._pending[ref] = {
+                    "n": n, "sha": d.get("sha"), "chunks": {}
+                }
+            ent["chunks"][i] = data
+            self._evict_locked(keep=ref)
+            if len(ent["chunks"]) < n:
+                return
+            blob = b"".join(ent["chunks"][j] for j in range(n))
+            del self._pending[ref]
+        sha = ent.get("sha")
+        if sha and hashlib.sha256(blob).hexdigest() != sha:
+            raise ValueError(f"MODEL-CHUNK sha mismatch for {ref}")
+        art = ModelArtifact.from_string(blob.decode("utf-8"))
+        self._materialize(ref, art)
+
+    def _materialize(self, ref: str, art: ModelArtifact) -> None:
+        """Write the assembled artifact into the stable cache, atomically
+        enough for concurrent processes: build in a per-pid temp dir, then
+        rename into place; a lost race just adopts the winner's copy
+        (identical bytes — both assembled the same chunk stream)."""
+        import hashlib
+        import os
+        import shutil
+
+        name = hashlib.sha256(ref.encode()).hexdigest()[:24]
+        dest = self._root() / name
+        tmp = self._root() / f".{name}.tmp-{os.getpid()}"
+        shutil.rmtree(tmp, ignore_errors=True)
+        art.write(tmp)
+        shutil.rmtree(dest, ignore_errors=True)
+        try:
+            os.replace(tmp, dest)
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)  # another process won
+        with self._lock:
+            self._cache.pop(ref, None)
+            self._cache[ref] = dest  # (re)insert at LRU tail
+            while len(self._cache) > self.MAX_CACHED:
+                old_ref, old_dir = next(iter(self._cache.items()))
+                if old_ref == ref:
+                    break
+                del self._cache[old_ref]
+                shutil.rmtree(old_dir, ignore_errors=True)
+
+    def _evict_locked(self, keep: str) -> None:
+        total = sum(
+            len(c)
+            for e in self._pending.values()
+            for c in e["chunks"].values()
+        )
+        while total > self.MAX_PENDING_BYTES:
+            victim = next(
+                (r for r in self._pending if r != keep), None
+            )
+            if victim is None:
+                return  # never evict the ref currently being assembled
+            ev = self._pending.pop(victim)
+            total -= sum(len(c) for c in ev["chunks"].values())
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "artifact relay evicted pending chunks for %s", victim
+            )
+
+    def resolve(self, ref: str) -> str:
+        """A readable local path for a MODEL-REF: the path itself when it
+        exists, else the bus-assembled cache copy. FileNotFoundError (an
+        OSError — the dispatch loop's transient-I/O retry class) when
+        neither is available yet."""
+        p = Path(strip_scheme(ref))
+        if (p / MODEL_FILENAME).exists() or p.is_file():
+            return str(p)
+        with self._lock:
+            c = self._cache.get(ref)
+        if c is not None:
+            return str(c)
+        raise FileNotFoundError(
+            f"MODEL-REF {ref} is not readable locally and no complete "
+            f"bus-chunked copy has arrived"
+        )
+
+
+_RELAY: ArtifactRelay | None = None
+
+
+def artifact_relay() -> ArtifactRelay:
+    """Process-global relay: one consumer-side cache shared by every
+    listener thread in the process."""
+    global _RELAY
+    if _RELAY is None:
+        _RELAY = ArtifactRelay()
+    return _RELAY
+
+
+def publish_model_ref(
+    producer,
+    serialized: str,
+    model_path: str,
+    max_message_size: int,
+    transfer: bool = True,
+) -> None:
+    """Publish an oversized model as MODEL-CHUNK x N + MODEL-REF. transfer
+    False restores the reference's bare-path behavior (shared-mount
+    deployments that don't want the topic to carry the artifact bytes)."""
+    # headroom for the JSON envelope (ref path + indices + sha), then 4/3
+    # b64 expansion; a cap too small to carry even the envelope falls back
+    # to the bare reference (chunks would overrun the topic's limit)
+    budget = (max_message_size - 512 - len(model_path)) // 4 * 3
+    if transfer and budget < 1:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "update-topic max-size %d too small for artifact chunks; "
+            "publishing bare MODEL-REF (consumers need path access)",
+            max_message_size,
+        )
+        transfer = False
+    if transfer:
+        import hashlib
+        import math
+
+        raw = serialized.encode("utf-8")
+        sha = hashlib.sha256(raw).hexdigest()
+        n = max(1, math.ceil(len(raw) / budget))
+        for i in range(n):
+            producer.send(
+                CHUNK_KEY,
+                json.dumps(
+                    {
+                        "ref": model_path,
+                        "i": i,
+                        "n": n,
+                        "sha": sha,
+                        "data": base64.b64encode(
+                            raw[i * budget : (i + 1) * budget]
+                        ).decode("ascii"),
+                    },
+                    separators=(",", ":"),
+                ),
+            )
+    producer.send("MODEL-REF", model_path)

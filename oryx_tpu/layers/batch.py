@@ -162,13 +162,20 @@ class BatchLayer:
             self._producer = _NullProducer(self.update_topic)
 
     def _pod_window(self, ts: int) -> tuple[int, "dict[int, int] | None"]:
-        """Agree the generation boundary pod-wide. Members' timers fire at
-        different moments, and an unsynchronized poll_available() would
-        hand each member a DIFFERENT record set — mismatched factor
-        shapes under the pod mesh wedge the (non-elastic) collectives.
-        So every member allgathers (timestamp, end offsets) and adopts
-        the leader's row: same window, same split timestamp, everywhere.
-        The allgather doubles as the generation barrier that aligns the
+        """Agree the generation boundary pod-wide — BOTH edges. Members'
+        timers fire at different moments, and an unsynchronized
+        poll_available() would hand each member a DIFFERENT record set —
+        mismatched factor shapes under the pod mesh wedge the
+        (non-elastic) collectives. So every member allgathers
+        (timestamp, start positions, end offsets) and adopts the leader's
+        row: non-leaders seek() to the leader's delivered positions (their
+        own start='committed' resolves independently — to their own log
+        END at their own startup instant on a fresh group, or to whatever
+        their per-process group last committed — so staggered startup or
+        divergent past commits would otherwise skew the window's START
+        even with an agreed end), then every member drains to the
+        leader's END. Same window, same split timestamp, everywhere. The
+        allgather doubles as the generation barrier that aligns the
         members' cadence. Single-process: no-op."""
         if not self._pod_member:
             return ts, None
@@ -181,8 +188,13 @@ class BatchLayer:
         from oryx_tpu.parallel.distributed import host_allgather
 
         ends = self._consumer.end_offsets()
+        starts = self._consumer.positions()
         parts = sorted(ends)
-        vals = [ts] + [ends[p] for p in parts]
+        vals = (
+            [ts]
+            + [starts.get(p, 0) for p in parts]
+            + [ends[p] for p in parts]
+        )
         # hi/lo 32-bit lanes: jax without x64 silently truncates int64
         # arrays to int32, and a millisecond timestamp (or a mature kafka
         # offset) does not fit — observed as negative generation ids
@@ -191,7 +203,15 @@ class BatchLayer:
         )
         lead = host_allgather(local)[0].astype(np.int64)
         agreed = [int(hi) << 32 | int(lo) for hi, lo in lead]
-        return agreed[0], {p: agreed[i + 1] for i, p in enumerate(parts)}
+        n = len(parts)
+        lead_starts = {p: agreed[1 + i] for i, p in enumerate(parts)}
+        lead_ends = {p: agreed[1 + n + i] for i, p in enumerate(parts)}
+        if not self.is_leader and starts != lead_starts:
+            log.info(
+                "pod member seeking to leader start positions %s", lead_starts
+            )
+            self._consumer.seek(lead_starts)
+        return agreed[0], lead_ends
 
     def run_generation(self, timestamp_ms: int | None = None) -> int:
         """Execute one batch generation synchronously; returns the number of

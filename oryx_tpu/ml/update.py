@@ -43,6 +43,11 @@ class MLUpdate(BatchLayerUpdate):
         self.eval_parallelism = config.get_int("oryx.ml.eval.parallelism", 1)
         self.threshold = config.get("oryx.ml.eval.threshold", None)
         self.max_message_size = config.get_int("oryx.update-topic.message.max-size", 1 << 24)
+        # bus-chunked MODEL-REF artifact bytes (cross-host resolution with
+        # no shared mount); off restores the reference's bare-path publish
+        self.artifact_transfer = config.get_bool(
+            "oryx.update-topic.artifact-transfer", True
+        )
         from oryx_tpu.parallel.distributed import DistributedConfig
 
         self._pod = DistributedConfig.from_config(config).enabled
@@ -187,9 +192,17 @@ class MLUpdate(BatchLayerUpdate):
         self, model: ModelArtifact, model_path: str, producer: TopicProducer
     ) -> None:
         """Inline when small enough, else a path reference
-        (MLUpdate.java:212-231)."""
+        (MLUpdate.java:212-231) — preceded by the bus-chunked artifact
+        bytes so consumers on other hosts can resolve it without a shared
+        filesystem (common/artifact.py ArtifactRelay; the reference leans
+        on a shared Hadoop FileSystem instead, AppPMMLUtils.java:261-275)."""
+        from oryx_tpu.common.artifact import publish_model_ref
+
         serialized = model.to_string()
         if len(serialized.encode("utf-8")) <= self.max_message_size:
             producer.send("MODEL", serialized)
         else:
-            producer.send("MODEL-REF", model_path)
+            publish_model_ref(
+                producer, serialized, model_path, self.max_message_size,
+                transfer=self.artifact_transfer,
+            )

@@ -79,6 +79,11 @@ MAX_FRAME_SIZE = 16384  # we never raise it; peers must not send larger
 DEFAULT_WINDOW = 65535
 MAX_HEADER_BLOCK = 64 * 1024
 MAX_STREAMS = 256
+# read deadlines: ACTIVE_READ_TIMEOUT between frames while streams are
+# open (covers slow uploads), IDLE_READ_TIMEOUT otherwise and for the
+# CONTINUATION frames of an unfinished header block
+ACTIVE_READ_TIMEOUT = 300.0
+IDLE_READ_TIMEOUT = 75.0
 
 
 class ConnectionError2(Exception):
@@ -177,7 +182,25 @@ class Http2Connection:
             if self.upgraded_request is not None:
                 # h2c upgrade: the original HTTP/1.1 request becomes
                 # stream 1, half-closed (remote) — respond once the h2
-                # layer is up (RFC 7540 §3.2)
+                # layer is up (RFC 7540 §3.2). The HTTP2-Settings header
+                # is the client's initial SETTINGS (§3.2.1): apply it
+                # BEFORE opening stream 1 so e.g. a smaller
+                # INITIAL_WINDOW_SIZE governs the stream-1 response
+                # (strict clients treat an overrun as FLOW_CONTROL_ERROR)
+                h2s = self.upgraded_request[2].get("http2-settings", "")
+                if h2s:
+                    import base64
+                    import binascii
+
+                    try:
+                        raw = base64.urlsafe_b64decode(
+                            h2s + "=" * (-len(h2s) % 4)
+                        )
+                    except (ValueError, binascii.Error):
+                        raise ConnectionError2(
+                            PROTOCOL_ERROR, "bad HTTP2-Settings header"
+                        ) from None
+                    await self._on_settings(0, raw, ack=False)
                 st = _Stream(1, self.peer_initial_window)
                 st.remote_closed = True
                 self.streams[1] = st
@@ -232,7 +255,9 @@ class Http2Connection:
             self._mark_busy(bool(self.streams))
             ftype, flags, sid, payload = await asyncio.wait_for(
                 self._read_frame(),
-                timeout=300 if self.streams else 75,
+                timeout=(
+                    ACTIVE_READ_TIMEOUT if self.streams else IDLE_READ_TIMEOUT
+                ),
             )
             self._mark_busy(True)
             if ftype == HEADERS:
@@ -270,7 +295,12 @@ class Http2Connection:
                     )
             # unknown frame types are ignored (RFC 7540 §4.1)
 
-    async def _on_settings(self, flags: int, payload: bytes) -> None:
+    async def _on_settings(
+        self, flags: int, payload: bytes, ack: bool = True
+    ) -> None:
+        """Apply a client SETTINGS payload. ack=False for the h2c
+        HTTP2-Settings upgrade header (RFC 7540 §3.2.1: treated as the
+        client's initial SETTINGS but never ACKed as a frame)."""
         if flags & FLAG_ACK:
             return
         if len(payload) % 6:
@@ -293,7 +323,8 @@ class Http2Connection:
             elif ident == S_HEADER_TABLE_SIZE:
                 # our stateless encoder never indexes, so any size is fine
                 pass
-        await self._send_frame(SETTINGS, FLAG_ACK, 0)
+        if ack:
+            await self._send_frame(SETTINGS, FLAG_ACK, 0)
 
     async def _on_window_update(self, sid: int, payload: bytes) -> None:
         if len(payload) != 4:
@@ -324,7 +355,12 @@ class Http2Connection:
         fragment = bytearray(payload)
         end_headers = flags & FLAG_END_HEADERS
         while not end_headers:
-            ftype, cflags, csid, cpayload = await self._read_frame()
+            # bounded like the frame loop's reads: a client that sends
+            # HEADERS without END_HEADERS then stalls must not pin the
+            # connection (and its graceful-shutdown busy slot) forever
+            ftype, cflags, csid, cpayload = await asyncio.wait_for(
+                self._read_frame(), timeout=IDLE_READ_TIMEOUT
+            )
             if ftype != CONTINUATION or csid != sid:
                 raise ConnectionError2(
                     PROTOCOL_ERROR, "HEADERS not followed by CONTINUATION"

@@ -1,0 +1,300 @@
+"""Cross-host MODEL-REF resolution over the bus (round-3 verdict #3).
+
+The reference reads MODEL-REF paths through a shared Hadoop FileSystem
+(app/oryx-app-common .../pmml/AppPMMLUtils.java:261-275, FileSystem.get),
+so every host can fetch the model. Without HDFS, the framework ships the
+oversized artifact's bytes as MODEL-CHUNK messages ahead of the MODEL-REF;
+the consumer-side ArtifactRelay assembles them into a local cache that
+read_artifact_from_update falls back to when the path isn't readable.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import oryx_tpu.common.artifact as artifact_mod
+from oryx_tpu.common.artifact import (
+    CHUNK_KEY,
+    ArtifactRelay,
+    ModelArtifact,
+    publish_model_ref,
+    read_artifact_from_update,
+)
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _fresh_relay(monkeypatch):
+    monkeypatch.setattr(artifact_mod, "_RELAY", None)
+
+
+class _CaptureProducer:
+    def __init__(self):
+        self.sent: list[tuple[str, str]] = []
+
+    def send(self, key, message):
+        self.sent.append((key, message))
+
+
+def _sample_artifact() -> ModelArtifact:
+    # big enough that to_string() far exceeds the 1024-byte max-size the
+    # tests publish with (forcing the MODEL-REF + chunk path)
+    rng = np.random.default_rng(5)
+    return ModelArtifact(
+        "kmeans",
+        {"k": "3"},
+        {"counts": [4, 5, 6]},
+        {"centers": rng.standard_normal((3, 2048)).astype(np.float32)},
+    )
+
+
+def test_publish_chunks_then_ref_and_reassemble_out_of_order(tmp_path):
+    art = _sample_artifact()
+    serialized = art.to_string()
+    prod = _CaptureProducer()
+    ref = str(tmp_path / "model" / "12345")  # never written: no shared fs
+    publish_model_ref(prod, serialized, ref, max_message_size=1024)
+    keys = [k for k, _ in prod.sent]
+    assert keys[-1] == "MODEL-REF"
+    chunks = [m for k, m in prod.sent if k == CHUNK_KEY]
+    assert len(chunks) > 1  # really chunked at this max size
+    for k, m in prod.sent[:-1]:
+        assert len(m.encode()) <= 1024  # every chunk respects max-size
+
+    relay = artifact_mod.artifact_relay()
+    # before any chunk: unresolvable, and as an OSError (retry class)
+    with pytest.raises(OSError):
+        relay.resolve(ref)
+    # out-of-order arrival
+    for m in reversed(chunks):
+        relay.offer(m)
+    loaded = ModelArtifact.read(relay.resolve(ref))
+    assert loaded.app == "kmeans"
+    assert loaded.content["counts"] == [4, 5, 6]
+    np.testing.assert_array_equal(
+        loaded.tensors["centers"], art.tensors["centers"]
+    )
+    # the full consumer path resolves through the relay too
+    art2 = read_artifact_from_update("MODEL-REF", ref)
+    assert art2.extensions["k"] == "3"
+
+
+def test_local_path_wins_over_cache(tmp_path):
+    art = _sample_artifact()
+    local = tmp_path / "local-model"
+    art.write(local)
+    relay = ArtifactRelay()
+    assert relay.resolve(str(local)) == str(local)
+
+
+def test_sha_mismatch_rejected(tmp_path):
+    art = _sample_artifact()
+    prod = _CaptureProducer()
+    ref = str(tmp_path / "m")
+    publish_model_ref(prod, art.to_string(), ref, max_message_size=1024)
+    chunks = [m for k, m in prod.sent if k == CHUNK_KEY]
+    relay = artifact_mod.artifact_relay()
+    for m in chunks[:-1]:
+        relay.offer(m)
+    last = json.loads(chunks[-1])
+    last["data"] = last["data"][:-8] + "AAAAAAAA"  # corrupt the payload
+    with pytest.raises(ValueError):
+        relay.offer(json.dumps(last))
+    with pytest.raises(OSError):
+        relay.resolve(ref)
+
+
+def test_transfer_flag_off_sends_bare_ref(tmp_path):
+    prod = _CaptureProducer()
+    publish_model_ref(
+        prod, _sample_artifact().to_string(), str(tmp_path / "m"),
+        max_message_size=1024, transfer=False,
+    )
+    assert [k for k, _ in prod.sent] == ["MODEL-REF"]
+
+
+def test_serving_manager_loads_chunked_model_without_path(tmp_path):
+    """In-process end-to-end: the k-means serving manager consumes the
+    chunk stream + MODEL-REF through its normal dispatch loop and loads
+    the model even though the referenced path never existed here."""
+    from oryx_tpu.apps.kmeans.serving import KMeansServingModelManager
+    from oryx_tpu.bus.api import KeyMessage
+    from oryx_tpu.common.config import load_config
+
+    art = _sample_artifact()
+    prod = _CaptureProducer()
+    ref = str(tmp_path / "never-written" / "999")
+    publish_model_ref(prod, art.to_string(), ref, max_message_size=1024)
+
+    cfg = load_config(
+        overlay={
+            "oryx.input-schema.num-features": 8,
+            "oryx.input-schema.feature-names": [f"f{i}" for i in range(8)],
+            "oryx.input-schema.numeric-features": [f"f{i}" for i in range(8)],
+        }
+    )
+    mgr = KMeansServingModelManager(cfg)
+    mgr.consume(iter([KeyMessage(k, m) for k, m in prod.sent]))
+    assert mgr.model is not None
+    assert mgr.model.centers.shape == (3, 2048)
+
+
+def test_cross_process_model_ref(tmp_path):
+    """The VERDICT's done-bar: a batch process publishes a >max-size model
+    over a file:// bus from ITS data dir; a serving consumer with no
+    access to that dir (deleted here — no shared mount) still loads it."""
+    bus = tmp_path / "bus"
+    model_root = tmp_path / "batch-host-models"
+    pub = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import numpy as np\n"
+        "from oryx_tpu.bus.broker import get_broker, topics\n"
+        "from oryx_tpu.bus.api import TopicProducer\n"
+        "from oryx_tpu.common.artifact import ModelArtifact\n"
+        "from oryx_tpu.common.config import load_config\n"
+        "from oryx_tpu.ml.update import MLUpdate\n"
+        "uri = 'file://%s'\n"
+        "topics.maybe_create(uri, 'OryxUpdate', partitions=1)\n"
+        "rng = np.random.default_rng(5)\n"
+        "art = ModelArtifact('kmeans', {'k': '3'}, {'counts': [4, 5, 6]},\n"
+        "                    {'centers': rng.standard_normal((3, 2048)).astype(np.float32)})\n"
+        "path = art.write(%r)\n"
+        "cfg = load_config(overlay={'oryx.update-topic.message.max-size': 1024})\n"
+        "class Pub(MLUpdate):\n"
+        "    def build_model(self, *a, **k): raise NotImplementedError\n"
+        "    def evaluate(self, *a, **k): raise NotImplementedError\n"
+        "prod = TopicProducer(get_broker(uri), 'OryxUpdate')\n"
+        "Pub(cfg).publish_model(art, str(path), prod)\n"
+        "print('PUBLISHED')\n"
+    ) % (str(ROOT), bus, str(model_root / "12345"))
+    r = subprocess.run(
+        [sys.executable, "-c", pub], capture_output=True, text=True, timeout=120
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "PUBLISHED" in r.stdout
+
+    # simulate a different host: the batch host's model dir is unreachable
+    import shutil
+
+    shutil.rmtree(model_root)
+
+    from oryx_tpu.apps.kmeans.serving import KMeansServingModelManager
+    from oryx_tpu.bus.api import ConsumeDataIterator
+    from oryx_tpu.bus.broker import get_broker
+    from oryx_tpu.common.config import load_config
+
+    cfg = load_config(
+        overlay={
+            "oryx.input-schema.num-features": 8,
+            "oryx.input-schema.feature-names": [f"f{i}" for i in range(8)],
+            "oryx.input-schema.numeric-features": [f"f{i}" for i in range(8)],
+        }
+    )
+    mgr = KMeansServingModelManager(cfg)
+    it = ConsumeDataIterator(
+        get_broker(f"file://{bus}"), "OryxUpdate", group="s", start="earliest"
+    )
+    msgs = it.poll_available()
+    assert any(k == "MODEL-REF" for k, _ in msgs)
+    mgr.consume(iter(msgs))
+    assert mgr.model is not None
+    assert mgr.model.centers.shape == (3, 2048)
+    np.testing.assert_array_equal(
+        np.asarray(mgr.model.counts), np.array([4, 5, 6])
+    )
+
+
+def test_cache_stable_across_restarts_and_capped(tmp_path, monkeypatch):
+    """Replay on restart must overwrite the same cache paths (no growth),
+    and the per-process cache is LRU-capped so history can't accrete."""
+    art = _sample_artifact()
+    prod = _CaptureProducer()
+    refs = [str(tmp_path / f"gen-{g}") for g in range(3)]
+    for ref in refs:
+        publish_model_ref(prod, art.to_string(), ref, max_message_size=4096)
+    chunk_msgs = [(k, m) for k, m in prod.sent if k == CHUNK_KEY]
+
+    croot = tmp_path / "cache-root"
+    croot.mkdir()
+
+    def fresh_relay():
+        r = ArtifactRelay()
+        r._cache_root = croot  # isolate from other tests' shared root
+        return r
+
+    r1 = fresh_relay()
+    for _, m in chunk_msgs:
+        r1.offer(m)
+    dests1 = {ref: r1.resolve(ref) for ref in refs}
+
+    # a "restarted" process replays the same history: same dests, nothing
+    # new on disk
+    r2 = fresh_relay()
+    for _, m in chunk_msgs:
+        r2.offer(m)
+    for ref in refs:
+        assert r2.resolve(ref) == dests1[ref]
+    root = Path(dests1[refs[0]]).parent
+    entries = [p for p in root.iterdir() if not p.name.startswith(".")]
+    assert len(entries) == len(refs)
+
+    # LRU cap: with MAX_CACHED=2, materializing 3 refs keeps only the
+    # newest two on disk (in this relay's view)
+    monkeypatch.setattr(ArtifactRelay, "MAX_CACHED", 2)
+    r3 = fresh_relay()
+    for _, m in chunk_msgs:
+        r3.offer(m)
+    with pytest.raises(OSError):
+        r3.resolve(refs[0])  # evicted
+    assert r3.resolve(refs[2])  # newest survives
+
+
+def test_oversized_pending_is_never_self_evicted(monkeypatch):
+    """An artifact bigger than the pending cap must still assemble — only
+    OTHER refs' stale chunks are evicted (the in-flight transfer's memory
+    floor is the artifact size, same as the publisher paid)."""
+    monkeypatch.setattr(ArtifactRelay, "MAX_PENDING_BYTES", 1024)
+    art = _sample_artifact()  # serialized ~30KB >> 1KB cap
+    prod = _CaptureProducer()
+    ref = "/nowhere/big-model"
+    publish_model_ref(prod, art.to_string(), ref, max_message_size=4096)
+    relay = ArtifactRelay()
+    for k, m in prod.sent:
+        if k == CHUNK_KEY:
+            relay.offer(m)
+    loaded = ModelArtifact.read(relay.resolve(ref))
+    np.testing.assert_array_equal(
+        loaded.tensors["centers"], art.tensors["centers"]
+    )
+
+
+def test_republish_with_new_bytes_restarts_assembly(tmp_path):
+    """Same chunk count, new content (publisher rebuilt the model at the
+    same path): the assembly must restart on the new sha, not verify the
+    mixed stream against the stale one forever."""
+    rng = np.random.default_rng(9)
+    ref = str(tmp_path / "gen")
+    old = ModelArtifact("kmeans", {}, {}, {"centers": rng.standard_normal((3, 2048)).astype(np.float32)})
+    new = ModelArtifact("kmeans", {}, {}, {"centers": rng.standard_normal((3, 2048)).astype(np.float32)})
+    p_old, p_new = _CaptureProducer(), _CaptureProducer()
+    publish_model_ref(p_old, old.to_string(), ref, max_message_size=4096)
+    publish_model_ref(p_new, new.to_string(), ref, max_message_size=4096)
+    old_chunks = [m for k, m in p_old.sent if k == CHUNK_KEY]
+    new_chunks = [m for k, m in p_new.sent if k == CHUNK_KEY]
+    assert len(old_chunks) == len(new_chunks)  # same n: the nasty case
+    relay = ArtifactRelay()
+    for m in old_chunks[: len(old_chunks) // 2]:  # publisher died mid-send
+        relay.offer(m)
+    for m in new_chunks:  # republish, full stream
+        relay.offer(m)
+    loaded = ModelArtifact.read(relay.resolve(ref))
+    np.testing.assert_array_equal(
+        loaded.tensors["centers"], new.tensors["centers"]
+    )

@@ -314,3 +314,109 @@ def test_digest_auth_over_h2():
             f"http://127.0.0.1:{sl.port}/ready",
         )
         assert r3.stdout.startswith("HTTP/2 401"), r3.stdout[:200]
+
+
+def test_h2c_upgrade_applies_http2_settings_header():
+    """RFC 7540 §3.2.1: the HTTP2-Settings upgrade header IS the client's
+    initial SETTINGS. A client advertising INITIAL_WINDOW_SIZE=8 must not
+    be overrun by the stream-1 response: the server may send at most 8
+    DATA bytes until the client grants more window (round-3 advice)."""
+    import base64
+
+    bus = "mem://h2upsettings"
+    _setup_bus(bus)
+    with ServingLayer(_config(bus, "async")) as sl:
+        _wait_ready(sl.port)
+        settings_payload = struct.pack(">HI", 0x4, 8)  # INITIAL_WINDOW_SIZE=8
+        h2s = base64.urlsafe_b64encode(settings_payload).rstrip(b"=")
+        with socket.create_connection(("127.0.0.1", sl.port), 10) as s:
+            s.settimeout(10)
+            s.sendall(
+                b"GET /distinct HTTP/1.1\r\nHost: x\r\n"
+                b"Connection: Upgrade, HTTP2-Settings\r\n"
+                b"Upgrade: h2c\r\nHTTP2-Settings: " + h2s + b"\r\n\r\n"
+            )
+            f = s.makefile("rb")
+            status = f.readline()
+            assert b"101" in status, status
+            while f.readline() not in (b"\r\n", b"\n", b""):
+                pass
+            # client connection preface after the 101 (RFC 7540 §3.2)
+            s.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
+            s.sendall(_frame(0x4, 0, 0))  # empty SETTINGS frame
+            from oryx_tpu.serving.hpack import Decoder
+
+            dec = Decoder()
+            body = b""
+            ended = False
+            granted = False
+            status_hdrs = None
+            while not ended:
+                ftype, flags, sid, payload = _read_frame(f)
+                if ftype == 0x4 and not flags & 0x1:
+                    s.sendall(_frame(0x4, 0x1, 0))  # ack server SETTINGS
+                elif ftype == 0x1 and sid == 1:
+                    status_hdrs = dict(dec.decode(payload))
+                elif ftype == 0x0 and sid == 1:
+                    body += payload
+                    if flags & 0x1:
+                        ended = True
+                    elif not granted:
+                        # the pre-grant DATA must respect the 8-byte
+                        # window from the upgrade header
+                        assert len(body) <= 8, (
+                            f"server overran the advertised window: "
+                            f"{len(body)} bytes before any WINDOW_UPDATE"
+                        )
+                        if len(body) == 8:
+                            granted = True
+                            s.sendall(
+                                _frame(0x8, 0, 1, struct.pack(">I", 4096))
+                            )
+            assert status_hdrs is not None and status_hdrs[b":status"] == b"200"
+            assert len(body) > 8, body  # response really was bigger
+            assert json.loads(body)["word"] == 2
+            s.sendall(_frame(0x7, 0, 0, struct.pack(">II", 0, 0)))
+
+
+def test_continuation_stall_times_out(monkeypatch):
+    """A client that sends HEADERS without END_HEADERS then stalls must
+    be disconnected after the idle read deadline, not pin the connection
+    forever (round-3 advice)."""
+    import time as _time
+
+    from oryx_tpu.serving import http2 as h2mod
+
+    monkeypatch.setattr(h2mod, "IDLE_READ_TIMEOUT", 1.0)
+    from oryx_tpu.serving.hpack import encode
+
+    bus = "mem://h2stall"
+    _setup_bus(bus)
+    with ServingLayer(_config(bus, "async")) as sl:
+        _wait_ready(sl.port)
+        with socket.create_connection(("127.0.0.1", sl.port), 10) as s:
+            s.settimeout(10)
+            s.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
+            s.sendall(_frame(0x4, 0, 0))
+            block = encode(
+                [
+                    (b":method", b"GET"),
+                    (b":scheme", b"http"),
+                    (b":path", b"/ready"),
+                    (b":authority", b"x"),
+                ]
+            )
+            # HEADERS with END_STREAM but WITHOUT END_HEADERS: the server
+            # now waits for CONTINUATION frames that never come
+            s.sendall(_frame(0x1, 0x1, 1, block))
+            t0 = _time.time()
+            f = s.makefile("rb")
+            # drain whatever the server sends; EOF must arrive well within
+            # the (patched) deadline + slack, not hang past 10s
+            while True:
+                head = f.read(9)
+                if len(head) < 9:
+                    break
+                length = int.from_bytes(head[:3], "big")
+                f.read(length)
+            assert _time.time() - t0 < 8.0

@@ -213,7 +213,9 @@ def test_harness_model_ref_when_oversized(tmp_path):
     broker.create_topic("U", partitions=1)
     upd = _BigModel(cfg)
     upd.run_update(7, [KeyMessage(None, "x")], [], str(tmp_path / "m"), TopicProducer(broker, "U"))
-    recs = broker.read("U", 0, 0, 10)
+    recs = broker.read("U", 0, 0, 1000)
+    # a 64-byte cap cannot carry even one chunk envelope: the publish
+    # falls back to the bare reference instead of overrunning the topic
     assert recs[0][1] == "MODEL-REF"
     assert ModelArtifact.read(recs[0][2]).content["blob"] == "z" * 500
 

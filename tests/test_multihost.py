@@ -133,12 +133,127 @@ print(f"MULTIHOST_OK {pid}", flush=True)
 '''
 
 
+_POD_WINDOW_WORKER = r'''
+import sys
+
+sys.path.insert(0, sys.argv[4])
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from oryx_tpu.api import BatchLayerUpdate
+from oryx_tpu.bus.broker import get_broker, topics
+from oryx_tpu.common.config import load_config
+from oryx_tpu.layers.batch import BatchLayer
+from oryx_tpu.parallel.distributed import host_allgather, init_distributed
+
+pid, nprocs, port, root, bus_dir = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4], sys.argv[5]
+)
+uri = f"file://{bus_dir}"
+cfg = load_config(overlay={
+    "oryx.id": "podwin",
+    "oryx.input-topic.broker": uri,
+    "oryx.input-topic.message.topic": "OryxInput",
+    "oryx.update-topic.broker": uri,
+    "oryx.update-topic.message.topic": "OryxUpdate",
+    "oryx.batch.streaming.generation-interval-sec": 3600,
+    "oryx.batch.storage.data-dir": f"{bus_dir}/data",
+    "oryx.batch.storage.model-dir": f"{bus_dir}/model",
+    "oryx.compute.distributed.coordinator-address": f"127.0.0.1:{port}",
+    "oryx.compute.distributed.num-processes": nprocs,
+    "oryx.compute.distributed.process-id": pid,
+})
+assert init_distributed(cfg) is True
+
+
+class Captures(BatchLayerUpdate):
+    def __init__(self, *a):
+        self.windows = []
+
+    def run_update(self, ts, new_data, past_data, model_dir, producer):
+        self.windows.append([m.message for m in new_data])
+
+
+up = Captures()
+layer = BatchLayer(cfg, update=up)
+
+if pid == 0:
+    # the leader consumed records 0-1 in an earlier life: its group has a
+    # durable commit at offset 2
+    get_broker(uri).commit_offsets("OryxGroup-podwin-batch", "OryxInput", {0: 2})
+layer.ensure_streams()
+# the non-leader's fresh per-process group resolves start='committed' to
+# its OWN log end (10) — WITHOUT the pod-agreed start seek it would see
+# an empty window while the leader processes records 2..9
+layer.run_generation(timestamp_ms=1234)
+
+window = up.windows[0] if up.windows else []
+assert len(window) == 8, f"pid {pid}: window has {len(window)} records"
+assert window == [f"r{i}" for i in range(2, 10)], f"pid {pid}: {window}"
+lens = host_allgather(np.int32(len(window)))
+assert int(lens[0]) == int(lens[1]) == 8, lens
+print(f"PODWINDOW_OK {pid}", flush=True)
+'''
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def test_pod_window_agrees_both_edges(tmp_path):
+    """Round-3 advice (medium): _pod_window allgathered only END offsets,
+    so a non-leader whose start position resolved independently (fresh
+    group -> own log END at its own startup instant) consumed a DIFFERENT
+    record set than the leader. Two real processes over a shared file://
+    bus: the leader's group has a durable commit at offset 2, the
+    non-leader starts fresh after 10 records exist — both must process
+    exactly records 2..9."""
+    from oryx_tpu.bus.broker import get_broker, topics
+
+    bus_dir = tmp_path / "bus"
+    bus_dir.mkdir()
+    uri = f"file://{bus_dir}"
+    topics.maybe_create(uri, "OryxInput", partitions=1)
+    topics.maybe_create(uri, "OryxUpdate", partitions=1)
+    broker = get_broker(uri)
+    for i in range(10):
+        broker.send("OryxInput", None, f"r{i}")
+
+    port = _free_port()
+    from oryx_tpu.common.executil import cpu_subprocess_env
+
+    env = cpu_subprocess_env(dict(os.environ))
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    env["XLA_FLAGS"] = " ".join(flags + ["--xla_force_host_platform_device_count=2"])
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _POD_WINDOW_WORKER, str(i), "2", str(port),
+             str(ROOT), str(bus_dir)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=180)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-2000:]}"
+        assert f"PODWINDOW_OK {i}" in out, out[-2000:]
 
 
 def test_two_process_pod_collectives(tmp_path):

@@ -143,3 +143,23 @@ def test_pod_two_compute_plus_serving_e2e(tmp_path):
         if pod.poll() is None:
             pod.kill()
             pod.wait()
+
+
+def test_pod_child_flags_keeps_pod_valued_flags():
+    """The argv rebuild must drop only the SUBCOMMAND token 'pod' and the
+    pod-only flags — a legitimate flag value spelled 'pod' (e.g.
+    --conf pod, or --set oryx.id=pod tokenized oddly) survives
+    (round-3 advice)."""
+    from oryx_tpu.cli import _pod_child_flags
+
+    argv = [
+        "pod", "--conf", "pod", "--compute", "4", "--coordinator",
+        "h:1", "--set", "oryx.id=pod", "--serving",
+    ]
+    assert _pod_child_flags(argv) == [
+        "--conf", "pod", "--set", "oryx.id=pod",
+    ]
+    # '=' forms of pod flags are dropped whole
+    assert _pod_child_flags(["pod", "--compute=8", "--conf", "x.conf"]) == [
+        "--conf", "x.conf",
+    ]
