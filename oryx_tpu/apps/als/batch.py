@@ -102,7 +102,7 @@ class ALSUpdate(MLUpdate):
             alpha=float(hyperparams["alpha"]),
             iterations=self.als.iterations,
             implicit=self.als.implicit,
-            mesh=self.mesh,
+            mesh=self._build_mesh(),
             compute_dtype=self.als.compute_dtype,
         )
         model_dir = self.config.get_string("oryx.batch.storage.model-dir", None)

@@ -50,7 +50,7 @@ class KMeansUpdate(MLUpdate):
             k=int(hyperparams["k"]),
             iterations=self.kmeans.iterations,
             init=self.kmeans.init_strategy,
-            mesh=self.mesh,
+            mesh=self._build_mesh(),
             runs=self.kmeans.runs,
         )
         art = ModelArtifact(

@@ -99,7 +99,7 @@ class RDFUpdate(MLUpdate):
             max_depth=int(hyperparams["max-depth"]),
             impurity=impurity,
             n_classes=n_classes,
-            mesh=self.mesh,
+            mesh=self._build_mesh(),
         )
         return forest_to_artifact(
             forest, data.edges, data.n_bins, encodings, self.schema, hyperparams

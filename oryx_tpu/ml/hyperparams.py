@@ -90,12 +90,16 @@ class DiscreteAround(HyperParamRange):
         self.value, self.step = int(value), int(step)
 
     def trial_values(self, n: int) -> list:
+        if n <= 1 or self.step == 0:
+            # step 0 pins the value; without this guard the growing-set
+            # loop below never terminates for n >= 2
+            return [self.value]
         out = {self.value}
         i = 1
         while len(out) < n:
             out |= {self.value - i * self.step, self.value + i * self.step}
             i += 1
-        return sorted(out)[:n] if n > 0 else [self.value]
+        return sorted(out)[:n]
 
     def random_value(self, rng):
         return self.value + int(rng.integers(-1, 2)) * self.step
@@ -106,6 +110,8 @@ class ContinuousAround(HyperParamRange):
         self.value, self.step = float(value), float(step)
 
     def trial_values(self, n: int) -> list:
+        if n <= 1 or self.step == 0.0:
+            return [self.value]  # step 0 pins the value (no [v, v] dups)
         half = (n - 1) // 2
         return [self.value + i * self.step for i in range(-half, n - half)]
 
@@ -142,7 +148,14 @@ def grid_search(ranges: Mapping[str, HyperParamRange], how_many: int) -> list[di
     if not names:
         return [{}]
     how_many = min(max(1, how_many), MAX_COMBOS)
-    per_param = max(1, int(round(how_many ** (1.0 / len(names)))))
+    # spread the budget across parameters that can actually VARY: a fixed
+    # scalar contributes exactly one value regardless, and counting it
+    # would starve the real search axes (e.g. one varying lambda among
+    # fixed features/alpha got a budget of 1 and the "grid" collapsed to
+    # a single combo). set() dedupes degenerate ranges that return
+    # repeated values.
+    vary = sum(1 for n in names if len(set(ranges[n].trial_values(2))) > 1)
+    per_param = max(1, int(round(how_many ** (1.0 / max(1, vary)))))
     value_lists = [ranges[n].trial_values(per_param) for n in names]
     combos = [dict(zip(names, vals)) for vals in itertools.product(*value_lists)]
     return combos[:MAX_COMBOS]

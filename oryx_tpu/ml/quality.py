@@ -1,13 +1,17 @@
-"""Shared ALS build-and-evaluate harness: the bench's training stage and
-the nightly 25M quality gate (tests/test_quality_gate.py) run the SAME
-code, so the bf16 singularity guard (ops/als.py _half_step jitter retry)
-cannot silently regress between bench runs.
+"""Shared build-and-evaluate harnesses: the bench's training stages and
+the nightly quality gates (tests/test_quality_gate.py) run the SAME
+code, so a silent quality regression in any trainer fails both.
 
-Measures what BASELINE.json's north star asks for: end-to-end build
-wall-clock at a given interaction scale plus held-out mean-per-user AUC
-— with NaN factor rows surfaced as a first-class diagnostic (NaN scores
-compare False everywhere, which would silently zero the AUC instead of
-failing it).
+- ALS: the bf16 singularity guard (ops/als.py _half_step jitter retry)
+  cannot silently regress between bench runs. Measures what
+  BASELINE.json's north star asks for: end-to-end build wall-clock at a
+  given interaction scale plus held-out mean-per-user AUC — with NaN
+  factor rows surfaced as a first-class diagnostic.
+- RDF: planted-rule synthetic at covertype shape with a held-out
+  accuracy floor (reference eval: RDFUpdate.java:179-205).
+- k-means: planted Gaussian blobs; SSE against the true generating
+  centers plus silhouette (reference eval strategies:
+  KMeansUpdate.java:137-173 and the four metric classes).
 """
 
 from __future__ import annotations
@@ -123,4 +127,153 @@ def build_and_evaluate(
         nan_rows=nan_rows,
         interactions=nnz,
         timings=timings,
+    )
+
+
+@dataclass
+class RDFReport:
+    build_s: float
+    accuracy: float
+    examples: int
+    trees: int
+    noise_rate: float
+    n_classes: int
+
+    @property
+    def accuracy_ceiling(self) -> float:
+        """Achievable held-out accuracy: flipped labels agree with the
+        rule by chance 1/n_classes of the time. Lives here, next to the
+        label-flip code it must match."""
+        return 1.0 - self.noise_rate * (1.0 - 1.0 / self.n_classes)
+
+
+def build_and_evaluate_rdf(
+    n_examples: int = 581_012,
+    n_features: int = 54,
+    n_classes: int = 7,
+    num_trees: int = 20,
+    max_depth: int = 10,
+    noise_rate: float = 0.1,
+    holdout_p: float = 0.1,
+    seed: int = 13,
+) -> RDFReport:
+    """Planted-rule synthetic at UCI-covertype shape (581k x 54, 7
+    classes — BASELINE.json config #3): the label is a deterministic
+    rule over a handful of feature thresholds with `noise_rate` labels
+    flipped, so the achievable held-out accuracy is ~(1 - noise_rate)
+    and a healthy forest must land near it. Defaults mirror the
+    reference's covertype example config (oryx.rdf.num-trees etc.).
+
+    The rule mixes axis-aligned thresholds (what trees split on) across
+    several features with unequal class difficulty — deep enough that a
+    stump can't ace it, learnable enough that a regressed trainer
+    (broken histogram splits, bad bootstrap, mis-grown depth) falls far
+    below the floor.
+    """
+    from oryx_tpu.ops.rdf import bin_dataset, grow_forest, predict_class_probs
+
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n_examples, n_features)).astype(np.float32)
+    # planted rule over 4 axis-aligned thresholds — exactly representable
+    # by depth>=4 trees, so the held-out ceiling is (1 - noise) plus the
+    # chance agreement of flipped labels, and any shortfall measures the
+    # TRAINER (histogram splits, bootstrap, subset sampling), not an
+    # inexpressible concept
+    r1 = (x[:, 0] > 0).astype(np.int64)
+    r2 = (x[:, 7] > 0.5).astype(np.int64)
+    r3 = (x[:, 21] > -0.5).astype(np.int64)
+    r4 = (x[:, 40] > 0.3).astype(np.int64)
+    y_true = (r1 * 4 + r2 * 2 + r3 + r4) % n_classes
+    flip = rng.random(n_examples) < noise_rate
+    y = np.where(
+        flip, rng.integers(0, n_classes, n_examples), y_true
+    ).astype(np.int32)
+
+    test = rng.random(n_examples) < holdout_p
+    tr = ~test
+
+    t0 = time.perf_counter()
+    binned = bin_dataset(
+        x[tr],
+        is_categorical=np.zeros(n_features, dtype=bool),
+        category_counts=np.zeros(n_features, dtype=np.int32),
+        max_split_candidates=32,
+    )
+    forest = grow_forest(
+        binned, y[tr], num_trees=num_trees, max_depth=max_depth,
+        impurity="entropy", n_classes=n_classes,
+    )
+    build_s = time.perf_counter() - t0
+
+    # bin the held-out rows with the TRAINING edges (ops/rdf.py
+    # bin_column — the same path serving uses, apps/rdf/common.py)
+    from oryx_tpu.ops.rdf import bin_column
+
+    xt = x[test]
+    test_binned = np.empty_like(xt, dtype=np.int32)
+    for j in range(n_features):
+        test_binned[:, j] = bin_column(
+            xt[:, j], binned.edges[j], int(binned.n_bins[j])
+        )
+    probs = predict_class_probs(forest, test_binned)
+    acc = float((np.argmax(probs, axis=1) == y[test]).mean())
+    return RDFReport(
+        build_s=build_s,
+        accuracy=acc,
+        examples=n_examples,
+        trees=num_trees,
+        noise_rate=noise_rate,
+        n_classes=n_classes,
+    )
+
+
+@dataclass
+class KMeansReport:
+    build_s: float
+    sse_ratio: float  # model SSE / planted-centers SSE (1.0 = perfect)
+    silhouette: float
+    points: int
+    k: int
+
+
+def build_and_evaluate_kmeans(
+    n_points: int = 1_000_000,
+    dims: int = 20,
+    k: int = 50,
+    iterations: int = 10,
+    spread: float = 5.0,
+    seed: int = 19,
+) -> KMeansReport:
+    """Planted Gaussian blobs: k true centers at `spread` separation,
+    unit-variance clusters. A healthy k-means|| + Lloyd's run recovers
+    near the generating structure: SSE within a small factor of the
+    planted-centers SSE, positive silhouette. A regressed init (bad
+    k-means|| weighting) or broken Lloyd's update inflates SSE or
+    collapses clusters and fails the floors."""
+    from oryx_tpu.ops.kmeans import (
+        silhouette_coefficient,
+        sum_squared_error,
+        train_kmeans,
+    )
+
+    rng = np.random.default_rng(seed)
+    centers_true = (rng.standard_normal((k, dims)) * spread).astype(np.float32)
+    pts = (
+        centers_true[rng.integers(0, k, n_points)]
+        + rng.standard_normal((n_points, dims))
+    ).astype(np.float32)
+
+    t0 = time.perf_counter()
+    model = train_kmeans(pts, k=k, iterations=iterations)
+    build_s = time.perf_counter() - t0
+
+    sse_model = sum_squared_error(pts, model.centers)
+    sse_true = sum_squared_error(pts, centers_true)
+    sil = silhouette_coefficient(pts, model.centers)
+    return KMeansReport(
+        build_s=build_s,
+        sse_ratio=float(sse_model / sse_true),
+        silhouette=float(sil),
+        points=n_points,
+        k=k,
     )

@@ -52,15 +52,19 @@ class MLUpdate(BatchLayerUpdate):
 
         self._pod = DistributedConfig.from_config(config).enabled
         if self._pod and self.eval_parallelism != 1:
-            # pod members train candidates over the SHARED mesh: parallel
-            # builds would launch each candidate's collectives in
-            # thread-scheduling order, which differs across members and
-            # deadlocks the group — candidates must run serially, in the
-            # same order, everywhere
+            # multi-PROCESS pod members train candidates over the SHARED
+            # mesh: parallel builds would launch each candidate's
+            # collectives in thread-scheduling order, which differs
+            # across members and deadlocks the group — candidates must
+            # run serially, in the same order, everywhere. (The
+            # single-process multi-device deployment gets true candidate
+            # parallelism instead: the mesh is partitioned into disjoint
+            # sub-meshes, one candidate per sub-mesh — see run_update.)
             log.warning(
-                "pod member: forcing oryx.ml.eval.parallelism=1 "
-                "(was %d) — parallel candidate builds would interleave "
-                "pod collectives differently on different members",
+                "multi-process pod member: forcing "
+                "oryx.ml.eval.parallelism=1 (was %d) — parallel candidate "
+                "builds would interleave pod collectives differently on "
+                "different members",
                 self.eval_parallelism,
             )
             self.eval_parallelism = 1
@@ -106,6 +110,21 @@ class MLUpdate(BatchLayerUpdate):
         """Hook for streaming data too large for the artifact message (ALS
         streams every factor row here, MLUpdate.java:233-236)."""
 
+    def training_mesh(self):
+        """The mesh candidate builds run on (apps that shard training set
+        self.mesh in __init__); None trains single-device."""
+        return getattr(self, "mesh", None)
+
+    def _build_mesh(self):
+        """The mesh for the CURRENT candidate build: the thread's assigned
+        sub-mesh during a partitioned parallel search, else the full
+        training mesh. App build_model implementations resolve their
+        trainer's mesh through this."""
+        from oryx_tpu.parallel.submesh import current_candidate_mesh
+
+        m = current_candidate_mesh()
+        return m if m is not None else self.training_mesh()
+
     # ---- the harness -----------------------------------------------------
 
     def run_update(
@@ -135,20 +154,60 @@ class MLUpdate(BatchLayerUpdate):
         root = Path(strip_scheme(model_dir))
         cand_root = mkdirs(root / ".candidates" / str(timestamp_ms))
 
+        # parallel search runs one candidate per DISJOINT sub-mesh (the
+        # TPU-native MLUpdate.java:253-258 — concurrent threads over one
+        # mesh would only contend): slice the mesh along its data axis,
+        # clamp the thread count to the number of sub-meshes, and hand
+        # each RUNNING build a mesh from a free pool (assignment by task
+        # index would let two in-flight candidates share devices whenever
+        # candidates outnumber sub-meshes)
+        mesh_pool = None
+        parallelism = min(self.eval_parallelism, len(combos))
+        if parallelism > 1 and not self._pod:
+            mesh = self.training_mesh()
+            if mesh is not None:
+                import queue
+
+                from oryx_tpu.parallel.submesh import partition_mesh
+
+                subs = partition_mesh(mesh, parallelism)
+                parallelism = min(parallelism, len(subs))
+                if parallelism > 1:
+                    mesh_pool = queue.Queue()
+                    for m in subs:
+                        mesh_pool.put(m)
+                    log.info(
+                        "parallel candidate search: %d sub-meshes of %s "
+                        "devices", len(subs),
+                        [m.devices.size for m in subs],
+                    )
+
         def build_and_eval(i: int) -> tuple[float, Path | None]:
+            from contextlib import nullcontext
+
+            from oryx_tpu.parallel.submesh import candidate_mesh
+
+            sub = mesh_pool.get() if mesh_pool is not None else None
+            ctx = candidate_mesh(sub) if sub is not None else nullcontext()
             try:
-                model = self.build_model(train, combos[i])
-                cand_dir = model.write(cand_root / str(i))
-                score = (
-                    self.evaluate(model, train, test) if test else float("nan")
-                )
+                with ctx:
+                    model = self.build_model(train, combos[i])
+                    cand_dir = model.write(cand_root / str(i))
+                    score = (
+                        self.evaluate(model, train, test)
+                        if test
+                        else float("nan")
+                    )
                 log.info("candidate %d %s -> eval %s", i, combos[i], score)
                 return score, cand_dir
             except Exception:
                 log.exception("candidate %d failed", i)
                 return float("nan"), None
+            finally:
+                if sub is not None:
+                    mesh_pool.put(sub)
 
-        results = collect_in_parallel(len(combos), build_and_eval, self.eval_parallelism)
+        results = collect_in_parallel(len(combos), build_and_eval, parallelism)
 
         best_i, best_score = -1, float("-inf")
         for i, (score, path) in enumerate(results):

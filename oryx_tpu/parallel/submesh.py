@@ -1,0 +1,69 @@
+"""Sub-mesh partitioning for parallel hyperparameter candidates.
+
+The reference builds and evaluates model candidates concurrently on the
+Spark cluster (framework/oryx-ml .../ml/MLUpdate.java:253-258,
+ExecUtils.collectInParallel with oryx.ml.eval.parallelism). The TPU-native
+equivalent cannot just thread the builds over ONE mesh — concurrent
+programs on the same devices merely contend, and on a multi-member pod
+they interleave collectives in thread-scheduling order and wedge the
+group. Instead the device mesh is PARTITIONED along its data axis into
+disjoint sub-meshes, one candidate per sub-mesh: each candidate's
+collectives run entirely inside its own device group, so the builds are
+truly concurrent and cannot deadlock each other.
+
+The active sub-mesh travels to the app's trainer through a thread-local
+(the build threads of oryx_tpu/ml/update.py each enter candidate_mesh());
+apps resolve it via MLUpdate._build_mesh() at build time.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+from jax.sharding import Mesh
+
+from oryx_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+_TLS = threading.local()
+
+
+def current_candidate_mesh() -> Mesh | None:
+    """The sub-mesh assigned to the candidate building on THIS thread, or
+    None outside a partitioned build."""
+    return getattr(_TLS, "mesh", None)
+
+
+@contextmanager
+def candidate_mesh(mesh: Mesh | None):
+    prev = getattr(_TLS, "mesh", None)
+    _TLS.mesh = mesh
+    try:
+        yield
+    finally:
+        _TLS.mesh = prev
+
+
+def partition_mesh(mesh: Mesh, k: int) -> list[Mesh]:
+    """Split a (data, model) mesh into up to k disjoint sub-meshes along
+    the data axis (contiguous slices, sizes as equal as possible; the
+    model axis is kept whole inside every sub-mesh — tensor-parallel
+    candidates stay tensor-parallel). Returns fewer than k meshes when
+    the data axis has fewer rows than k; a 1-row data axis returns the
+    whole mesh (nothing to partition)."""
+    if k <= 1:
+        return [mesh]
+    d = mesh.devices.shape[0]
+    k = min(k, d)
+    if k <= 1:
+        return [mesh]
+    base, extra = divmod(d, k)
+    subs: list[Mesh] = []
+    row = 0
+    for g in range(k):
+        rows = base + (1 if g < extra else 0)
+        subs.append(
+            Mesh(mesh.devices[row : row + rows, :], (DATA_AXIS, MODEL_AXIS))
+        )
+        row += rows
+    return subs

@@ -1,0 +1,213 @@
+"""Golden-transcript contract tests for the Kafka wire client
+(round-3 verdict #6).
+
+The client (oryx_tpu/bus/kafka.py) previously validated only against the
+in-repo protocol fake — same author on both ends. Here it speaks to a
+DUMB byte replayer: recorded response bytes from
+tests/data/kafka_transcripts.json (provenance in the file: either
+captured from a real broker via tools/kafka_transcripts.py `record`, or
+synthesized by that tool's independent spec-level implementation — own
+varint/zigzag, own CRC-32C, own RecordBatch v2 builder, zero oryx
+imports). The replayer contains no protocol logic: it parses only the
+request header (with the INDEPENDENT parser), patches the correlation id
+and the recorded broker-address fields, and writes the recorded bytes.
+The produce path goes further: the replayer hands the client's
+RecordBatch bytes to the independent decoder, which validates the
+CRC-32C and record layout the client emitted.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "tools"))
+
+import kafka_transcripts as indep  # noqa: E402 - the independent impl
+
+from oryx_tpu.bus.kafka import KafkaBroker  # noqa: E402
+
+DOC = json.loads((ROOT / "tests" / "data" / "kafka_transcripts.json").read_text())
+TOPIC = DOC["topic"]
+BY_KEY = {e["api_key"]: e for e in DOC["exchanges"].values()}
+
+
+class Replayer:
+    """Byte-level replay server: answers every request with the recorded
+    response for its api key, correlation id and address fields patched.
+    Records what the client sent for the tests to assert on."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(16)
+        self.port = self.sock.getsockname()[1]
+        self.requests: list[tuple[int, int, str | None, bytes]] = []
+        self.lock = threading.Lock()
+        self._stop = False
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        try:
+            while True:
+                head = b""
+                while len(head) < 4:
+                    chunk = conn.recv(4 - len(head))
+                    if not chunk:
+                        return
+                    head += chunk
+                (n,) = struct.unpack(">i", head)
+                body = b""
+                while len(body) < n:
+                    chunk = conn.recv(n - len(body))
+                    if not chunk:
+                        return
+                    body += chunk
+                key, ver, corr, cid, rest = indep.parse_request_header(body)
+                with self.lock:
+                    self.requests.append((key, ver, cid, rest))
+                ex = BY_KEY.get(key)
+                if ex is None:
+                    return  # unknown api: drop the connection loudly
+                assert ver == ex["api_version"], (
+                    f"client spoke api {key} v{ver}, transcript has "
+                    f"v{ex['api_version']}"
+                )
+                resp = bytearray(bytes.fromhex(ex["response_hex"]))
+                for off in ex.get("port_offsets", []):
+                    resp[off : off + 4] = struct.pack(">i", self.port)
+                framed = (
+                    struct.pack(">i", len(resp) + 4)
+                    + struct.pack(">i", corr)
+                    + bytes(resp)
+                )
+                conn.sendall(framed)
+        finally:
+            conn.close()
+
+    def close(self):
+        self._stop = True
+        self.sock.close()
+
+
+@pytest.fixture()
+def replay():
+    r = Replayer()
+    b = KafkaBroker([("127.0.0.1", r.port)])
+    yield r, b
+    b.close()
+    r.close()
+
+
+def test_metadata_topology_decode(replay):
+    r, b = replay
+    assert b.topic_exists(TOPIC)
+    assert b.num_partitions(TOPIC) == 2
+    keys = [k for k, *_ in r.requests]
+    assert keys and all(k == 3 for k in keys)  # metadata only
+
+
+def test_fetch_decodes_recorded_batches(replay):
+    """The client must decode the transcript's RecordBatch bytes — an
+    uncompressed batch and a gzip batch, null and non-null keys —
+    into exactly the recorded (offset, key, value) triples."""
+    _, b = replay
+    recs = b.read(TOPIC, 0, 5, 100)
+    assert recs == [tuple(e) for e in BY_KEY[1]["expect"]]
+    # offset INSIDE the first batch: earlier records are skipped
+    recs = b.read(TOPIC, 0, 7, 100)
+    assert [o for o, _, _ in recs] == [7, 8, 9]
+    # max_records truncation
+    assert len(b.read(TOPIC, 0, 5, 2)) == 2
+
+
+def test_produce_emits_valid_record_batch(replay):
+    """Round-trip the client's OWN produce bytes through the independent
+    decoder: framing, varints, and CRC-32C must all verify."""
+    r, b = replay
+    b.send_batch(
+        TOPIC,
+        [(None, "v-five"), ("k6", "v-six"), ("k7", "v-seven")],
+        partition=0,
+    )
+    produce = [rest for k, _, _, rest in r.requests if k == 0]
+    assert len(produce) == 1
+    body = produce[0]
+    # independent parse of the produce v3 body: transactional_id(nullable
+    # string), acks i16, timeout i32, topic array
+    pos = 0
+    (tlen,) = struct.unpack_from(">h", body, pos)
+    pos += 2 + max(0, tlen)
+    acks, timeout = struct.unpack_from(">hi", body, pos)
+    assert acks == 1
+    pos += 6
+    (ntopics,) = struct.unpack_from(">i", body, pos)
+    pos += 4
+    assert ntopics == 1
+    (nlen,) = struct.unpack_from(">h", body, pos)
+    name = body[pos + 2 : pos + 2 + nlen].decode()
+    assert name == TOPIC
+    pos += 2 + nlen
+    (nparts,) = struct.unpack_from(">i", body, pos)
+    pos += 4
+    assert nparts == 1
+    pidx, blen = struct.unpack_from(">ii", body, pos)
+    assert pidx == 0
+    pos += 8
+    batch = body[pos : pos + blen]
+    decoded = indep.decode_record_batches_indep(batch)  # validates CRC
+    assert [(k, v) for _, k, v in decoded] == [
+        (None, b"v-five"), (b"k6", b"v-six"), (b"k7", b"v-seven"),
+    ]
+    assert [o for o, _, _ in decoded] == [0, 1, 2]
+
+
+def test_end_offsets_via_list_offsets(replay):
+    _, b = replay
+    ends = b.end_offsets(TOPIC)
+    assert ends == [10, 10]
+    # the exchange really used ListOffsets v1 per partition
+    keys = [(k, v) for k, v, _, _ in replay[0].requests]
+    assert (2, 1) in keys
+
+
+def test_offset_commit_and_fetch_roundtrip(replay):
+    r, b = replay
+    b.commit_offsets("oryx-golden-g", TOPIC, {0: 41, 1: 7})
+    got = b.get_offsets("oryx-golden-g", TOPIC)
+    assert got == {int(k): v for k, v in BY_KEY[9]["expect"].items()}
+    keys = {k for k, *_ in r.requests}
+    assert {10, 8, 9} <= keys  # find_coordinator, commit, fetch
+
+
+def test_create_and_delete_topic(replay):
+    _, b = replay
+    b.create_topic(TOPIC, partitions=2)
+    b.delete_topic(TOPIC)
+    keys = [k for k, *_ in replay[0].requests]
+    assert 19 in keys and 20 in keys
+
+
+def test_client_id_and_header_framing(replay):
+    r, b = replay
+    b.topic_exists(TOPIC)
+    key, ver, cid, _ = r.requests[0]
+    assert key == 3 and ver == 1
+    assert cid  # a non-empty client id string parsed by the
+    # INDEPENDENT header parser proves request header framing

@@ -1,0 +1,645 @@
+#!/usr/bin/env python3
+"""Golden request/response transcripts for the Kafka wire client.
+
+Why this exists (round-3 verdict #6): oryx_tpu/bus/kafka.py had only ever
+spoken to the in-repo protocol fake (tests/kafka_testbroker.py) — a
+shared-blind-spot risk, since the same author wrote both ends. This tool
+produces byte-exact transcripts for the client's canonical exchanges, to
+be replayed by tests/test_kafka_transcripts.py against the real client
+with NO protocol logic in the middle (the replayer is a dumb byte pipe
+that only patches correlation ids and recorded address fields).
+
+Two provenances, recorded in the artifact:
+
+- "live-broker": `python tools/kafka_transcripts.py record` captures the
+  bytes from a REAL broker through a man-in-the-middle TCP proxy. Run it
+  on any host with a broker (see the docker recipe below); commit the
+  refreshed JSON.
+- "spec-synthesized": `python tools/kafka_transcripts.py synth` builds
+  the responses from an INDEPENDENT implementation of the Kafka protocol
+  written directly from the public protocol specification (kafka.apache.
+  org/protocol) — its own varint/zigzag, its own CRC-32C, its own
+  RecordBatch v2 layout, importing nothing from oryx_tpu. Double-entry
+  bookkeeping: a layout misunderstanding must now be made twice,
+  independently, to cancel out.
+
+Docker recipe for the live capture (any docker-capable host):
+
+    docker run -d --name oryx-kafka -p 9092:9092 \
+      -e KAFKA_CFG_NODE_ID=0 \
+      -e KAFKA_CFG_PROCESS_ROLES=controller,broker \
+      -e KAFKA_CFG_LISTENERS=PLAINTEXT://:9092,CONTROLLER://:9093 \
+      -e KAFKA_CFG_ADVERTISED_LISTENERS=PLAINTEXT://127.0.0.1:19092 \
+      -e KAFKA_CFG_CONTROLLER_LISTENER_NAMES=CONTROLLER \
+      -e KAFKA_CFG_CONTROLLER_QUORUM_VOTERS=0@localhost:9093 \
+      bitnami/kafka:3.6
+    # advertised port 19092 = the recording proxy below, so every
+    # follow-up (leader / coordinator) connection also flows through it
+    ORYX_KAFKA_BROKER=127.0.0.1:9092 ORYX_KAFKA_PROXY_PORT=19092 \
+      python tools/kafka_transcripts.py record
+
+The transcript JSON is self-describing: each exchange carries the api
+key/version, request/response hex, the byte offsets of address fields the
+replayer must patch (broker host/port inside Metadata / FindCoordinator
+responses), and the decoded values the client is expected to produce.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import struct
+import sys
+from pathlib import Path
+
+OUT = Path(__file__).resolve().parent.parent / "tests" / "data" / "kafka_transcripts.json"
+
+# --------------------------------------------------------------------------
+# independent wire primitives (from the spec; no oryx_tpu imports)
+# --------------------------------------------------------------------------
+
+_CRC_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ (0x82F63B78 if _c & 1 else 0)
+    _CRC_TABLE.append(_c)
+
+
+def crc32c(data: bytes) -> int:
+    """CRC-32C (Castagnoli), reflected polynomial 0x82F63B78."""
+    c = 0xFFFFFFFF
+    for b in data:
+        c = (c >> 8) ^ _CRC_TABLE[(c ^ b) & 0xFF]
+    return c ^ 0xFFFFFFFF
+
+
+def zigzag(v: int) -> int:
+    return (v << 1) ^ (v >> 63)
+
+
+def varint(v: int) -> bytes:
+    u = zigzag(v) & 0xFFFFFFFFFFFFFFFF
+    out = bytearray()
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def i8(v):  # noqa: E704 - tiny struct aliases
+    return struct.pack(">b", v)
+def i16(v):
+    return struct.pack(">h", v)
+def i32(v):
+    return struct.pack(">i", v)
+def i64(v):
+    return struct.pack(">q", v)
+def u32(v):
+    return struct.pack(">I", v)
+def string(s):
+    if s is None:
+        return i16(-1)
+    b = s.encode("utf-8")
+    return i16(len(b)) + b
+def kbytes(b):
+    if b is None:
+        return i32(-1)
+    return i32(len(b)) + b
+
+
+def record(offset_delta: int, ts_delta: int, key: bytes | None, value: bytes) -> bytes:
+    body = (
+        i8(0)  # record attributes
+        + varint(ts_delta)
+        + varint(offset_delta)
+        + (varint(-1) if key is None else varint(len(key)) + key)
+        + varint(len(value)) + value
+        + varint(0)  # headers
+    )
+    return varint(len(body)) + body
+
+
+def record_batch(
+    base_offset: int,
+    records: list[tuple[bytes | None, bytes]],
+    first_ts: int = 1_700_000_000_000,
+    codec: int = 0,
+) -> bytes:
+    """RecordBatch v2 (magic 2): the fetch-response / produce-request
+    payload format. codec: 0 none, 1 gzip (attributes bits 0-2)."""
+    recs = b"".join(
+        record(d, 0, k, v) for d, (k, v) in enumerate(records)
+    )
+    if codec == 1:
+        recs = gzip.compress(recs, mtime=0)
+    after_crc = (
+        i16(codec)                       # attributes
+        + i32(len(records) - 1)          # lastOffsetDelta
+        + i64(first_ts)                  # firstTimestamp
+        + i64(first_ts)                  # maxTimestamp
+        + i64(-1) + i16(-1) + i32(-1)    # producerId/Epoch, baseSequence
+        + i32(len(records))
+        + recs
+    )
+    after_length = i32(0) + i8(2) + u32(crc32c(after_crc)) + after_crc
+    # partitionLeaderEpoch(0), magic(2), crc, then the covered bytes
+    return i64(base_offset) + i32(len(after_length)) + after_length
+
+
+def parse_request_header(body: bytes) -> tuple[int, int, int, str | None, bytes]:
+    """(api_key, api_version, correlation_id, client_id, rest)."""
+    key, ver, corr = struct.unpack_from(">hhi", body, 0)
+    (clen,) = struct.unpack_from(">h", body, 8)
+    pos = 10
+    cid = None
+    if clen >= 0:
+        cid = body[pos : pos + clen].decode("utf-8")
+        pos += clen
+    return key, ver, corr, cid, body[pos:]
+
+
+def decode_record_batches_indep(buf: bytes) -> list[tuple[int, bytes | None, bytes]]:
+    """Independent RecordBatch v2 decoder (validates CRC-32C); used by the
+    replay server to check the bytes the CLIENT produced."""
+    out = []
+    pos = 0
+    while pos + 12 <= len(buf):
+        (base,) = struct.unpack_from(">q", buf, pos)
+        (blen,) = struct.unpack_from(">i", buf, pos + 8)
+        start = pos + 12
+        if start + blen > len(buf):
+            break  # truncated trailing batch (legal on the wire)
+        batch = buf[start : start + blen]
+        magic = batch[4]
+        assert magic == 2, f"magic {magic}"
+        (crc,) = struct.unpack_from(">I", batch, 5)
+        covered = batch[9:]
+        assert crc == crc32c(covered), "RecordBatch CRC-32C mismatch"
+        # within `covered`: attributes@0(2) lastOffsetDelta@2(4)
+        # firstTs@6(8) maxTs@14(8) producerId@22(8) producerEpoch@30(2)
+        # baseSequence@32(4) recordCount@36(4) records@40
+        (attrs,) = struct.unpack_from(">h", covered, 0)
+        (count,) = struct.unpack_from(">i", covered, 36)
+        recs = covered[40:]
+        codec = attrs & 0x7
+        if codec == 1:
+            recs = gzip.decompress(recs)
+        elif codec != 0:
+            raise AssertionError(f"unexpected codec {codec}")
+        rp = 0
+
+        def rd_varint():
+            nonlocal rp
+            shift = u = 0
+            while True:
+                b = recs[rp]
+                rp += 1
+                u |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            return (u >> 1) ^ -(u & 1)
+
+        for _ in range(count):
+            _ln = rd_varint()
+            rp += 1  # attributes
+            rd_varint()  # ts delta
+            od = rd_varint()
+            klen = rd_varint()
+            key = None
+            if klen >= 0:
+                key = recs[rp : rp + klen]
+                rp += klen
+            vlen = rd_varint()
+            val = recs[rp : rp + vlen]
+            rp += vlen
+            nh = rd_varint()
+            assert nh == 0
+            out.append((base + od, key, val))
+        pos = start + blen
+    return out
+
+
+# --------------------------------------------------------------------------
+# spec-synthesized responses at the exact api versions the client speaks
+# --------------------------------------------------------------------------
+
+TOPIC = "oryx-golden"
+HOST = "127.0.0.1"  # patched to the replay server's address at replay time
+
+
+def _metadata_v1() -> tuple[bytes, list[int]]:
+    """Metadata v1 response: 1 broker, TOPIC with 2 partitions led by it.
+    Returns (bytes, [port field offsets]) — the replayer patches the port
+    i32s (and FindCoordinator's) to wherever the replay server listens."""
+    out = bytearray()
+    out += i32(1)  # brokers
+    out += i32(0) + string(HOST)
+    port_off = [len(out)]
+    out += i32(0)  # port (patched)
+    out += string(None)  # rack
+    out += i32(0)  # controller id
+    out += i32(1)  # topics
+    out += i16(0) + string(TOPIC) + i8(0)  # error, name, is_internal
+    out += i32(2)  # partitions
+    for idx in range(2):
+        out += i16(0) + i32(idx) + i32(0)  # err, index, leader=node 0
+        out += i32(1) + i32(0)  # replicas [0]
+        out += i32(1) + i32(0)  # isr [0]
+    return bytes(out), port_off
+
+
+def _find_coordinator_v0() -> tuple[bytes, list[int]]:
+    out = bytearray()
+    out += i16(0) + i32(0) + string(HOST)
+    port_off = [len(out)]
+    out += i32(0)
+    return bytes(out), port_off
+
+
+FETCH_RECORDS = [
+    # batch at base offset 5, uncompressed: null key, keyed, longer value
+    (5, None, b"v-five"),
+    (6, b"k6", b"v-six"),
+    (7, b"k7", b"v-seven has a somewhat longer value \xf0\x9f\x8c\x8a".decode(
+        "utf-8", "ignore").encode()),
+    # batch at base offset 8, gzip
+    (8, None, b"v-eight"),
+    (9, b"k9", b"v-nine"),
+]
+
+
+def _fetch_v4() -> bytes:
+    batch_a = record_batch(
+        5, [(k, v) for _, k, v in FETCH_RECORDS[:3]], codec=0
+    )
+    batch_b = record_batch(
+        8, [(k, v) for _, k, v in FETCH_RECORDS[3:]], codec=1
+    )
+    record_set = batch_a + batch_b
+    out = bytearray()
+    out += i32(0)  # throttle
+    out += i32(1)  # topics
+    out += string(TOPIC)
+    out += i32(1)  # partitions
+    out += i32(0)  # partition index
+    out += i16(0)  # error
+    out += i64(10)  # high watermark
+    out += i64(10)  # last stable offset
+    out += i32(0)  # aborted txns
+    out += kbytes(record_set)
+    return bytes(out)
+
+
+def _produce_v3() -> bytes:
+    out = bytearray()
+    out += i32(1)  # topics
+    out += string(TOPIC)
+    out += i32(1)
+    out += i32(0) + i16(0) + i64(42) + i64(-1)  # partition, err, base, ts
+    out += i32(0)  # throttle_time_ms (v1+; client must tolerate it)
+    return bytes(out)
+
+
+def _list_offsets_v1() -> bytes:
+    out = bytearray()
+    out += i32(1)
+    out += string(TOPIC)
+    out += i32(1)
+    out += i32(0) + i16(0) + i64(-1) + i64(10)  # ts, offset=log end 10
+    return bytes(out)
+
+
+def _create_topics_v0() -> bytes:
+    return bytes(i32(1) + string(TOPIC) + i16(0))
+
+
+def _delete_topics_v0() -> bytes:
+    return bytes(i32(1) + string(TOPIC) + i16(0))
+
+
+def _offset_commit_v2() -> bytes:
+    out = bytearray()
+    out += i32(1)
+    out += string(TOPIC)
+    out += i32(2)
+    out += i32(0) + i16(0)
+    out += i32(1) + i16(0)
+    return bytes(out)
+
+
+def _offset_fetch_v1() -> bytes:
+    out = bytearray()
+    out += i32(1)
+    out += string(TOPIC)
+    out += i32(2)
+    out += i32(0) + i64(41) + string("") + i16(0)
+    out += i32(1) + i64(7) + string(None) + i16(0)
+    return bytes(out)
+
+
+def synthesize() -> dict:
+    meta, meta_ports = _metadata_v1()
+    coord, coord_ports = _find_coordinator_v0()
+    doc = {
+        "source": "spec-synthesized",
+        "note": "responses built by tools/kafka_transcripts.py from the "
+        "public Kafka protocol spec, independently of oryx_tpu.bus "
+        "(own varint/zigzag, CRC-32C, RecordBatch v2); refresh from a "
+        "real broker with `tools/kafka_transcripts.py record` (see "
+        "module docstring for the docker recipe)",
+        "topic": TOPIC,
+        "exchanges": {
+            "metadata": {
+                "api_key": 3, "api_version": 1,
+                "response_hex": meta.hex(), "port_offsets": meta_ports,
+            },
+            "find_coordinator": {
+                "api_key": 10, "api_version": 0,
+                "response_hex": coord.hex(), "port_offsets": coord_ports,
+            },
+            "fetch": {
+                "api_key": 1, "api_version": 4,
+                "response_hex": _fetch_v4().hex(),
+                "expect": [
+                    [off, k.decode() if k else None, v.decode()]
+                    for off, k, v in FETCH_RECORDS
+                ],
+            },
+            "produce": {
+                "api_key": 0, "api_version": 3,
+                "response_hex": _produce_v3().hex(),
+            },
+            "list_offsets": {
+                "api_key": 2, "api_version": 1,
+                "response_hex": _list_offsets_v1().hex(),
+                "expect_end_offset": 10,
+            },
+            "create_topics": {
+                "api_key": 19, "api_version": 0,
+                "response_hex": _create_topics_v0().hex(),
+            },
+            "delete_topics": {
+                "api_key": 20, "api_version": 0,
+                "response_hex": _delete_topics_v0().hex(),
+            },
+            "offset_commit": {
+                "api_key": 8, "api_version": 2,
+                "response_hex": _offset_commit_v2().hex(),
+            },
+            "offset_fetch": {
+                "api_key": 9, "api_version": 1,
+                "response_hex": _offset_fetch_v1().hex(),
+                "expect": {"0": 41, "1": 7},
+            },
+        },
+    }
+    return doc
+
+
+# --------------------------------------------------------------------------
+# independent response parsers — used by the live recorder to annotate
+# captured bytes with the same port_offsets / expect fields the
+# synthesizer writes, so `record` output replays identically
+# --------------------------------------------------------------------------
+
+def _rd_string(buf: bytes, pos: int) -> tuple[str | None, int]:
+    (n,) = struct.unpack_from(">h", buf, pos)
+    pos += 2
+    if n < 0:
+        return None, pos
+    return buf[pos : pos + n].decode("utf-8"), pos + n
+
+
+def metadata_v1_port_offsets(resp: bytes) -> list[int]:
+    """Byte offsets of every broker port i32 in a Metadata v1 response."""
+    offs = []
+    (nb,) = struct.unpack_from(">i", resp, 0)
+    pos = 4
+    for _ in range(nb):
+        pos += 4  # node id
+        _, pos = _rd_string(resp, pos)  # host
+        offs.append(pos)
+        pos += 4  # port
+        _, pos = _rd_string(resp, pos)  # rack
+    return offs
+
+
+def find_coordinator_v0_port_offsets(resp: bytes) -> list[int]:
+    pos = 2 + 4  # error, node id
+    _, pos = _rd_string(resp, pos)
+    return [pos]
+
+
+def fetch_v4_expect(resp: bytes) -> list[list]:
+    """Decode a Fetch v4 response's first record set with the independent
+    decoder; returns [[offset, key, value], ...]."""
+    pos = 4  # throttle
+    (nt,) = struct.unpack_from(">i", resp, pos)
+    pos += 4
+    assert nt >= 1
+    _, pos = _rd_string(resp, pos)
+    (np_,) = struct.unpack_from(">i", resp, pos)
+    pos += 4
+    assert np_ >= 1
+    pos += 4 + 2 + 8 + 8  # partition, error, hw, lso
+    (na,) = struct.unpack_from(">i", resp, pos)
+    pos += 4 + max(0, na) * 16
+    (blen,) = struct.unpack_from(">i", resp, pos)
+    pos += 4
+    batch = resp[pos : pos + blen]
+    return [
+        [off, k.decode() if k is not None else None, v.decode()]
+        for off, k, v in decode_record_batches_indep(batch)
+    ]
+
+
+def list_offsets_v1_end_offset(resp: bytes) -> int:
+    pos = 4  # topics count (>=1)
+    _, pos = _rd_string(resp, pos)
+    pos += 4  # partitions count
+    pos += 4 + 2 + 8  # partition, error, timestamp
+    (off,) = struct.unpack_from(">q", resp, pos)
+    return off
+
+
+def offset_fetch_v1_expect(resp: bytes) -> dict[str, int]:
+    out = {}
+    (nt,) = struct.unpack_from(">i", resp, 0)
+    pos = 4
+    for _ in range(nt):
+        _, pos = _rd_string(resp, pos)
+        (np_,) = struct.unpack_from(">i", resp, pos)
+        pos += 4
+        for _ in range(np_):
+            pidx, off = struct.unpack_from(">iq", resp, pos)
+            pos += 12
+            _, pos = _rd_string(resp, pos)  # metadata
+            pos += 2  # error
+            out[str(pidx)] = off
+    return out
+
+
+_API_NAMES = {
+    0: "produce", 1: "fetch", 2: "list_offsets", 3: "metadata",
+    8: "offset_commit", 9: "offset_fetch", 10: "find_coordinator",
+    19: "create_topics", 20: "delete_topics",
+}
+
+
+def _annotate(ex: dict) -> dict:
+    """Attach the replayer-required fields to one captured exchange."""
+    resp = bytes.fromhex(ex["response_hex"])
+    key = ex["api_key"]
+    if key == 3:
+        ex["port_offsets"] = metadata_v1_port_offsets(resp)
+    elif key == 10:
+        ex["port_offsets"] = find_coordinator_v0_port_offsets(resp)
+    elif key == 1:
+        ex["expect"] = fetch_v4_expect(resp)
+    elif key == 2:
+        ex["expect_end_offset"] = list_offsets_v1_end_offset(resp)
+    elif key == 9:
+        ex["expect"] = offset_fetch_v1_expect(resp)
+    return ex
+
+
+# --------------------------------------------------------------------------
+# live capture: man-in-the-middle recorder against a real broker
+# --------------------------------------------------------------------------
+
+def record_live(broker: str, proxy_port: int) -> dict:
+    """Record real-broker bytes: a TCP proxy logs every framed request/
+    response while the oryx client performs the canonical scenario. The
+    broker's advertised listener must point at the proxy (docker recipe
+    in the module docstring) so leader/coordinator reconnects also flow
+    through it."""
+    import socket
+    import threading
+
+    host, port_s = broker.rsplit(":", 1)
+    captured: dict[int, dict] = {}
+
+    def pump(client_sock):
+        up = socket.create_connection((host, int(port_s)), 10)
+
+        def frames(sock):
+            while True:
+                head = b""
+                while len(head) < 4:
+                    chunk = sock.recv(4 - len(head))
+                    if not chunk:
+                        return
+                    head += chunk
+                (n,) = struct.unpack(">i", head)
+                body = b""
+                while len(body) < n:
+                    chunk = sock.recv(n - len(body))
+                    if not chunk:
+                        return
+                    body += chunk
+                yield body
+
+        pending: dict[int, tuple[int, int, str]] = {}
+
+        def c2s():
+            for body in frames(client_sock):
+                key, ver, corr, _cid, _rest = parse_request_header(body)
+                pending[corr] = (key, ver, body.hex())
+                up.sendall(struct.pack(">i", len(body)) + body)
+            up.close()
+
+        def s2c():
+            for body in frames(up):
+                (corr,) = struct.unpack_from(">i", body, 0)
+                if corr in pending:
+                    key, ver, req_hex = pending.pop(corr)
+                    # last COMPLETE request/response pair per api key wins
+                    # (metadata runs several times across the scenario;
+                    # the final one names the topic with its partitions)
+                    captured[key] = {
+                        "api_key": key,
+                        "api_version": ver,
+                        "request_hex": req_hex,
+                        "response_hex": body[4:].hex(),
+                    }
+                client_sock.sendall(struct.pack(">i", len(body)) + body)
+            client_sock.close()
+
+        threading.Thread(target=c2s, daemon=True).start()
+        s2c()
+
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", proxy_port))
+    srv.listen(16)
+
+    def accept_loop():
+        while True:
+            c, _ = srv.accept()
+            threading.Thread(target=pump, args=(c,), daemon=True).start()
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from oryx_tpu.bus.kafka import KafkaBroker
+
+    b = KafkaBroker([("127.0.0.1", proxy_port)])
+    try:
+        b.delete_topic(TOPIC)
+    except Exception:
+        pass
+    b.create_topic(TOPIC, partitions=2)
+    b.send_batch(TOPIC, [(None, "v-five"), ("k6", "v-six")], partition=0)
+    b.read(TOPIC, 0, 0, 10)
+    b.end_offsets(TOPIC)
+    b.commit_offsets("oryx-golden-g", TOPIC, {0: 41, 1: 7})
+    b.get_offsets("oryx-golden-g", TOPIC)
+    b.close()
+    srv.close()
+    # NOTE the scenario deliberately leaves the topic in place and
+    # captures the LAST metadata/fetch/list_offsets exchanges while it
+    # exists, then annotates each captured exchange with the same
+    # port_offsets/expect fields the synthesizer writes — the output
+    # replays through tests/test_kafka_transcripts.py unchanged.
+    return {
+        "source": "live-broker",
+        "broker": broker,
+        "topic": TOPIC,
+        "exchanges": {
+            _API_NAMES.get(k, str(k)): _annotate(v)
+            for k, v in sorted(captured.items())
+        },
+    }
+
+
+def main() -> int:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "synth"
+    if mode == "synth":
+        doc = synthesize()
+    elif mode == "record":
+        import os
+
+        broker = os.environ.get("ORYX_KAFKA_BROKER")
+        if not broker:
+            print("set ORYX_KAFKA_BROKER=host:port", file=sys.stderr)
+            return 2
+        doc = record_live(
+            broker, int(os.environ.get("ORYX_KAFKA_PROXY_PORT", "19092"))
+        )
+    else:
+        print(__doc__)
+        return 2
+    OUT.parent.mkdir(parents=True, exist_ok=True)
+    OUT.write_text(json.dumps(doc, indent=1))
+    print(f"wrote {OUT} ({doc['source']})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
