@@ -22,8 +22,14 @@ baseline was measured at.
 MFU fields (round-2 verdict #2): training and serving report analytic
 FLOPs (ops/flops.py) over wall-clock and the chip's dense-bf16 peak.
 
-Prints progress JSON lines, then ONE final line: {"metric", "value",
-"unit", "vs_baseline", ...}; the driver parses the last parseable line.
+Prints progress JSON lines, then a full-diagnostics "detail": true line,
+then ONE COMPACT final summary line: {"metric", "value", "unit",
+"vs_baseline", "final": true, ...}. The driver parses the LAST parseable
+line of a bounded stdout tail — round 4 lost its record because the
+merged-diagnostics final line outgrew that tail window and the capture
+began mid-line (BENCH_r04.json parsed: null). The compact line is
+size-capped so it always survives; everything else lives on the detail
+line immediately above it.
 """
 
 from __future__ import annotations
@@ -105,17 +111,16 @@ def _bench_body() -> None:
     batch = 4096 if on_accel else 256
     n_items, features, k = 1_000_000, 50, 10
 
-    from oryx_tpu.ops.transfer import staged_device_put
-
-    rng = np.random.default_rng(42)
-    # chunked upload: a single ~200MB buffered write is the transport
-    # pattern that has wedged this host's tunneled TPU
-    y = staged_device_put(
-        rng.standard_normal((n_items, features), dtype=np.float32),
-        dtype=jnp.bfloat16,
+    # the scoring model generates directly in device memory (content is
+    # irrelevant to scan cost) — this stage runs FIRST in the accel suite
+    # and must not open with a ~200MB host upload, the transport pattern
+    # that has wedged this host's tunneled TPU when killed mid-transfer
+    # (the HTTP stage still exercises the real staged-upload serve path)
+    y = jax.random.normal(
+        jax.random.PRNGKey(0), (n_items, features), dtype=jnp.bfloat16
     )
-    users = jnp.asarray(
-        rng.standard_normal((batch, features), dtype=np.float32), dtype=jnp.bfloat16
+    users = jax.random.normal(
+        jax.random.PRNGKey(1), (batch, features), dtype=jnp.bfloat16
     )
     y, users = jax.block_until_ready((y, users))
 
@@ -312,12 +317,19 @@ print("LATMS " + " ".join(f"{l*1000:.1f}" for l in all_lats), flush=True)
 """
 
 
-def _bench_http_body() -> None:
+def _bench_http_body(sample_rate: float = 1.0) -> None:
     """End-to-end /recommend throughput through the REAL serving stack:
     HTTP parse -> route dispatch -> readiness gate -> micro-batched device
     top-k -> JSON render. This is the apples-to-apples number against the
     reference's LoadBenchmark.java (437 qps best case): same endpoint
     semantics, but exact scoring (no LSH) via one coalesced matmul+top_k.
+
+    sample_rate < 1.0 switches the model to the LSH candidate-subsampling
+    path (apps/als/lsh.py — the CPU-serving parity approximation of
+    LocalitySensitiveHash.java) at the baseline's exact configuration
+    (sampleRate 0.3): pure host scoring, so the row is pinned to CPU and
+    compared against the 437-qps "With LSH" table with an explicit
+    per-core normalization (this host's core count vs the baseline's 32).
 
     Load generation runs in SEPARATE OS processes (round-2 lesson: client
     threads inside the server process fight the serving tier for the GIL —
@@ -335,15 +347,30 @@ def _bench_http_body() -> None:
     from oryx_tpu.common.config import load_config
     from oryx_tpu.serving.server import ServingLayer
 
+    lsh = sample_rate < 1.0
+    if lsh:
+        # the LSH path is pure host-numpy scoring: pin the backend (and
+        # with it the metric's platform label) to CPU even when invoked
+        # directly on an accelerator host — a host measurement must never
+        # wear a TPU metric's name (round-2 verdict). The suite path also
+        # pins the subprocess; this covers direct invocation.
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass  # backend already initialized by the caller
     platform = jax.devices()[0].platform
-    on_accel = platform not in ("cpu",)
+    if lsh:
+        platform = "cpu"
+    on_accel = platform not in ("cpu",) and not lsh
     # BASELINE config on both paths (round-3 verdict #2): the CPU fallback
     # no longer shrinks to 100k items, so vs_baseline is non-null even on
     # the degraded path (the _cpu metric suffix still marks the platform)
     n_items, n_users, features, k = 1_000_000, 100_000, 50, 10
     # throughput saturates when the micro-batcher's mean coalesced batch
-    # approaches the device knee; concurrency = procs * threads
-    n_procs, threads_per = (8, 32) if on_accel else (4, 16)
+    # approaches the device knee; concurrency = procs * threads. The LSH
+    # host path serializes scoring through a core-sized semaphore, so
+    # deep client queues only add latency — 16 clients saturates it
+    n_procs, threads_per = (8, 32) if on_accel else ((2, 8) if lsh else (4, 16))
     n_clients = n_procs * threads_per
     # one 1M x 50 coalesced dispatch costs seconds on the single-core CPU
     # path: the measured window must hold several dispatches to mean much
@@ -377,7 +404,7 @@ def _bench_http_body() -> None:
     )
     topics.maybe_create("mem://bench", "OryxUpdate", partitions=1)
     manager = ALSServingModelManager(cfg)
-    manager.model = ALSServingModel(state, sample_rate=1.0)
+    manager.model = ALSServingModel(state, sample_rate=sample_rate)
     serving = ServingLayer(cfg, model_manager=manager)
     serving.start()
     port = serving.port
@@ -397,8 +424,9 @@ def _bench_http_body() -> None:
     # path needs far longer: each bucket's first dispatch pays an XLA
     # compile plus a multi-GFLOP execute on one core, and the ramp
     # 1->2->...->64 must finish before the window opens or the measured
-    # qps is mostly compile stalls.
-    warm_s = 8.0 if on_accel else 30.0
+    # qps is mostly compile stalls. The LSH path compiles nothing (pure
+    # numpy scoring) — it only needs the partition index built once.
+    warm_s = 8.0 if on_accel else (10.0 if lsh else 30.0)
     t_measure = time.time() + warm_s
     t_end = t_measure + duration
     procs = [
@@ -460,37 +488,49 @@ def _bench_http_body() -> None:
     # (BASELINE.md "Memory": 1,400 MB heap at 50f x 2M users+items): host
     # f32 arenas + the bf16 device scoring copy
     host_mb = (state.x.nbytes() + state.y.nbytes()) / 1e6
-    y_dev = manager.model._y_view_full()[0]
-    device_mb = y_dev.nbytes / 1e6
+    if lsh:
+        # pure host path: building the (unused) device scoring view here
+        # would just measure a 200MB upload
+        lsh_index = manager.model._lsh
+        num_hashes = lsh_index.num_hashes if lsh_index is not None else None
+        device_mb = 0.0
+    else:
+        y_dev = manager.model._y_view_full()[0]
+        device_mb = y_dev.nbytes / 1e6
     serving.close()
 
-    # HTTP-tier efficiency, apples to apples: the kernel loop at the SAME
-    # coalesced batch shape the batcher actually dispatched (pow2-padded,
-    # like the batcher pads). Comparing http qps against a kernel loop at
-    # a 64x bigger batch mostly measures batch amortization of the fixed
-    # per-dispatch cost, not the HTTP tier.
-    import jax.numpy as jnp
+    kernel_qps_same_batch = tier_efficiency = None
+    if not lsh:
+        # HTTP-tier efficiency, apples to apples: the kernel loop at the
+        # SAME coalesced batch shape the batcher actually dispatched
+        # (pow2-padded, like the batcher pads). Comparing http qps against
+        # a kernel loop at a 64x bigger batch mostly measures batch
+        # amortization of the fixed per-dispatch cost, not the HTTP tier.
+        import jax.numpy as jnp
 
-    from oryx_tpu.ops.als import topk_dot_batch
+        from oryx_tpu.ops.als import topk_dot_batch
 
-    eff_batch = 1 << max(0, (max(1, round(mean_batch)) - 1)).bit_length()
-    xs_eff = jnp.asarray(
-        rng.standard_normal((eff_batch, features), dtype=np.float32)
-    )
-    jax.block_until_ready(topk_dot_batch(xs_eff, y_dev, k=k))
-    n_eff, t0 = 0, time.perf_counter()
-    while time.perf_counter() - t0 < 2.0:
-        _, idx_eff = topk_dot_batch(xs_eff, y_dev, k=k)
-        np.asarray(idx_eff)
-        n_eff += eff_batch
-    kernel_qps_same_batch = n_eff / (time.perf_counter() - t0)
-    tier_efficiency = qps / kernel_qps_same_batch if kernel_qps_same_batch else None
+        eff_batch = 1 << max(0, (max(1, round(mean_batch)) - 1)).bit_length()
+        xs_eff = jnp.asarray(
+            rng.standard_normal((eff_batch, features), dtype=np.float32)
+        )
+        jax.block_until_ready(topk_dot_batch(xs_eff, y_dev, k=k))
+        n_eff, t0 = 0, time.perf_counter()
+        while time.perf_counter() - t0 < 2.0:
+            _, idx_eff = topk_dot_batch(xs_eff, y_dev, k=k)
+            np.asarray(idx_eff)
+            n_eff += eff_batch
+        kernel_qps_same_batch = n_eff / (time.perf_counter() - t0)
+        tier_efficiency = (
+            qps / kernel_qps_same_batch if kernel_qps_same_batch else None
+        )
 
+    mode = "lsh" if lsh else "exact"
     scaled = "" if on_accel else f" [CPU fallback, baseline scale: {n_items} items]"
     print(
-        f"HTTP /recommend: {total} reqs ({n_errors} errs) in {dt:.2f}s, "
-        f"{n_clients} clients, mean device batch {mean_batch:.1f} on "
-        f"{platform}{scaled}",
+        f"HTTP /recommend ({mode}): {total} reqs ({n_errors} errs) in "
+        f"{dt:.2f}s, {n_clients} clients, mean device batch {mean_batch:.1f} "
+        f"on {platform}{scaled}",
         file=sys.stderr,
     )
     from oryx_tpu.ops.flops import device_peak_flops, mfu, topk_score_flops
@@ -500,34 +540,53 @@ def _bench_http_body() -> None:
     # stream (2·I·F per request) over chip peak — the gap between this and
     # the kernel-loop MFU is the host/HTTP tier's cost
     http_mfu = mfu(qps * topk_score_flops(1, n_items, features), peak)
-    print(
-        json.dumps(
-            {
-                "metric": _metric_name(
-                    "als_recommend_http_qps", n_items, features, platform
-                ),
-                "value": round(qps, 1),
-                "unit": "qps",
-                "vs_baseline": _vs_baseline(qps, n_items, features),
-                "platform": platform,
-                "n_items": n_items,
-                "clients": n_clients,
-                "mean_device_batch": round(mean_batch, 1),
-                "errors": n_errors,
-                "latency_ms_p50": round(pctl(0.50), 1),
-                "latency_ms_p90": round(pctl(0.90), 1),
-                "latency_ms_p99": round(pctl(0.99), 1),
-                "model_host_mb": round(host_mb, 1),
-                "model_device_mb": round(device_mb, 1),
-                "mfu": round(http_mfu, 4) if http_mfu is not None else None,
-                "peak_flops": peak,
-                "kernel_qps_same_batch": round(kernel_qps_same_batch, 1),
-                "http_tier_efficiency": (
-                    round(tier_efficiency, 3) if tier_efficiency else None
-                ),
-            }
+    base = "als_recommend_http_lsh_qps" if lsh else "als_recommend_http_qps"
+    out = {
+        "metric": _metric_name(base, n_items, features, platform),
+        "value": round(qps, 1),
+        "unit": "qps",
+        "vs_baseline": _vs_baseline(qps, n_items, features),
+        "platform": platform,
+        "n_items": n_items,
+        "clients": n_clients,
+        "mean_device_batch": round(mean_batch, 1),
+        "errors": n_errors,
+        "latency_ms_p50": round(pctl(0.50), 1),
+        "latency_ms_p90": round(pctl(0.90), 1),
+        "latency_ms_p99": round(pctl(0.99), 1),
+        "model_host_mb": round(host_mb, 1),
+        "model_device_mb": round(device_mb, 1),
+        "mfu": round(http_mfu, 4) if http_mfu is not None else None,
+        "peak_flops": peak,
+    }
+    if lsh:
+        # the 437-qps "With LSH" table row was measured on a 32-core Xeon;
+        # this host's core count is recorded so the per-core ratio is
+        # explicit instead of conflated with the raw vs_baseline
+        # (round-4 verdict weak #5)
+        cores = os.cpu_count() or 1
+        out["lsh_sample_rate"] = sample_rate
+        out["lsh_num_hashes"] = num_hashes
+        out["host_cores"] = cores
+        out["baseline_cores"] = 32
+        if out["vs_baseline"] is not None:
+            out["qps_per_core_vs_baseline"] = round(
+                (qps / cores) / (BASELINE_QPS / 32), 2
+            )
+    else:
+        out["kernel_qps_same_batch"] = round(kernel_qps_same_batch, 1)
+        out["http_tier_efficiency"] = (
+            round(tier_efficiency, 3) if tier_efficiency else None
         )
-    )
+    print(json.dumps(out))
+
+
+def _bench_http_lsh_body() -> None:
+    """The LSH CPU-parity serving row (round-4 verdict #2): the baseline's
+    exact configuration — 1M items x 50 features, sampleRate 0.3 — through
+    the same HTTP stack, scored on the host via the Hamming-ball candidate
+    subsample (apps/als/lsh.py)."""
+    _bench_http_body(sample_rate=0.3)
 
 
 def _bench_train_body() -> None:
@@ -1027,19 +1086,67 @@ def _merge_scaling(result: dict, sc: dict) -> None:
         result["scaling"] = sc["rows"]
 
 
+def _merge_http(result: dict, http: dict) -> None:
+    """The HTTP end-to-end row is the suite's headline: its fields land at
+    the artifact's top level, overwriting any placeholder headline an
+    earlier stage was adopted for."""
+    result.update(http)
+
+
+def _merge_lsh(result: dict, row: dict) -> None:
+    result["lsh_qps"] = row.get("value")
+    result["lsh_vs_baseline"] = row.get("vs_baseline")
+    for extra in (
+        "lsh_sample_rate", "lsh_num_hashes", "host_cores",
+        "qps_per_core_vs_baseline",
+    ):
+        if row.get(extra) is not None:
+            result[extra] = row[extra]
+    if row.get("latency_ms_p50") is not None:
+        result["lsh_latency_ms_p50"] = row["latency_ms_p50"]
+
+
 # cap for the primary (HTTP) stage — the wedge-vs-budget-exhaustion
 # classifier in _run_suite derives from this same constant, so changing
 # the cap cannot silently flip timeout classification (round-3 advice)
 _PRIMARY_CAP = 420
 
 _SUITE_STAGES = (
-    # (body, stage cap seconds, allow_partial, merge)
-    ("_bench_body", 300, False, _merge_kernel),
-    ("_bench_train_body", 600, False, _merge_train),
-    ("_bench_speed_body", 300, False, _merge_speed),
-    ("_bench_kmeans_rdf_body", 420, False, _merge_kmeans_rdf),
-    ("_bench_scale_body", 900, True, _merge_scaling),
+    # (body, stage cap seconds, allow_partial, merge, stage_force_cpu)
+    # stage_force_cpu: the LSH parity row is host-CPU work by definition
+    # (the reference's 437-qps row is a 32-core CPU measurement); it runs
+    # pinned to CPU even inside an accelerator suite so its metric wears
+    # the honest _cpu suffix
+    ("_bench_body", 300, False, _merge_kernel, False),
+    ("_bench_train_body", 600, False, _merge_train, False),
+    ("_bench_speed_body", 300, False, _merge_speed, False),
+    ("_bench_kmeans_rdf_body", 420, False, _merge_kmeans_rdf, False),
+    ("_bench_http_lsh_body", 240, False, _merge_lsh, True),
+    ("_bench_scale_body", 900, True, _merge_scaling, False),
 )
+
+# Accelerator stage ORDER: cheapest/safest TPU evidence first. The kernel
+# row and the scale sweep generate their models in device HBM (no host
+# upload at all) and lock in the core TPU record within ~2 stage caps —
+# only then does the HTTP primary run its real staged-upload serve path,
+# so a transport wedge there can no longer erase the round's TPU numbers
+# (round-4 window post-mortem: the upload-heavy stage ran first, wedged
+# the tunnel when killed mid-transfer, and nothing survived).
+_ACCEL_STAGE_ORDER = (
+    "_bench_body", "_bench_scale_body", "_bench_http_body",
+    "_bench_train_body", "_bench_speed_body", "_bench_kmeans_rdf_body",
+    "_bench_http_lsh_body",
+)
+
+
+def _stage_list(force_cpu: bool) -> tuple:
+    by_name = {s[0]: s for s in _SUITE_STAGES}
+    by_name["_bench_http_body"] = (
+        "_bench_http_body", _PRIMARY_CAP, False, _merge_http, False
+    )
+    if force_cpu:
+        return (by_name["_bench_http_body"],) + _SUITE_STAGES
+    return tuple(by_name[name] for name in _ACCEL_STAGE_ORDER)
 
 # worst-case wall-clock of a full suite on a cold accelerator: the stage
 # caps above + the primary; a healthy TPU window must be at least this
@@ -1057,12 +1164,14 @@ _LATEST_PARTIAL: dict | None = None
 # no-final-line failure the finalizer exists to prevent
 _SKIP_LIVE_SPARK = False
 
-# default wait budget: must sit under the driver's capture timeout (round-3
-# verdict #1 — a 3h budget exceeded it and the driver's kill left rc 124).
-# 2700s is slightly under the worst-case all-stages-at-cap suite (2940s);
-# real suites run far below their caps, and a deadline-clamped tail stage
-# is labeled budget-exhausted, never silently dropped.
-_DEFAULT_BUDGET_S = 2700.0
+# default wait budget: must sit under the driver's REAL capture timeout.
+# Round 4 calibrated 2700s against an assumed timeout and the driver
+# killed at 1798s (BENCH_r04.json: "terminated by signal 15 after 1798s");
+# 1650s leaves ~150s of exit headroom so bench finishes on its own clock
+# with rc 0. Real suites run far below their stage caps, and a
+# deadline-clamped tail stage is labeled budget-exhausted, never silently
+# dropped.
+_DEFAULT_BUDGET_S = 1650.0
 
 
 def _run_suite(
@@ -1079,26 +1188,29 @@ def _run_suite(
     global _LATEST_PARTIAL
     left = lambda cap: max(30.0, min(cap, deadline - time.monotonic()))
     tag = "cpu" if force_cpu else "accel"
-    granted = left(_PRIMARY_CAP)
-    status, result = _run_bench(env, timeout=granted, force_cpu=force_cpu)
-    if result is None:
-        errors.append(f"http bench ({tag}) {status}")
-        # a stage killed because the global deadline clamped its cap is
-        # budget exhaustion, not a transport wedge — don't send the
-        # caller back to the wait loop over it
-        wedge = (
-            status == "timeout" and not force_cpu and granted >= _PRIMARY_CAP - 1
-        )
-        return None, wedge
-    _LATEST_PARTIAL = dict(result)
-    for body, cap, allow_partial, merge in _SUITE_STAGES:
+    # explicit completion bookkeeping: _select_final ranks artifacts by
+    # stages_done + recency, never by dict key count (round-4 advice —
+    # an old partial with extra diagnostic keys must not outrank a newer,
+    # further-along artifact)
+    result: dict = {"stages_done": 0, "artifact_ts": round(time.time(), 1)}
+    for body, cap, allow_partial, merge, stage_cpu in _stage_list(force_cpu):
         granted = left(cap)
         status, out = _run_bench(
-            env, timeout=granted, body=body, force_cpu=force_cpu,
+            _cpu_env() if stage_cpu and not force_cpu else env,
+            timeout=granted, body=body, force_cpu=force_cpu or stage_cpu,
             allow_partial=allow_partial,
         )
         if out is not None:
+            if "metric" not in result and out.get("metric"):
+                # no headline yet: the first completed stage's becomes the
+                # artifact's — honestly named after what was measured (the
+                # HTTP primary overwrites it via _merge_http if it lands)
+                for kf in ("metric", "value", "unit", "vs_baseline", "platform"):
+                    if kf in out:
+                        result[kf] = out[kf]
             merge(result, out)
+            result["stages_done"] += 1
+            result["artifact_ts"] = round(time.time(), 1)
             # cumulative interim line after EVERY completed stage: if the
             # DRIVER's own deadline kills this process mid-suite (e.g. a
             # healthy window opened late), the finished stages survive as
@@ -1109,11 +1221,21 @@ def _run_suite(
             if status == "timeout" and granted < cap - 1:
                 errors.append(f"{body} ({tag}) budget-exhausted")
                 result["suite_aborted_at"] = body
-                return result, False
+                return (result if "metric" in result else None), False
             errors.append(f"{body} ({tag}) {status}")
-            if status == "timeout" and not force_cpu:
-                result["suite_aborted_at"] = body
-                return result, True
+            if status == "timeout" and not force_cpu and not stage_cpu:
+                # a full-cap timeout can be a wedged transport OR a
+                # cold-compile storm (round-4 window post-mortem): probe.
+                # A live device means keep going — the remaining stages
+                # capture THEIR numbers; only a dead probe aborts so the
+                # caller resumes waiting for a healthy window.
+                if _probe_backend(env, timeout=90.0) is None:
+                    result["suite_aborted_at"] = body
+                    return (result if "metric" in result else None), True
+                errors.append(f"{body} timed out but device alive; continuing")
+    if "metric" not in result:
+        errors.append(f"no stage produced a result ({tag})")
+        return None, False
     # mark completion so the signal-time finalizer can distinguish "ran to
     # the end" from "driver killed it mid-suite" (only the latter may wear
     # the partial flag)
@@ -1210,14 +1332,22 @@ def _select_final(
     """Pick the standing best artifact for finalization. An accelerator
     artifact — even a wedged-mid-suite partial — beats a complete CPU
     anchor: the accelerator measurement is the point of the exercise and
-    must never be silently displaced by a longer CPU dict. Returns
+    must never be silently displaced by a more-complete CPU dict. Ranked
+    by the explicit stage-completion counter then recency — NOT dict key
+    count, which let an old wedged partial carrying extra diagnostic keys
+    outrank a newer artifact (round-4 advice). Returns
     (artifact or None, is_cpu_anchor)."""
+    rank = lambda c: (
+        bool(c.get("suite_complete")),
+        c.get("stages_done", 0),
+        c.get("artifact_ts", 0.0),
+    )
     accel = [
         c for c in (best_tpu, latest_partial)
         if c and c.get("platform") not in (None, "cpu")
     ]
     if accel:
-        best = max(accel, key=len)
+        best = max(accel, key=rank)
         complete = best.pop("suite_complete", False)
         best.pop("interim", None)
         if not complete:
@@ -1228,13 +1358,62 @@ def _select_final(
         if c and c.get("platform") == "cpu"
     ]
     if cpu_cands:
-        best = max(cpu_cands, key=len)
+        best = max(cpu_cands, key=rank)
         complete = best.pop("suite_complete", False)
         best.pop("interim", None)
         if not complete:
             best["partial"] = True  # killed mid-CPU-suite: label it
         return best, True
     return None, True
+
+
+# scalar fields promoted from the detail artifact onto the compact final
+# line — headline numbers only; everything else stays on the detail line
+_SUMMARY_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "platform", "mfu",
+    "kernel_qps", "kernel_mfu", "kernel_pallas_ms", "kernel_xla_ms",
+    "pallas_speedup", "als_build_seconds", "als_build_auc", "train_mfu",
+    "speed_events_per_sec", "kmeans_build_seconds", "rdf_build_seconds",
+    "rdf_accuracy", "lsh_qps", "lsh_vs_baseline", "qps_per_core_vs_baseline",
+    "speedup_vs_mllib", "partial", "stages_done", "tpu_wait",
+)
+
+
+def _compact_summary(result: dict) -> dict:
+    """The LAST stdout line, sized to survive any bounded tail capture.
+    Round 4's single merged final line outgrew the driver's tail window
+    and the round's structured record came back parsed: null
+    (BENCH_r04.json) — so the final line carries only headline scalars
+    plus a pointer to the full detail line printed immediately above it."""
+    s = {k: result[k] for k in _SUMMARY_KEYS if k in result}
+    # the driver's contract fields are always present, even degenerate
+    for k in ("metric", "value", "unit", "vs_baseline"):
+        s.setdefault(k, result.get(k))
+    scaling = result.get("scaling")
+    if isinstance(scaling, list):
+        s["scaling_rows"] = len(scaling)
+        scored = [r for r in scaling if r.get("vs_lsh_baseline")]
+        if scored:
+            best = max(scored, key=lambda r: r["vs_lsh_baseline"])
+            s["scaling_best"] = {
+                k: best[k]
+                for k in ("items", "features", "qps", "vs_lsh_baseline")
+                if k in best
+            }
+    bound = result.get("spark_baseline_bound") or {}
+    for k in ("speedup_vs_mllib_floor", "speedup_vs_mllib_anchor_range"):
+        if k in bound:
+            s[k] = bound[k]
+    err = result.get("error")
+    if err:
+        # keep BOTH ends: early errors carry the wedge history, the tail
+        # carries the signal-finalization note the tests pin
+        s["error"] = (
+            err if len(err) <= 400 else err[:200] + " ...[truncated]... " + err[-180:]
+        )
+    s["final"] = True
+    s["detail"] = "full artifact on the preceding detail:true line"
+    return s
 
 
 def _attach_baseline_bound(result: dict, build_s, nnz) -> None:
@@ -1288,9 +1467,11 @@ def _attach_baseline_bound(result: dict, build_s, nnz) -> None:
 
 
 def main() -> None:
-    """Emit ONE final JSON line (progress lines precede it; the driver
-    parses the LAST parseable line, so a kill mid-run still leaves the
-    best artifact so far on record).
+    """Emit a full detail:true artifact line, then ONE COMPACT final
+    summary line (progress lines precede both; the driver parses the LAST
+    parseable line of a bounded stdout tail, so the final line must stay
+    small — round-4 lesson — and a kill mid-run still leaves the best
+    artifact so far on record).
 
     Round-3 orchestration (round-2 verdict #1): the tunneled TPU wedges
     for hours with healthy windows between. Two probe attempts then CPU
@@ -1357,7 +1538,10 @@ def main() -> None:
             result["error"] = "; ".join(
                 e if n == 1 else f"{e} (x{n})" for e, n in seen.items()
             )
-        print(json.dumps(result), flush=True)
+        detail = dict(result)
+        detail["detail"] = True
+        print(json.dumps(detail), flush=True)
+        print(json.dumps(_compact_summary(result)), flush=True)
 
     best_tpu: dict | None = None
     cpu_result: dict | None = None
@@ -1433,7 +1617,12 @@ def main() -> None:
         # 2. safety artifact: the forced-CPU suite, honestly labeled,
         #    printed as an interim line so even a SIGKILL mid-wait leaves
         #    a parseable, truthful artifact on record
-        cpu_deadline = min(deadline, time.monotonic() + 1500)
+        # the anchor's clamp scales with the budget: the 2700s-era fixed
+        # 1500s clamp would eat most of the 1650s default and the
+        # wait-for-window loop below would never be entered
+        cpu_deadline = min(
+            deadline, time.monotonic() + max(600.0, 0.5 * budget)
+        )
         cpu_result, _ = _run_suite(
             _cpu_env(), force_cpu=True, deadline=cpu_deadline, errors=cpu_errors
         )
@@ -1458,7 +1647,10 @@ def main() -> None:
         #    nothing left worth measuring
         while (
             accel_failures < 2
-            and time.monotonic() + max(600.0, 0.2 * _SUITE_BUDGET) < deadline
+            # a late window is still worth entering at ~0.15 suite-budget:
+            # the accel order fronts the upload-free kernel + scale stages,
+            # which lock in the core TPU record within that slice
+            and time.monotonic() + max(420.0, 0.15 * _SUITE_BUDGET) < deadline
         ):
             time.sleep(poll_s)
             platform = probe()
@@ -1479,9 +1671,10 @@ def main() -> None:
                 accel_failures += 1
                 continue
             if result is not None and (
-                best_tpu is None or len(result) >= len(best_tpu)
+                best_tpu is None
+                or result.get("stages_done", 0) >= best_tpu.get("stages_done", 0)
             ):
-                best_tpu = result  # keep the most complete partial
+                best_tpu = result  # keep the furthest-along partial
             errors.append("suite wedged mid-run; resuming wait")
 
         # 4. budget expiry: a COMPLETE result (rc 0) — best partial

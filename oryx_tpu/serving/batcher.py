@@ -62,15 +62,40 @@ K_BUCKETS = (16, PALLAS_TOPK_MAX_K, 128, 1024)
 
 MAX_BATCH = 4096  # rows per device dispatch (the bench-measured knee)
 
-# A dispatch stuck this long is a wedged transport, not a slow kernel: the
-# worst honest cost of one cycle is a cold XLA compile (tens of seconds on
-# a remote-compile tunnel). Probes re-test a downed device at this cadence.
+# A dispatch stuck this long is a wedged transport, not a slow kernel —
+# EXCEPT while a never-before-dispatched shape may be cold-compiling:
+# first dispatches get COMPILE_TIMEOUT grace (a cold XLA compile over a
+# remote-compile tunnel runs tens of seconds to minutes, and misreading
+# one as a wedge permanently fails the device path over to host scoring).
+# Probes re-test a downed device at PROBE_INTERVAL.
 DEVICE_TIMEOUT = 75.0
+COMPILE_TIMEOUT = 240.0
 PROBE_INTERVAL = 20.0
+
+# On an accelerator the top-k scan is HBM-bandwidth-bound in Y: runtime is
+# nearly flat in the batch dimension until several hundred rows (at
+# 1M x 50f the B=512 matmul adds ~0.1ms on a v5e against the fixed cost of
+# streaming Y), so batch shapes pad to just TWO buckets and the pow2
+# compile ramp (a dozen cold compiles, tens of seconds each over a
+# remote-compile tunnel) collapses to at most two per k-bucket. On CPU the
+# sgemm is compute-bound per row: fine-grained pow2 padding keeps wasted
+# rows under 2x.
+BATCH_BUCKETS_ACCEL = (512, MAX_BATCH)
 
 
 def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
+
+
+def _pad_rows(b: int, on_accel: bool) -> int:
+    if on_accel:
+        for s in BATCH_BUCKETS_ACCEL:
+            if b <= s:
+                return s
+        # a batcher constructed with max_batch beyond the bucket ladder
+        # dispatches the group unpadded — padding must never shrink a batch
+        return b
+    return _next_pow2(b)
 
 
 def k_bucket(k: int) -> int:
@@ -78,6 +103,22 @@ def k_bucket(k: int) -> int:
         if k <= b:
             return b
     return _next_pow2(k)
+
+
+def cosine_scale(scores: np.ndarray, norms: np.ndarray) -> np.ndarray:
+    """Dot scores -> cosine scores with the shared zero-norm clamp."""
+    return scores / np.maximum(norms, 1e-12)
+
+
+def select_topk(scores: np.ndarray, k: int) -> tuple[np.ndarray, np.ndarray]:
+    """Top-k (values, indices) of a score vector, ranked descending:
+    argpartition then an exact sort of the k survivors. The ONE host
+    selection implementation — the batcher fallback and the LSH partition
+    path both rank through it, so tie-breaking/NaN semantics can't drift."""
+    k = min(k, scores.shape[0])
+    top = np.argpartition(-scores, k - 1)[:k]
+    top = top[np.argsort(-scores[top])]
+    return scores[top], top
 
 
 def host_topk(
@@ -95,11 +136,8 @@ def host_topk(
     if cosine:
         if norms is None:
             norms = np.linalg.norm(host_mat, axis=1)
-        scores = scores / np.maximum(norms, 1e-12)
-    k = min(k, scores.shape[0])
-    top = np.argpartition(-scores, k - 1)[:k]
-    top = top[np.argsort(-scores[top])]
-    return scores[top], top
+        scores = cosine_scale(scores, norms)
+    return select_topk(scores, k)
 
 
 class _Pending:
@@ -166,10 +204,22 @@ class TopKBatcher:
         max_batch: int = MAX_BATCH,
         device_timeout: float = DEVICE_TIMEOUT,
         probe_interval: float = PROBE_INTERVAL,
+        compile_timeout: float = COMPILE_TIMEOUT,
     ):
         self.max_batch = max_batch
         self.device_timeout = device_timeout
         self.probe_interval = probe_interval
+        self.compile_timeout = compile_timeout
+        # dispatch shapes that have completed at least once: their XLA
+        # compiles are done, so the wedge watchdog needs no compile grace
+        self._compiled_shapes: set[tuple] = set()
+        # shape_key -> grace deadline for NEVER-COMPILED shapes currently
+        # in flight: entries are added at dispatch, removed when the
+        # dispatch resolves, and cleared on failover — so grace exists
+        # exactly while a cold compile may legitimately be running, and a
+        # wedge on an already-compiled shape still trips at device_timeout
+        self._compiling: dict[tuple, float] = {}
+        self._on_accel = False
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: list[_Pending] = []
@@ -246,6 +296,7 @@ class TopKBatcher:
             return
         try:
             d = next(iter(y.devices()))
+            self._on_accel = getattr(d, "platform", "cpu") not in ("cpu",)
             if getattr(d, "platform", "") == "tpu":
                 from oryx_tpu.ops.flops import peak_flops_for_kind
 
@@ -358,7 +409,7 @@ class TopKBatcher:
         # overlap is not an optimization, it is the difference between a
         # usable and an unusable serving tier on remote-attached devices.
         me = threading.current_thread()
-        inflight: list[tuple[list[_Pending], int, object, object]] = []
+        inflight: list[tuple[list[_Pending], int, object, object, tuple]] = []
         while True:
             with self._cond:
                 while not self._queue and not self._closed and not inflight:
@@ -428,7 +479,22 @@ class TopKBatcher:
                 b = len(group)
                 self.flops_scored += 2.0 * b * y.shape[0] * y.shape[1]
                 self._note_device(y)
-                padded = _next_pow2(b)
+                padded = _pad_rows(b, self._on_accel)
+                shape_key = (
+                    padded, kb, recall, tuple(y.shape),
+                    str(getattr(y, "dtype", "")),
+                )
+                if shape_key not in self._compiled_shapes:
+                    # first dispatch of this shape may cold-compile for
+                    # minutes over a remote-compile tunnel: give the wedge
+                    # watchdog compile grace (for THIS shape, until it
+                    # resolves) so it doesn't misread the compile as a
+                    # wedged transport and permanently fail the device
+                    # path over to host scoring
+                    with self._cond:
+                        self._compiling[shape_key] = (
+                            time.monotonic() + self.compile_timeout
+                        )
                 xs = np.zeros((padded, y.shape[1]), dtype=np.float32)
                 for i, p in enumerate(group):
                     xs[i] = p.vec
@@ -440,7 +506,7 @@ class TopKBatcher:
                     idx.copy_to_host_async()
                 except AttributeError:  # non-jax array (tests with stubs)
                     pass
-                launched.append((group, kb, vals, idx))
+                launched.append((group, kb, vals, idx, shape_key))
             except Exception as e:
                 log.exception("batcher group dispatch failed (k=%d)", kb)
                 # the watchdog's drain may be host-resolving these same
@@ -449,11 +515,17 @@ class TopKBatcher:
                     try_set_exception(p.future, e)
         return launched
 
-    def _resolve(self, item: tuple[list[_Pending], int, object, object]) -> None:
-        group, kb, vals_dev, idx_dev = item
+    def _resolve(
+        self, item: tuple[list[_Pending], int, object, object, tuple]
+    ) -> None:
+        group, kb, vals_dev, idx_dev, shape_key = item
         try:
             vals = np.asarray(vals_dev)
             idx = np.asarray(idx_dev)
+            # the dispatch completed, so this shape's compile is done:
+            # drop its grace window and never grant it one again
+            self._compiled_shapes.add(shape_key)
+            self._compiling.pop(shape_key, None)
             for i, p in enumerate(group):
                 k_eff = min(p.k, kb)
                 # the watchdog may have host-resolved this request while the
@@ -463,6 +535,7 @@ class TopKBatcher:
                 try_set_result(p.future, (vals[i, :k_eff], idx[i, :k_eff]))
         except Exception as e:
             log.exception("batcher group resolve failed (k=%d)", kb)
+            self._compiling.pop(shape_key, None)
             for p in group:
                 try_set_exception(p.future, e)
 
@@ -475,9 +548,14 @@ class TopKBatcher:
                 if self._closed:
                     return
                 busy = self._busy_since
+                now = time.monotonic()
                 wedged = (
                     busy is not None
-                    and time.monotonic() - busy > self.device_timeout
+                    and now - busy > self.device_timeout
+                    # a first-dispatch shape may still be cold-compiling:
+                    # grace holds only while such a shape is in flight and
+                    # its own compile deadline hasn't passed
+                    and now > max(self._compiling.values(), default=0.0)
                 )
                 if not wedged:
                     continue
@@ -491,6 +569,7 @@ class TopKBatcher:
                 self._inflight.clear()
                 self._queue = []
                 self._busy_since = None
+                self._compiling.clear()  # abandoned with the dispatcher
                 self._thread = None  # supersede the wedged dispatcher
             log.error(
                 "device dispatch stuck > %.0fs — failing %d requests over "

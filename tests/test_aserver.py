@@ -376,7 +376,11 @@ def test_wedge_failover_under_concurrent_http_load(monkeypatch):
     b = TopKBatcher.shared()
     hook = None
     try:
-        b.device_timeout, b.probe_interval = 1.0, 600.0  # no recovery mid-test
+        # no recovery mid-test; zero compile grace so the simulated wedge
+        # (not a cold compile) trips the watchdog at device_timeout
+        b.device_timeout, b.probe_interval = 1.0, 600.0
+        b.compile_timeout = 0.0
+        b._compiling.clear()  # clear grace left by earlier dispatches
         hook = WedgeHook(als_mod.topk_dot_batch, block_first_only=False, timeout=60)
         monkeypatch.setattr(als_mod, "topk_dot_batch", hook)
 

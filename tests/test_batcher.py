@@ -134,7 +134,7 @@ def test_wedged_dispatch_fails_over_to_host(y, monkeypatch):
     monkeypatch.setattr(
         "oryx_tpu.ops.als.topk_dot_batch", hook, raising=True
     )
-    b = TopKBatcher(device_timeout=0.5, probe_interval=0.2)
+    b = TopKBatcher(device_timeout=0.5, probe_interval=0.2, compile_timeout=0.5)
     vec = np.random.default_rng(0).normal(size=8).astype(np.float32)
     # the dispatch wedges; the watchdog must host-resolve within ~timeout
     vals, idx = b.submit(vec, 10, y, host_mat=_host_mat(y))
@@ -155,7 +155,7 @@ def test_wedged_dispatch_without_host_mat_errors(y, monkeypatch):
     monkeypatch.setattr(
         "oryx_tpu.ops.als.topk_dot_batch", hook, raising=True
     )
-    b = TopKBatcher(device_timeout=0.5, probe_interval=0.2)
+    b = TopKBatcher(device_timeout=0.5, probe_interval=0.2, compile_timeout=0.5)
     vec = np.random.default_rng(0).normal(size=8).astype(np.float32)
     with pytest.raises(RuntimeError):
         b.submit(vec, 10, y)
@@ -168,7 +168,7 @@ def test_device_recovery_resumes_device_path(y, monkeypatch):
     monkeypatch.setattr(
         "oryx_tpu.ops.als.topk_dot_batch", hook, raising=True
     )
-    b = TopKBatcher(device_timeout=0.4, probe_interval=0.1)
+    b = TopKBatcher(device_timeout=0.4, probe_interval=0.1, compile_timeout=0.4)
     vec = np.random.default_rng(0).normal(size=8).astype(np.float32)
     b.submit(vec, 10, y, host_mat=_host_mat(y))  # wedge + failover
     assert b._device_down.is_set()
@@ -184,6 +184,47 @@ def test_device_recovery_resumes_device_path(y, monkeypatch):
     dvals, didx = _direct(vec, 10, y)
     assert list(idx) == list(didx)
     b.close()
+
+
+def test_first_dispatch_compile_grace_defers_watchdog(y, monkeypatch):
+    """A first dispatch of a shape that runs past device_timeout but within
+    compile_timeout is a cold XLA compile, not a wedge: the watchdog must
+    not fail it over to host scoring (round-4 window post-mortem — a
+    remote-compile tunnel takes tens of seconds per cold shape, and a
+    misread here permanently degrades the device path)."""
+    import threading
+    import time as _time
+
+    hook = _WedgeHook()
+    monkeypatch.setattr("oryx_tpu.ops.als.topk_dot_batch", hook, raising=True)
+    b = TopKBatcher(device_timeout=0.3, probe_interval=0.1, compile_timeout=15.0)
+    vec = np.random.default_rng(0).normal(size=8).astype(np.float32)
+    threading.Thread(
+        target=lambda: (_time.sleep(1.2), hook.release.set()), daemon=True
+    ).start()
+    vals, idx = b.submit(vec, 10, y, host_mat=_host_mat(y))
+    assert b.device_failovers == 0
+    assert b.host_fallbacks == 0
+    dvals, didx = _direct(vec, 10, y)
+    assert list(idx) == list(didx)
+    np.testing.assert_allclose(vals, dvals, rtol=1e-5)
+    b.close()
+
+
+def test_accel_batch_padding_two_buckets():
+    """On an accelerator the batch dimension pads to only two buckets (the
+    scan is bandwidth-bound in Y, and each extra shape is a cold compile
+    over the tunnel); on CPU it stays fine-grained pow2."""
+    from oryx_tpu.serving.batcher import MAX_BATCH, _pad_rows
+
+    assert _pad_rows(1, True) == 512
+    assert _pad_rows(512, True) == 512
+    assert _pad_rows(513, True) == MAX_BATCH
+    # beyond the ladder (custom max_batch): unpadded, never shrunk
+    assert _pad_rows(MAX_BATCH + 1, True) == MAX_BATCH + 1
+    assert _pad_rows(1, False) == 1
+    assert _pad_rows(22, False) == 32
+    assert _pad_rows(513, False) == 1024
 
 
 def test_host_topk_cosine_matches_numpy(y):

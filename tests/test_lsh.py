@@ -145,7 +145,7 @@ def test_representative_items_one_per_partition():
     reps = model.representative_items(50)
     assert 0 < len(reps) <= 50
     # all reps from distinct partitions
-    lsh, _, ids, parts = model._lsh_index()
+    lsh, ids, parts, _pindex = model._lsh_index()
     part_of = {ids[i]: parts[i] for i in range(len(ids))}
     chosen = [part_of[r] for r in reps]
     assert len(set(chosen)) == len(chosen)
