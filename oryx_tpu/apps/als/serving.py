@@ -102,7 +102,8 @@ class ALSServingModel(ServingModel):
         self._num_cores = num_cores
         self._lsh_max_bits = lsh_max_bits_differing
         self._lsh = None
-        # (mat, ids, parts, version, rows_by_partition)
+        # (ids, parts, version, _LshPartitions) — no flat matrix copy: the
+        # partition blocks inside _LshPartitions are the snapshot
         self._partition_view: tuple | None = None
         self._partition_built_at = 0.0
         # Host LSH scoring gates on a core-sized semaphore: each request

@@ -50,24 +50,14 @@ class MLUpdate(BatchLayerUpdate):
         )
         from oryx_tpu.parallel.distributed import DistributedConfig
 
+        # multi-PROCESS pods parallelize the candidate search by process
+        # GROUP (run_update): the pod splits into contiguous host groups,
+        # each group trains a disjoint candidate subset on its own slice
+        # of the mesh, and scores are gathered pod-wide afterwards — the
+        # cluster-parallel search of the reference (MLUpdate.java:253-258)
+        # without ever interleaving two candidates' collectives on one
+        # device.
         self._pod = DistributedConfig.from_config(config).enabled
-        if self._pod and self.eval_parallelism != 1:
-            # multi-PROCESS pod members train candidates over the SHARED
-            # mesh: parallel builds would launch each candidate's
-            # collectives in thread-scheduling order, which differs
-            # across members and deadlocks the group — candidates must
-            # run serially, in the same order, everywhere. (The
-            # single-process multi-device deployment gets true candidate
-            # parallelism instead: the mesh is partitioned into disjoint
-            # sub-meshes, one candidate per sub-mesh — see run_update.)
-            log.warning(
-                "multi-process pod member: forcing "
-                "oryx.ml.eval.parallelism=1 (was %d) — parallel candidate "
-                "builds would interleave pod collectives differently on "
-                "different members",
-                self.eval_parallelism,
-            )
-            self.eval_parallelism = 1
 
     # ---- hooks an app implements -----------------------------------------
 
@@ -163,7 +153,30 @@ class MLUpdate(BatchLayerUpdate):
         # candidates outnumber sub-meshes)
         mesh_pool = None
         parallelism = min(self.eval_parallelism, len(combos))
-        if parallelism > 1 and not self._pod:
+        pod_groups = None
+        multiproc = False
+        if self._pod:
+            import jax
+
+            multiproc = jax.process_count() > 1
+        if parallelism > 1 and multiproc:
+            from oryx_tpu.parallel.submesh import pod_group_submesh
+
+            mesh = self.training_mesh()
+            pod_groups = (
+                pod_group_submesh(mesh, parallelism) if mesh is not None else None
+            )
+            if pod_groups is None:
+                # un-partitionable pod (a data row spanning processes, or
+                # no mesh): candidates must then run serially in the same
+                # order on every member — two candidates' collectives
+                # interleaved on shared devices wedge the group
+                log.warning(
+                    "pod mesh not partitionable by process group; "
+                    "running candidate search serially"
+                )
+                parallelism = 1
+        if parallelism > 1 and pod_groups is None:
             mesh = self.training_mesh()
             if mesh is not None:
                 import queue
@@ -187,6 +200,14 @@ class MLUpdate(BatchLayerUpdate):
 
             from oryx_tpu.parallel.submesh import candidate_mesh
 
+            if multiproc:
+                # per-candidate deterministic seed, order-independent: a
+                # pod member building only its group's candidate subset
+                # must draw the same keys the serial lockstep search
+                # would, or group-parallel and serial searches diverge
+                RandomManager.use_test_seed(
+                    self._pod_candidate_seed(timestamp_ms, i)
+                )
             sub = mesh_pool.get() if mesh_pool is not None else None
             ctx = candidate_mesh(sub) if sub is not None else nullcontext()
             try:
@@ -207,11 +228,19 @@ class MLUpdate(BatchLayerUpdate):
                 if sub is not None:
                     mesh_pool.put(sub)
 
-        results = collect_in_parallel(len(combos), build_and_eval, parallelism)
+        if pod_groups is not None:
+            scores, has_model, paths = self._pod_group_search(
+                timestamp_ms, train, test, combos, cand_root, pod_groups
+            )
+        else:
+            results = collect_in_parallel(len(combos), build_and_eval, parallelism)
+            scores = [s for s, _ in results]
+            paths = [p for _, p in results]
+            has_model = [p is not None for p in paths]
 
         best_i, best_score = -1, float("-inf")
-        for i, (score, path) in enumerate(results):
-            if path is None:
+        for i, (score, ok) in enumerate(zip(scores, has_model)):
+            if not ok:
                 continue
             if np.isnan(score):
                 # no test data / failed eval: candidate is acceptable only
@@ -238,14 +267,107 @@ class MLUpdate(BatchLayerUpdate):
             delete_recursively(cand_root)
             return
 
+        if pod_groups is not None:
+            # the winner lives on its builder group's disks only; every
+            # process must end this generation with the same final_dir
+            # content (exactly as the serial lockstep search guarantees)
+            paths[best_i] = self._fetch_winner(
+                best_i, paths[best_i], cand_root, pod_groups
+            )
+
         final_dir = root / str(timestamp_ms)
         delete_recursively(final_dir)
-        atomic_rename(results[best_i][1], final_dir)
+        atomic_rename(paths[best_i], final_dir)
         delete_recursively(root / ".candidates")
 
         model = ModelArtifact.read(final_dir)
         self.publish_model(model, str(final_dir), update_producer)
         self.publish_additional_model_data(model, str(final_dir), update_producer)
+
+    @staticmethod
+    def _pod_candidate_seed(timestamp_ms: int, i: int) -> int:
+        """Deterministic per-(generation, candidate) RNG seed: every pod
+        member derives the same seed for candidate i no matter which
+        candidates it builds, or in what order."""
+        return (timestamp_ms ^ ((i + 1) * 0x9E3779B9)) & 0x7FFFFFFF
+
+    def _pod_group_search(
+        self,
+        timestamp_ms: int,
+        train: Sequence[KeyMessage],
+        test: Sequence[KeyMessage],
+        combos: list[dict[str, Any]],
+        cand_root: Path,
+        pod_groups,
+    ) -> tuple[list[float], list[bool], list[Path | None]]:
+        """The multi-host parallel candidate search (reference
+        MLUpdate.java:253-258 fans candidates out over the Spark cluster).
+        Process groups build disjoint candidate subsets concurrently, each
+        on its own slice of the pod mesh; afterwards every process gathers
+        all scores and adopts each candidate's GROUP-LEADER row, so every
+        member picks the winner from identical numbers."""
+        import jax
+
+        from oryx_tpu.parallel.distributed import host_allgather
+        from oryx_tpu.parallel.submesh import candidate_mesh
+
+        my_group, groups, sub = pod_groups
+        n_groups = len(groups)
+        n = len(combos)
+        mine = [i for i in range(n) if i % n_groups == my_group]
+        log.info(
+            "pod parallel candidate search: %d groups over %d processes; "
+            "group %d (processes %s, %d-device sub-mesh) builds candidates %s",
+            n_groups, jax.process_count(), my_group, groups[my_group],
+            sub.devices.size, mine,
+        )
+        scores = np.full(n, np.nan)
+        built = np.zeros(n, dtype=np.int64)
+        paths: list[Path | None] = [None] * n
+        for i in mine:
+            RandomManager.use_test_seed(self._pod_candidate_seed(timestamp_ms, i))
+            try:
+                with candidate_mesh(sub):
+                    model = self.build_model(train, combos[i])
+                    paths[i] = model.write(cand_root / str(i))
+                    scores[i] = (
+                        self.evaluate(model, train, test) if test else float("nan")
+                    )
+                built[i] = 1
+                log.info("candidate %d %s -> eval %s", i, combos[i], scores[i])
+            except Exception:
+                log.exception("candidate %d failed", i)
+        all_scores = host_allgather(scores)
+        all_built = host_allgather(built)
+        final_scores, final_built = [], []
+        for i in range(n):
+            leader = groups[i % n_groups][0]
+            final_scores.append(float(all_scores[leader, i]))
+            final_built.append(bool(all_built[leader, i]))
+        return final_scores, final_built, paths
+
+    def _fetch_winner(
+        self, best_i: int, local_path: Path | None, cand_root: Path, pod_groups
+    ) -> Path:
+        """Collective: ship the winning candidate's artifact bytes from its
+        builder group's leader to every process that did not build it (no
+        shared filesystem — same reason MODEL-REF rides the ArtifactRelay).
+        All pod members must call this together."""
+        import jax
+
+        from oryx_tpu.parallel.distributed import host_broadcast_bytes
+
+        _, groups, _ = pod_groups
+        src = groups[best_i % len(groups)][0]
+        payload = None
+        if jax.process_index() == src:
+            payload = ModelArtifact.read(local_path).to_string().encode("utf-8")
+        blob = host_broadcast_bytes(payload, src)
+        if local_path is not None:
+            return Path(local_path)
+        return ModelArtifact.from_string(blob.decode("utf-8")).write(
+            cand_root / str(best_i)
+        )
 
     def publish_model(
         self, model: ModelArtifact, model_path: str, producer: TopicProducer

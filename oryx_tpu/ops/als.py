@@ -1011,15 +1011,23 @@ def train_als_tp(
     else:
         key = seed_key if seed_key is not None else RandomManager.get_key()
         if jax.process_count() > 1 and seed_key is None:
-            # every host must init the SAME y0: its sharding replicates
-            # along the cross-host data axis, and per-process urandom-
-            # seeded keys would stitch divergent replicas into a silently
-            # corrupt model
-            from jax.experimental import multihost_utils
+            from oryx_tpu.parallel.submesh import current_candidate_mesh
 
-            key = jax.random.wrap_key_data(
-                multihost_utils.broadcast_one_to_all(jax.random.key_data(key))
-            )
+            if current_candidate_mesh() is None:
+                # every host must init the SAME y0: its sharding replicates
+                # along the cross-host data axis, and per-process urandom-
+                # seeded keys would stitch divergent replicas into a
+                # silently corrupt model
+                from jax.experimental import multihost_utils
+
+                key = jax.random.wrap_key_data(
+                    multihost_utils.broadcast_one_to_all(jax.random.key_data(key))
+                )
+            # else: partitioned pod candidate search — the mesh spans only
+            # THIS group's processes, so the pod-wide broadcast would
+            # block on groups busy training other candidates. Group-wide
+            # key agreement comes from the per-candidate deterministic
+            # seed MLUpdate installs before every pod build.
         y0 = (
             jax.random.normal(key, (n_i_pad, features), dtype=jnp.float32) * 0.1
             + 1.0 / math.sqrt(features)
@@ -1028,7 +1036,12 @@ def train_als_tp(
 
     row_d = NamedSharding(mesh, P(DATA_AXIS, None))
     row_m = NamedSharding(mesh, P(MODEL_AXIS, None))
-    multihost = jax.process_count() > 1
+    # spanning-THIS-mesh, not process_count: during a partitioned pod
+    # candidate search the mesh covers only this group's processes, and a
+    # fully-local sub-mesh must not enter pod-WIDE collectives — two groups'
+    # process_allgathers would pair up and stitch different candidates'
+    # factors into one corrupt model
+    multihost = len({d.process_index for d in mesh.devices.ravel()}) > 1
 
     def put(a, s):
         # single-process: plain device_put. Multi-host: every process holds
@@ -1050,11 +1063,18 @@ def train_als_tp(
     )
     if multihost:
         # factor tables come back to every host (each publishes/serves the
-        # whole model, like every reference layer holds the full model)
-        from jax.experimental import multihost_utils
+        # whole model, like every reference layer holds the full model).
+        # Gather WITHIN the mesh — an XLA all-gather over exactly the
+        # mesh's devices — never a pod-wide process_allgather: during a
+        # partitioned candidate search other process groups are busy
+        # training different candidates, and a global collective would
+        # pair up across groups and interleave their models
+        from jax.sharding import NamedSharding
 
-        x = multihost_utils.process_allgather(x, tiled=True)
-        y = multihost_utils.process_allgather(y, tiled=True)
+        rep = NamedSharding(mesh, P(None, None))
+        x, y = jax.jit(lambda a, b: (a, b), out_shardings=(rep, rep))(x, y)
+        x = np.asarray(x.addressable_data(0))
+        y = np.asarray(y.addressable_data(0))
     return _finish_model(
         x, y, n_u, n_i, data
     )

@@ -44,6 +44,63 @@ def candidate_mesh(mesh: Mesh | None):
         _TLS.mesh = prev
 
 
+def process_groups(process_ids: list[int], k: int) -> list[list[int]]:
+    """Partition an ordered process list into up to k contiguous groups,
+    sizes as equal as possible — the multi-PROCESS analogue of
+    partition_mesh's contiguous data-axis slices. Deterministic: every pod
+    member computes the identical partition from (process list, k)."""
+    k = max(1, min(k, len(process_ids)))
+    base, extra = divmod(len(process_ids), k)
+    groups: list[list[int]] = []
+    at = 0
+    for g in range(k):
+        n = base + (1 if g < extra else 0)
+        groups.append(process_ids[at : at + n])
+        at += n
+    return groups
+
+
+def pod_group_submesh(mesh: Mesh, k: int) -> tuple[int, list[list[int]], Mesh] | None:
+    """Carve the pod-wide mesh into per-process-GROUP sub-meshes for the
+    multi-host parallel candidate search (reference MLUpdate.java:253-258
+    parallelizes candidates across the Spark cluster; here each candidate
+    trains on a disjoint slice of the pod). Data-axis rows are grouped by
+    the process that owns their devices (the hybrid mesh is host-major
+    along data, parallel/distributed.py global_mesh), processes are split
+    into contiguous groups, and THIS process gets (group_index, groups,
+    its group's sub-mesh) — groups[g][0] is group g's leader, whose score
+    row and winner artifact the gather/broadcast steps read.
+    Collectives inside a candidate build then touch
+    only the group's own hosts — groups never synchronize mid-build.
+
+    Returns None when the mesh cannot be partitioned by process (a data
+    row spanning several processes, or a single-process pod): callers
+    fall back to the serial lockstep search."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return None
+    row_owner: list[int] = []
+    for r in range(mesh.devices.shape[0]):
+        owners = {d.process_index for d in mesh.devices[r, :].ravel()}
+        if len(owners) != 1:
+            return None
+        row_owner.append(owners.pop())
+    procs = sorted(set(row_owner))
+    if len(procs) <= 1:
+        return None
+    groups = process_groups(procs, k)
+    if len(groups) <= 1:
+        return None
+    me = jax.process_index()
+    my_group = next((g for g, ps in enumerate(groups) if me in ps), None)
+    if my_group is None:
+        return None
+    rows = [r for r, p in enumerate(row_owner) if p in groups[my_group]]
+    sub = Mesh(mesh.devices[rows, :], (DATA_AXIS, MODEL_AXIS))
+    return my_group, groups, sub
+
+
 def partition_mesh(mesh: Mesh, k: int) -> list[Mesh]:
     """Split a (data, model) mesh into up to k disjoint sub-meshes along
     the data axis (contiguous slices, sizes as equal as possible; the
@@ -53,17 +110,10 @@ def partition_mesh(mesh: Mesh, k: int) -> list[Mesh]:
     whole mesh (nothing to partition)."""
     if k <= 1:
         return [mesh]
-    d = mesh.devices.shape[0]
-    k = min(k, d)
-    if k <= 1:
+    row_groups = process_groups(list(range(mesh.devices.shape[0])), k)
+    if len(row_groups) <= 1:
         return [mesh]
-    base, extra = divmod(d, k)
-    subs: list[Mesh] = []
-    row = 0
-    for g in range(k):
-        rows = base + (1 if g < extra else 0)
-        subs.append(
-            Mesh(mesh.devices[row : row + rows, :], (DATA_AXIS, MODEL_AXIS))
-        )
-        row += rows
-    return subs
+    return [
+        Mesh(mesh.devices[rows[0] : rows[-1] + 1, :], (DATA_AXIS, MODEL_AXIS))
+        for rows in row_groups
+    ]

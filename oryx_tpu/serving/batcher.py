@@ -473,6 +473,7 @@ class TopKBatcher:
         for (_, kb, recall), group in groups.items():
             # failures stay inside their group: a bad shape / OOM against
             # one target matrix must not fail requests scoring another
+            shape_key = None
             try:
                 y = group[0].y
                 self._last_y = y  # recovery probes re-test against this
@@ -509,6 +510,12 @@ class TopKBatcher:
                 launched.append((group, kb, vals, idx, shape_key))
             except Exception as e:
                 log.exception("batcher group dispatch failed (k=%d)", kb)
+                # no compile is in flight anymore: drop the grace entry,
+                # or a real transport wedge on a compiled shape would sit
+                # behind this shape's stale compile deadline
+                if shape_key is not None:
+                    with self._cond:
+                        self._compiling.pop(shape_key, None)
                 # the watchdog's drain may be host-resolving these same
                 # futures concurrently — a lost race must not propagate
                 for p in group:
@@ -523,9 +530,13 @@ class TopKBatcher:
             vals = np.asarray(vals_dev)
             idx = np.asarray(idx_dev)
             # the dispatch completed, so this shape's compile is done:
-            # drop its grace window and never grant it one again
+            # drop its grace window and never grant it one again. Pop
+            # under the lock — the watchdog iterates _compiling.values()
+            # holding it, and an unlocked pop mid-iteration kills the
+            # watchdog thread with RuntimeError
             self._compiled_shapes.add(shape_key)
-            self._compiling.pop(shape_key, None)
+            with self._cond:
+                self._compiling.pop(shape_key, None)
             for i, p in enumerate(group):
                 k_eff = min(p.k, kb)
                 # the watchdog may have host-resolved this request while the
@@ -535,7 +546,8 @@ class TopKBatcher:
                 try_set_result(p.future, (vals[i, :k_eff], idx[i, :k_eff]))
         except Exception as e:
             log.exception("batcher group resolve failed (k=%d)", kb)
-            self._compiling.pop(shape_key, None)
+            with self._cond:
+                self._compiling.pop(shape_key, None)
             for p in group:
                 try_set_exception(p.future, e)
 

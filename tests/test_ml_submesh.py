@@ -48,6 +48,27 @@ def test_partition_mesh_shapes():
     assert all(m.devices.shape[1] == 2 for m in partition_mesh(tiny, 2))
 
 
+def test_process_groups_contiguous_and_balanced():
+    from oryx_tpu.parallel.submesh import process_groups
+
+    assert process_groups([0, 1, 2, 3], 2) == [[0, 1], [2, 3]]
+    assert process_groups([0, 1, 2, 3, 4], 2) == [[0, 1, 2], [3, 4]]
+    assert process_groups([0, 1], 8) == [[0], [1]]
+    assert process_groups([3, 7], 2) == [[3], [7]]
+    assert process_groups([0, 1, 2], 1) == [[0, 1, 2]]
+
+
+def test_pod_group_submesh_single_process_falls_back():
+    # one process cannot form process groups: callers must get None and
+    # run the serial search (the thread/sub-mesh path covers this case)
+    import jax
+
+    from oryx_tpu.parallel.submesh import pod_group_submesh
+
+    mesh = make_mesh(MeshSpec(data=4, model=2), jax.devices("cpu"))
+    assert pod_group_submesh(mesh, 2) is None
+
+
 def test_candidate_mesh_is_thread_local():
     import jax
 

@@ -198,6 +198,103 @@ print(f"PODWINDOW_OK {pid}", flush=True)
 '''
 
 
+_POD_PARALLEL_WORKER = r'''
+import sys
+
+sys.path.insert(0, sys.argv[4])
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import json
+
+import numpy as np
+
+from oryx_tpu.bus.api import KeyMessage, TopicProducer
+from oryx_tpu.bus.broker import get_broker
+from oryx_tpu.common.config import load_config
+from oryx_tpu.parallel.distributed import global_mesh, init_distributed
+from oryx_tpu.parallel.mesh import MeshSpec
+from oryx_tpu.parallel.submesh import current_candidate_mesh
+
+pid, nprocs, port, root, tmp = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4], sys.argv[5]
+)
+
+base = {
+    "oryx.id": "podpar",
+    "oryx.ml.eval.candidates": 2,
+    "oryx.ml.eval.hyperparam-search": "grid",
+    "oryx.ml.eval.test-fraction": 0.2,
+    "oryx.als.hyperparams.features": 8,
+    "oryx.als.hyperparams.iterations": 4,
+    "oryx.als.hyperparams.alpha": 10.0,
+    "oryx.als.hyperparams.lambda": [0.01, 500.0],
+    "oryx.als.no-known-items": True,
+    "oryx.compute.distributed.coordinator-address": f"127.0.0.1:{port}",
+    "oryx.compute.distributed.num-processes": nprocs,
+    "oryx.compute.distributed.process-id": pid,
+}
+assert init_distributed(load_config(overlay=base)) is True
+mesh = global_mesh(MeshSpec(data=2, model=2))
+
+# identical input on every member (the pod agrees the window in real runs)
+rng = np.random.default_rng(17)
+msgs = []
+for j in range(1200):
+    u = int(rng.integers(0, 40))
+    i = (u % 3) * 10 + int(rng.integers(0, 10))
+    msgs.append(KeyMessage(None, f"u{u},i{i},1,{j}"))
+
+from oryx_tpu.apps.als.batch import ALSUpdate
+
+built = []
+
+
+class Spy(ALSUpdate):
+    def build_model(self, train, hyperparams):
+        built.append((float(hyperparams["lambda"]), current_candidate_mesh()))
+        return super().build_model(train, hyperparams)
+
+
+def run(parallelism):
+    built.clear()
+    over = dict(base)
+    over["oryx.ml.eval.parallelism"] = parallelism
+    broker = get_broker(f"mem://podpar-{pid}-{parallelism}")
+    broker.create_topic("U", partitions=1)
+    upd = Spy(load_config(overlay=over), mesh=mesh)
+    upd.run_update(
+        1000, msgs, [], f"{tmp}/p{pid}-model-{parallelism}",
+        TopicProducer(broker, "U"),
+    )
+    recs = broker.read("U", 0, 0, 5)
+    model_msgs = [m for _, k, m in recs if k == "MODEL"]
+    assert model_msgs, recs
+    return json.loads(model_msgs[0])["extensions"]["lambda"]
+
+
+par = run(2)
+# each member built exactly ONE candidate — its process group's — on its
+# own 2-device (1 data x 2 model) slice of the pod
+assert len(built) == 1, built
+lam, sub = built[0]
+assert sub is not None and sub.devices.size == 2, sub
+assert sub.devices.shape == (1, 2), sub.devices.shape
+assert {d.process_index for d in sub.devices.ravel()} == {pid}
+assert lam == (0.01 if pid == 0 else 500.0), (pid, lam)
+
+ser = run(1)
+# serial lockstep: every member builds every candidate on the full mesh
+assert [l for l, _ in built] == [0.01, 500.0], built
+assert all(m is None for _, m in built), built
+
+# winner identical across modes and members — and process 1 only has the
+# winning artifact because _fetch_winner shipped it over the pod
+assert par == ser == "0.01", (par, ser)
+print(f"PODPAR_OK {pid}", flush=True)
+'''
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -254,6 +351,44 @@ def test_pod_window_agrees_both_edges(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out[-2000:]}"
         assert f"PODWINDOW_OK {i}" in out, out[-2000:]
+
+
+def test_two_process_pod_parallel_candidates(tmp_path):
+    """Round-4 verdict #3: a REAL multi-process pod must search hyperparam
+    candidates in parallel — one candidate per process group, each on its
+    own slice of the pod mesh, scores gathered pod-wide, winner identical
+    to the serial lockstep search (reference MLUpdate.java:253-258
+    parallelizes across the Spark cluster). Two OS processes x 2 virtual
+    CPU devices = a 4-device pod building 2 candidates concurrently."""
+    port = _free_port()
+    from oryx_tpu.common.executil import cpu_subprocess_env
+
+    env = cpu_subprocess_env(dict(os.environ))
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    env["XLA_FLAGS"] = " ".join(flags + ["--xla_force_host_platform_device_count=2"])
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _POD_PARALLEL_WORKER, str(i), "2", str(port),
+             str(ROOT), str(tmp_path)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+        assert f"PODPAR_OK {i}" in out, out[-3000:]
 
 
 def test_two_process_pod_collectives(tmp_path):
