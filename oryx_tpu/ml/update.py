@@ -196,10 +196,6 @@ class MLUpdate(BatchLayerUpdate):
                     )
 
         def build_and_eval(i: int) -> tuple[float, Path | None]:
-            from contextlib import nullcontext
-
-            from oryx_tpu.parallel.submesh import candidate_mesh
-
             if multiproc:
                 # per-candidate deterministic seed, order-independent: a
                 # pod member building only its group's candidate subset
@@ -209,21 +205,8 @@ class MLUpdate(BatchLayerUpdate):
                     self._pod_candidate_seed(timestamp_ms, i)
                 )
             sub = mesh_pool.get() if mesh_pool is not None else None
-            ctx = candidate_mesh(sub) if sub is not None else nullcontext()
             try:
-                with ctx:
-                    model = self.build_model(train, combos[i])
-                    cand_dir = model.write(cand_root / str(i))
-                    score = (
-                        self.evaluate(model, train, test)
-                        if test
-                        else float("nan")
-                    )
-                log.info("candidate %d %s -> eval %s", i, combos[i], score)
-                return score, cand_dir
-            except Exception:
-                log.exception("candidate %d failed", i)
-                return float("nan"), None
+                return self._build_one(i, combos, train, test, cand_root, sub)
             finally:
                 if sub is not None:
                     mesh_pool.put(sub)
@@ -284,6 +267,36 @@ class MLUpdate(BatchLayerUpdate):
         self.publish_model(model, str(final_dir), update_producer)
         self.publish_additional_model_data(model, str(final_dir), update_producer)
 
+    def _build_one(
+        self,
+        i: int,
+        combos: list[dict[str, Any]],
+        train: Sequence[KeyMessage],
+        test: Sequence[KeyMessage],
+        cand_root: Path,
+        sub,
+    ) -> tuple[float, Path | None]:
+        """Build, write, and evaluate candidate i (on sub-mesh `sub` when
+        given) — the single copy of the candidate build-and-score contract
+        that the serial, thread-parallel, and pod-group searches all use."""
+        from contextlib import nullcontext
+
+        from oryx_tpu.parallel.submesh import candidate_mesh
+
+        ctx = candidate_mesh(sub) if sub is not None else nullcontext()
+        try:
+            with ctx:
+                model = self.build_model(train, combos[i])
+                cand_dir = model.write(cand_root / str(i))
+                score = (
+                    self.evaluate(model, train, test) if test else float("nan")
+                )
+            log.info("candidate %d %s -> eval %s", i, combos[i], score)
+            return score, cand_dir
+        except Exception:
+            log.exception("candidate %d failed", i)
+            return float("nan"), None
+
     @staticmethod
     def _pod_candidate_seed(timestamp_ms: int, i: int) -> int:
         """Deterministic per-(generation, candidate) RNG seed: every pod
@@ -309,7 +322,6 @@ class MLUpdate(BatchLayerUpdate):
         import jax
 
         from oryx_tpu.parallel.distributed import host_allgather
-        from oryx_tpu.parallel.submesh import candidate_mesh
 
         my_group, groups, sub = pod_groups
         n_groups = len(groups)
@@ -326,17 +338,10 @@ class MLUpdate(BatchLayerUpdate):
         paths: list[Path | None] = [None] * n
         for i in mine:
             RandomManager.use_test_seed(self._pod_candidate_seed(timestamp_ms, i))
-            try:
-                with candidate_mesh(sub):
-                    model = self.build_model(train, combos[i])
-                    paths[i] = model.write(cand_root / str(i))
-                    scores[i] = (
-                        self.evaluate(model, train, test) if test else float("nan")
-                    )
-                built[i] = 1
-                log.info("candidate %d %s -> eval %s", i, combos[i], scores[i])
-            except Exception:
-                log.exception("candidate %d failed", i)
+            scores[i], paths[i] = self._build_one(
+                i, combos, train, test, cand_root, sub
+            )
+            built[i] = 1 if paths[i] is not None else 0
         all_scores = host_allgather(scores)
         all_built = host_allgather(built)
         final_scores, final_built = [], []

@@ -229,25 +229,27 @@ def host_broadcast_bytes(payload: bytes | None, src_process: int) -> bytes:
     """Ship an arbitrary byte string from one process to every process in
     the pod (e.g. the winning candidate artifact after a partitioned
     hyperparam search — only the winner's group has it on local disk).
-    Built on two process_allgathers (length, then the padded buffer), so
-    peak memory is num_processes x len(payload): fine for model artifacts
-    in the tens of MB; anything larger should ride the bus-chunked
+    Two true one-to-all broadcasts (length, then the buffer): peak memory
+    is one len(payload) buffer per process — fine for model artifacts in
+    the tens of MB; anything larger should ride the bus-chunked
     ArtifactRelay instead. All processes must call this collectively."""
     if jax.process_count() == 1:
         return payload or b""
     from jax.experimental import multihost_utils
 
-    me = jax.process_index()
-    n = len(payload) if (me == src_process and payload is not None) else 0
-    lens = np.asarray(
-        multihost_utils.process_allgather(np.asarray(n, dtype=np.int64))
-    ).ravel()
-    total = int(lens[src_process])
+    is_src = jax.process_index() == src_process
+    n = len(payload) if (is_src and payload is not None) else 0
+    total = int(
+        multihost_utils.broadcast_one_to_all(
+            np.asarray(n, dtype=np.int64), is_source=is_src
+        )
+    )
     buf = np.zeros(total, dtype=np.uint8)
-    if me == src_process and total:
+    if is_src and total:
         buf[:] = np.frombuffer(payload, dtype=np.uint8)
-    got = np.asarray(multihost_utils.process_allgather(buf))
-    return got.reshape(jax.process_count(), total)[src_process].tobytes()
+    return np.asarray(
+        multihost_utils.broadcast_one_to_all(buf, is_source=is_src)
+    ).tobytes()
 
 
 def host_allgather(x) -> np.ndarray:
