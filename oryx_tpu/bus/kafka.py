@@ -14,7 +14,10 @@ replacement for the reference's ZooKeeper offset store). API versions are
 pinned pre-flexible: Produce v3 / Fetch v4 (record batch v2, the format all
 brokers >= 0.11 speak and modern brokers require), Metadata v1,
 ListOffsets v1, CreateTopics v0, DeleteTopics v0, FindCoordinator v0,
-OffsetCommit v2, OffsetFetch v1.
+OffsetCommit v2, OffsetFetch v1. Every fresh connection starts with an
+ApiVersions v0 handshake (KIP-35) that checks the pinned versions against
+the broker's advertised ranges, so an incompatible broker fails loudly at
+connect time.
 """
 
 from __future__ import annotations
@@ -28,6 +31,7 @@ from typing import Mapping
 
 from oryx_tpu.bus.broker import Broker, partition_for
 from oryx_tpu.bus.kafkawire import (
+    API_API_VERSIONS,
     API_CREATE_TOPICS,
     API_DELETE_TOPICS,
     API_FETCH,
@@ -55,6 +59,22 @@ _SOCKET_TIMEOUT_S = 30.0
 _FETCH_MAX_WAIT_MS = 100
 _MAX_PARTITION_BYTES = 32 << 20  # fits an oversized MODEL message
 
+# every api+version this client speaks (module docstring); checked against
+# the broker's advertised ranges in the per-connection ApiVersions
+# handshake so an incompatible broker fails loudly at connect, not
+# mid-consume with a garbled response
+_PINNED_VERSIONS: dict[int, int] = {
+    API_PRODUCE: 3,
+    API_FETCH: 4,
+    API_LIST_OFFSETS: 1,
+    API_METADATA: 1,
+    API_OFFSET_COMMIT: 2,
+    API_OFFSET_FETCH: 1,
+    API_FIND_COORDINATOR: 0,
+    API_CREATE_TOPICS: 0,
+    API_DELETE_TOPICS: 0,
+}
+
 
 class KafkaError(RuntimeError):
     def __init__(self, code: int, where: str):
@@ -71,13 +91,50 @@ class _Conn:
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
         self._corr = 0
+        self._negotiated = False
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
             s = socket.create_connection((self.host, self.port), timeout=_SOCKET_TIMEOUT_S)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._sock = s
+            try:
+                self._negotiate(s)
+            except Exception:
+                self.close_nolock()
+                raise
         return self._sock
+
+    def _negotiate(self, sock: socket.socket) -> None:
+        """ApiVersions v0 handshake on a fresh connection (KIP-35): verify
+        every api+version this client pins sits inside the broker's
+        advertised [min, max]. Per-connection, like real clients — version
+        support can differ across brokers in a rolling upgrade. Callers
+        hold self._lock (the only entry is _connect)."""
+        if self._negotiated:
+            return
+        self._corr += 1
+        corr = self._corr
+        sock.sendall(encode_request(API_API_VERSIONS, 0, corr, _CLIENT_ID, b""))
+        r = Reader(self._read_response(sock))
+        if r.i32() != corr:
+            raise KafkaError(-1, "correlation mismatch in ApiVersions")
+        err = r.i16()
+        if err != ERR_NONE:
+            raise KafkaError(err, "ApiVersions")
+        ranges = {}
+        for _ in range(r.i32()):
+            key, lo, hi = r.i16(), r.i16(), r.i16()
+            ranges[key] = (lo, hi)
+        for key, ver in _PINNED_VERSIONS.items():
+            adv = ranges.get(key)
+            if adv is None or not (adv[0] <= ver <= adv[1]):
+                raise KafkaError(
+                    35,  # UNSUPPORTED_VERSION
+                    f"broker {self.host}:{self.port} does not support "
+                    f"api {key} v{ver} (advertises {adv})",
+                )
+        self._negotiated = True
 
     def request(self, api_key: int, api_version: int, body: bytes) -> Reader:
         with self._lock:
@@ -125,6 +182,7 @@ class _Conn:
             except OSError:
                 pass
             self._sock = None
+        self._negotiated = False  # re-handshake on the next connection
 
     def close(self) -> None:
         with self._lock:
@@ -157,9 +215,16 @@ class KafkaBroker(Broker):
         for addr in self._bootstrap:
             try:
                 c = self._conn(addr)
-                c._connect()
+                with c._lock:  # _connect (incl. the handshake) shares the
+                    c._connect()  # socket with concurrent request() calls
                 return c
-            except OSError as e:
+            except KafkaError as e:
+                if e.code == 35:  # UNSUPPORTED_VERSION: a broker that
+                    raise  # genuinely can't serve this client — fail loud
+                last = e  # other handshake failures: try the next broker
+            except (OSError, EOFError) as e:
+                # a half-dead listener (accepts TCP, drops the handshake)
+                # must not mask a healthy broker later in the list
                 last = e
         raise ConnectionError(f"no reachable kafka broker in {self._bootstrap}: {last}")
 

@@ -124,6 +124,74 @@ def record(offset_delta: int, ts_delta: int, key: bytes | None, value: bytes) ->
     return varint(len(body)) + body
 
 
+def snappy_compress_indep(data: bytes) -> bytes:
+    """Raw snappy block written from the format description
+    (github.com/google/snappy/blob/main/format_description.txt): unsigned
+    LEB128 uncompressed length, then all-literal elements in <=60-byte
+    chunks (tag (len-1)<<2). Valid, if uncompressive — the point is an
+    independent byte stream the client must decode, not ratio."""
+    out = bytearray()
+    v = len(data)
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            break
+    i = 0
+    while i < len(data):
+        chunk = data[i : i + 60]
+        out.append((len(chunk) - 1) << 2)
+        out += chunk
+        i += len(chunk)
+    return bytes(out)
+
+
+def lz4f_compress_indep(data: bytes) -> bytes:
+    """LZ4 frame via our OWN ctypes binding to the system liblz4 (not
+    oryx_tpu.bus.compress — zero shared code with the client under test)."""
+    import ctypes
+    import ctypes.util
+
+    lib = ctypes.CDLL(ctypes.util.find_library("lz4"))
+    lib.LZ4F_compressFrameBound.restype = ctypes.c_size_t
+    lib.LZ4F_compressFrameBound.argtypes = [ctypes.c_size_t, ctypes.c_void_p]
+    lib.LZ4F_compressFrame.restype = ctypes.c_size_t
+    lib.LZ4F_compressFrame.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_void_p,
+    ]
+    bound = lib.LZ4F_compressFrameBound(len(data), None)
+    buf = ctypes.create_string_buffer(bound)
+    n = lib.LZ4F_compressFrame(buf, bound, data, len(data), None)
+    if n == 0 or n > bound:
+        raise RuntimeError("LZ4F_compressFrame failed")
+    return buf.raw[:n]
+
+
+def zstd_compress_indep(data: bytes) -> bytes:
+    """zstd via our OWN ctypes binding to the system libzstd."""
+    import ctypes
+    import ctypes.util
+
+    lib = ctypes.CDLL(ctypes.util.find_library("zstd"))
+    lib.ZSTD_compressBound.restype = ctypes.c_size_t
+    lib.ZSTD_compressBound.argtypes = [ctypes.c_size_t]
+    lib.ZSTD_compress.restype = ctypes.c_size_t
+    lib.ZSTD_compress.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+    ]
+    bound = lib.ZSTD_compressBound(len(data))
+    buf = ctypes.create_string_buffer(bound)
+    n = lib.ZSTD_compress(buf, bound, data, len(data), 3)
+    if n == 0 or n > bound:
+        raise RuntimeError("ZSTD_compress failed")
+    return buf.raw[:n]
+
+
 def record_batch(
     base_offset: int,
     records: list[tuple[bytes | None, bytes]],
@@ -131,12 +199,19 @@ def record_batch(
     codec: int = 0,
 ) -> bytes:
     """RecordBatch v2 (magic 2): the fetch-response / produce-request
-    payload format. codec: 0 none, 1 gzip (attributes bits 0-2)."""
+    payload format. codec (attributes bits 0-2): 0 none, 1 gzip,
+    2 snappy, 3 lz4-frame, 4 zstd."""
     recs = b"".join(
         record(d, 0, k, v) for d, (k, v) in enumerate(records)
     )
     if codec == 1:
         recs = gzip.compress(recs, mtime=0)
+    elif codec == 2:
+        recs = snappy_compress_indep(recs)
+    elif codec == 3:
+        recs = lz4f_compress_indep(recs)
+    elif codec == 4:
+        recs = zstd_compress_indep(recs)
     after_crc = (
         i16(codec)                       # attributes
         + i32(len(records) - 1)          # lastOffsetDelta
@@ -274,6 +349,23 @@ FETCH_RECORDS = [
 ]
 
 
+def _fetch_body(record_set: bytes, err: int = 0, hw: int = 10) -> bytes:
+    """Fetch v4 response body around a raw record set (possibly empty, or
+    deliberately truncated mid-batch)."""
+    out = bytearray()
+    out += i32(0)  # throttle
+    out += i32(1)  # topics
+    out += string(TOPIC)
+    out += i32(1)  # partitions
+    out += i32(0)  # partition index
+    out += i16(err)
+    out += i64(hw)  # high watermark
+    out += i64(hw)  # last stable offset
+    out += i32(0)  # aborted txns
+    out += kbytes(record_set)
+    return bytes(out)
+
+
 def _fetch_v4() -> bytes:
     batch_a = record_batch(
         5, [(k, v) for _, k, v in FETCH_RECORDS[:3]], codec=0
@@ -281,19 +373,37 @@ def _fetch_v4() -> bytes:
     batch_b = record_batch(
         8, [(k, v) for _, k, v in FETCH_RECORDS[3:]], codec=1
     )
-    record_set = batch_a + batch_b
+    return _fetch_body(batch_a + batch_b)
+
+
+def _api_versions_v0(ranges: list[tuple[int, int, int]] | None = None) -> bytes:
+    """ApiVersions v0 response: error, then [api_key, min, max] triples —
+    the negotiation the client runs on every fresh connection (KIP-35)."""
+    if ranges is None:
+        ranges = [(k, 0, 10) for k in (0, 1, 2, 3, 8, 9, 10, 18, 19, 20)]
     out = bytearray()
-    out += i32(0)  # throttle
-    out += i32(1)  # topics
-    out += string(TOPIC)
-    out += i32(1)  # partitions
-    out += i32(0)  # partition index
-    out += i16(0)  # error
-    out += i64(10)  # high watermark
-    out += i64(10)  # last stable offset
-    out += i32(0)  # aborted txns
-    out += kbytes(record_set)
+    out += i16(0)
+    out += i32(len(ranges))
+    for key, lo, hi in ranges:
+        out += i16(key) + i16(lo) + i16(hi)
     return bytes(out)
+
+
+def _metadata_v1_unknown_topic() -> tuple[bytes, list[int]]:
+    """Metadata v1 where the topic comes back UNKNOWN_TOPIC_OR_PARTITION
+    (error 3) with no partitions — what a broker without auto-create says
+    for a missing topic."""
+    out = bytearray()
+    out += i32(1)  # brokers
+    out += i32(0) + string(HOST)
+    port_off = [len(out)]
+    out += i32(0)
+    out += string(None)  # rack
+    out += i32(0)  # controller id
+    out += i32(1)  # topics
+    out += i16(3) + string(TOPIC) + i8(0)  # UNKNOWN_TOPIC_OR_PARTITION
+    out += i32(0)  # no partitions
+    return bytes(out), port_off
 
 
 def _produce_v3() -> bytes:
@@ -306,12 +416,12 @@ def _produce_v3() -> bytes:
     return bytes(out)
 
 
-def _list_offsets_v1() -> bytes:
+def _list_offsets_v1(offset: int = 10) -> bytes:
     out = bytearray()
     out += i32(1)
     out += string(TOPIC)
     out += i32(1)
-    out += i32(0) + i16(0) + i64(-1) + i64(10)  # ts, offset=log end 10
+    out += i32(0) + i16(0) + i64(-1) + i64(offset)  # ts, resolved offset
     return bytes(out)
 
 
@@ -343,6 +453,14 @@ def _offset_fetch_v1() -> bytes:
     return bytes(out)
 
 
+def _unknown_meta_exchange() -> dict:
+    resp, port_offs = _metadata_v1_unknown_topic()
+    return {
+        "api_key": 3, "api_version": 1,
+        "response_hex": resp.hex(), "port_offsets": port_offs,
+    }
+
+
 def synthesize() -> dict:
     meta, meta_ports = _metadata_v1()
     coord, coord_ports = _find_coordinator_v0()
@@ -350,9 +468,13 @@ def synthesize() -> dict:
         "source": "spec-synthesized",
         "note": "responses built by tools/kafka_transcripts.py from the "
         "public Kafka protocol spec, independently of oryx_tpu.bus "
-        "(own varint/zigzag, CRC-32C, RecordBatch v2); refresh from a "
-        "real broker with `tools/kafka_transcripts.py record` (see "
-        "module docstring for the docker recipe)",
+        "(own varint/zigzag, CRC-32C, RecordBatch v2, own snappy "
+        "encoder and lz4/zstd ctypes bindings); refresh from a real "
+        "broker with `tools/kafka_transcripts.py record` (see module "
+        "docstring for the docker recipe). Live capture attempted on "
+        "the build host 2026-07-31: no docker/podman binary and no "
+        "network egress, so record mode has not yet run against a "
+        "real broker",
         "topic": TOPIC,
         "exchanges": {
             "metadata": {
@@ -397,6 +519,97 @@ def synthesize() -> dict:
                 "response_hex": _offset_fetch_v1().hex(),
                 "expect": {"0": 41, "1": 7},
             },
+            "api_versions": {
+                "api_key": 18, "api_version": 0,
+                "response_hex": _api_versions_v0().hex(),
+            },
+        },
+    }
+
+    # -- edge exchanges: error codes, truncation, codecs, failed
+    # negotiation. Replayed as per-test OVERRIDES of the happy-path
+    # exchanges above; response_seq_hex entries are served in order
+    # (sticky last), modeling a broker whose state changes between
+    # requests (leader movement, log truncation).
+    batch5 = record_batch(5, [(k, v) for _, k, v in FETCH_RECORDS[:3]])
+    batch8 = record_batch(8, [(k, v) for _, k, v in FETCH_RECORDS[3:]], codec=0)
+    codec_batches = {
+        "snappy": (2, 10, [(None, b"sn-ten"), (b"k11", b"sn-eleven")]),
+        "lz4": (3, 12, [(b"k12", b"lz-twelve"), (None, b"lz-thirteen")]),
+        "zstd": (4, 14, [(None, b"zs-fourteen"), (b"k15", b"zs-fifteen")]),
+        "gzip": (1, 16, [(b"k16", b"gz-sixteen"), (None, b"gz-seventeen")]),
+    }
+    codec_set = b"".join(
+        record_batch(base, recs, codec=c)
+        for c, base, recs in codec_batches.values()
+    )
+    codec_expect = [
+        [base + d, (k.decode() if k else None), v.decode()]
+        for c, base, recs in codec_batches.values()
+        for d, (k, v) in enumerate(recs)
+    ]
+    doc["edge_exchanges"] = {
+        "fetch_offset_out_of_range": {
+            # fetch@5 -> OFFSET_OUT_OF_RANGE (log truncated by retention);
+            # the client must resolve the earliest retained offset and
+            # resume there, like auto.offset.reset=earliest
+            "api_key": 1, "api_version": 4,
+            "response_seq_hex": [
+                _fetch_body(b"", err=1).hex(),
+                _fetch_body(batch8).hex(),
+            ],
+            "expect": [
+                [off, k.decode() if k else None, v.decode()]
+                for off, k, v in FETCH_RECORDS[3:]
+            ],
+        },
+        "list_offsets_earliest_8": {
+            "api_key": 2, "api_version": 1,
+            "response_hex": _list_offsets_v1(8).hex(),
+        },
+        "fetch_not_leader": {
+            # NOT_LEADER_OR_FOLLOWER: the client must refresh metadata and
+            # poll again rather than raise (leader moved mid-consume)
+            "api_key": 1, "api_version": 4,
+            "response_seq_hex": [
+                _fetch_body(b"", err=6).hex(),
+                _fetch_body(batch5).hex(),
+            ],
+            "expect": [
+                [off, k.decode() if k else None, v.decode()]
+                for off, k, v in FETCH_RECORDS[:3]
+            ],
+        },
+        "metadata_unknown_topic": _unknown_meta_exchange(),
+        "fetch_truncated": {
+            # brokers cut the record set at max_bytes, possibly mid-batch:
+            # the complete first batch must decode, the partial tail must
+            # be ignored (not crash, not corrupt)
+            "api_key": 1, "api_version": 4,
+            "response_hex": _fetch_body(batch5 + batch8[: len(batch8) // 2]).hex(),
+            "expect": [
+                [off, k.decode() if k else None, v.decode()]
+                for off, k, v in FETCH_RECORDS[:3]
+            ],
+        },
+        "fetch_codecs": {
+            # one batch per codec the client claims: gzip + snappy written
+            # by this tool's own encoders, lz4/zstd by its own ctypes
+            # bindings to the system libraries
+            "api_key": 1, "api_version": 4,
+            "response_hex": _fetch_body(codec_set, hw=18).hex(),
+            "expect": codec_expect,
+        },
+        "api_versions_no_fetch_v4": {
+            # broker too old for the client's pinned Fetch v4: negotiation
+            # must fail loudly at connect, not mid-consume with a garbled
+            # response
+            "api_key": 18, "api_version": 0,
+            "response_hex": _api_versions_v0(
+                [(0, 0, 10), (1, 0, 3), (2, 0, 10), (3, 0, 10), (8, 0, 10),
+                 (9, 0, 10), (10, 0, 10), (18, 0, 10), (19, 0, 10),
+                 (20, 0, 10)]
+            ).hex(),
         },
     }
     return doc
@@ -488,7 +701,7 @@ def offset_fetch_v1_expect(resp: bytes) -> dict[str, int]:
 _API_NAMES = {
     0: "produce", 1: "fetch", 2: "list_offsets", 3: "metadata",
     8: "offset_commit", 9: "offset_fetch", 10: "find_coordinator",
-    19: "create_topics", 20: "delete_topics",
+    18: "api_versions", 19: "create_topics", 20: "delete_topics",
 }
 
 
