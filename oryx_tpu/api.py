@@ -62,7 +62,32 @@ def _dispatch_update(handler, km: KeyMessage) -> None:
                 )
                 time.sleep(0.2 * (attempt + 1))
             else:
-                _log.exception("giving up on update message (key=%r)", km.key)
+                parked = False
+                if km.key == "MODEL-REF":
+                    # park ONLY when the artifact itself is unresolvable
+                    # (chunk stream in flight, sha-mismatch republish) —
+                    # a handler failing for its own reasons with a
+                    # resolvable artifact must not re-fire immediately
+                    # and loop (park re-checks resolvability on entry)
+                    from oryx_tpu.common.artifact import artifact_relay
+
+                    relay = artifact_relay()
+                    try:
+                        relay.resolve(km.message)
+                    except OSError:
+                        _log.warning(
+                            "MODEL-REF %r unresolved after retries; parked "
+                            "for re-dispatch on late artifact arrival",
+                            km.message,
+                        )
+                        relay.park(
+                            km.message, lambda: _dispatch_update(handler, km)
+                        )
+                        parked = True
+                if not parked:
+                    _log.exception(
+                        "giving up on update message (key=%r)", km.key
+                    )
         except Exception:
             _log.exception("ignoring bad update message (key=%r)", km.key)
             return

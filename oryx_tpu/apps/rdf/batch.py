@@ -99,6 +99,7 @@ class RDFUpdate(MLUpdate):
             max_depth=int(hyperparams["max-depth"]),
             impurity=impurity,
             n_classes=n_classes,
+            feature_subset=self.rdf.feature_subset,
             mesh=self._build_mesh(),
         )
         return forest_to_artifact(

@@ -38,6 +38,9 @@ class RDFConfig:
     max_split_candidates: object  # hyperparam range values
     max_depth: object
     impurity: object
+    # featureSubsetStrategy (reference RDFUpdate.java:143-165): "auto",
+    # "all", "sqrt", "log2", "onethird", or an explicit integer
+    feature_subset: object
 
     @classmethod
     def from_config(cls, config: Config) -> "RDFConfig":
@@ -47,6 +50,7 @@ class RDFConfig:
             max_split_candidates=g("hyperparams.max-split-candidates", 100),
             max_depth=g("hyperparams.max-depth", 8),
             impurity=g("hyperparams.impurity", "entropy"),
+            feature_subset=g("hyperparams.feature-subset", "auto"),
         )
 
 

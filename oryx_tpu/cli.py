@@ -426,29 +426,49 @@ def _supervise_serving_replicas(config: Config, n_procs: int, argv: list[str]) -
 
 def _pod_child_flags(raw_argv: list[str]) -> list[str]:
     """Rebuild a child command line from the pod invocation: drop the
-    'pod' SUBCOMMAND token (first bare occurrence only — a later
-    legitimate flag value that happens to be "pod", e.g. --conf pod,
-    must survive) and the pod-only flags with their values."""
-    base_flags: list[str] = []
-    skip_next = False
-    seen_subcommand = False
-    pod_flags = {
+    'pod' SUBCOMMAND token and the pod-only flags with their values.
+    The subcommand is the first bare token NOT bound as the value of a
+    value-taking option — argparse accepts options before the positional,
+    so `--conf pod pod --compute 2` must keep --conf's value 'pod' and
+    drop the second bare token (round-4 advice: matching the first bare
+    'pod' dropped the flag value and left the real subcommand in the
+    child argv)."""
+    value_opts = {
+        "--compute", "--local-start", "--local-count", "--coordinator",
+        "--conf", "--url", "--paths", "--rate", "--duration", "--workers",
+        "--pmml", "--set",
+    }
+    pod_only = {
         "--compute", "--local-start", "--local-count", "--coordinator",
     }
-    for tok in raw_argv:
-        if skip_next:
-            skip_next = False  # the dropped pod-flag's value
+    out: list[str] = []
+    seen_subcommand = False
+    i = 0
+    while i < len(raw_argv):
+        tok = raw_argv[i]
+        name = tok.split("=", 1)[0]
+        if name in pod_only:
+            # separate-token form consumes its value too; '=' form is one
+            i += 2 if tok == name else 1
             continue
-        if tok == "pod" and not seen_subcommand:
+        if tok in ("--speed", "--serving"):
+            i += 1
+            continue
+        if tok.startswith("-"):
+            out.append(tok)
+            if tok == name and name in value_opts and i + 1 < len(raw_argv):
+                out.append(raw_argv[i + 1])  # bound value: never subcommand
+                i += 2
+                continue
+            i += 1
+            continue
+        if not seen_subcommand:  # first UNBOUND bare token: 'pod' itself
             seen_subcommand = True
+            i += 1
             continue
-        if tok in pod_flags:
-            skip_next = True
-            continue
-        if tok.split("=", 1)[0] in pod_flags or tok in ("--speed", "--serving"):
-            continue
-        base_flags.append(tok)
-    return base_flags
+        out.append(tok)
+        i += 1
+    return out
 
 
 def cmd_pod(config: Config, args, raw_argv: list[str]) -> int:

@@ -191,14 +191,24 @@ class ArtifactRelay:
     # oversized artifact ever published
     MAX_CACHED = 8
 
+    # parked re-dispatch callbacks for refs that could not be resolved
+    # when their MODEL-REF arrived (chunks still in flight, sha-mismatch
+    # republish, eviction race): bounded because replay also walks
+    # MODEL-REFs whose artifacts were TTL-pruned years ago and will never
+    # materialize
+    MAX_PARKED = 32
+
     def __init__(self):
         import threading
 
         self._lock = threading.Lock()
         # ref -> {"n": int, "sha": str | None, "chunks": {i: bytes}}
         self._pending: dict[str, dict] = {}
-        self._cache: dict[str, Path] = {}
         self._cache_root: Path | None = None
+        # ref -> ONE re-dispatch callback (latest wins): a sha-mismatch
+        # republish parks the same ref twice, and firing both would load
+        # and swap the same model twice
+        self._parked: dict[str, object] = {}
 
     def _root(self) -> Path:
         if self._cache_root is None:
@@ -252,18 +262,24 @@ class ArtifactRelay:
         art = ModelArtifact.from_string(blob.decode("utf-8"))
         self._materialize(ref, art)
 
+    def _dest(self, ref: str) -> Path:
+        """The deterministic cache dir for a ref — derived, not tracked:
+        every process sharing the root computes the same path, so one
+        process's materialization is a cache hit for its siblings."""
+        import hashlib
+
+        return self._root() / hashlib.sha256(ref.encode()).hexdigest()[:24]
+
     def _materialize(self, ref: str, art: ModelArtifact) -> None:
         """Write the assembled artifact into the stable cache, atomically
         enough for concurrent processes: build in a per-pid temp dir, then
         rename into place; a lost race just adopts the winner's copy
         (identical bytes — both assembled the same chunk stream)."""
-        import hashlib
         import os
         import shutil
 
-        name = hashlib.sha256(ref.encode()).hexdigest()[:24]
-        dest = self._root() / name
-        tmp = self._root() / f".{name}.tmp-{os.getpid()}"
+        dest = self._dest(ref)
+        tmp = self._root() / f".{dest.name}.tmp-{os.getpid()}"
         shutil.rmtree(tmp, ignore_errors=True)
         art.write(tmp)
         shutil.rmtree(dest, ignore_errors=True)
@@ -271,15 +287,45 @@ class ArtifactRelay:
             os.replace(tmp, dest)
         except OSError:
             shutil.rmtree(tmp, ignore_errors=True)  # another process won
-        with self._lock:
-            self._cache.pop(ref, None)
-            self._cache[ref] = dest  # (re)insert at LRU tail
-            while len(self._cache) > self.MAX_CACHED:
-                old_ref, old_dir = next(iter(self._cache.items()))
-                if old_ref == ref:
-                    break
-                del self._cache[old_ref]
-                shutil.rmtree(old_dir, ignore_errors=True)
+        try:
+            os.utime(dest)  # shared LRU stamp (see _evict_cache_dirs)
+        except OSError:
+            pass
+        self._evict_cache_dirs(keep=dest)
+        self._fire_parked(ref)
+
+    def _evict_cache_dirs(self, keep: Path) -> None:
+        """Cross-PROCESS LRU over the shared per-user cache root: speed
+        and serving consumers on one host share the root, so eviction
+        must rank by shared state — directory mtimes, bumped on every
+        materialize and resolve — not a per-process dict. (Round-4
+        advice: per-process LRU deleted dirs a sibling process still held
+        in its in-memory cache, silently dropping its MODEL update.) A
+        dir in active use carries a fresh stamp and survives; any
+        residual race is caught by resolve()'s existence re-check."""
+        import shutil
+
+        try:
+            dirs = [
+                d
+                for d in self._root().iterdir()
+                if d.is_dir() and not d.name.startswith(".")
+            ]
+        except OSError:
+            return
+        if len(dirs) <= self.MAX_CACHED:
+            return
+
+        def mtime(d: Path) -> float:
+            try:
+                return d.stat().st_mtime
+            except OSError:  # concurrently evicted by a sibling
+                return 0.0
+
+        dirs.sort(key=mtime)
+        for d in dirs[: len(dirs) - self.MAX_CACHED]:
+            if d != keep:
+                shutil.rmtree(d, ignore_errors=True)
 
     def _evict_locked(self, keep: str) -> None:
         total = sum(
@@ -301,6 +347,45 @@ class ArtifactRelay:
                 "artifact relay evicted pending chunks for %s", victim
             )
 
+    def park(self, ref: str, redispatch) -> None:
+        """Register a callback to re-run when `ref` later materializes —
+        the dispatch loop's short OSError retries give up in ~1.2s, which
+        loses the model permanently when the chunk stream simply hadn't
+        finished (multi-partition lag, sha-mismatch republish, eviction
+        race). One callback per ref, latest wins: a republished ref parks
+        twice but must dispatch once. Parked callbacks fire from
+        _materialize; the register-then-recheck order closes the race
+        against a materialization landing between the caller's last retry
+        and the park."""
+        import logging
+
+        with self._lock:
+            self._parked[ref] = redispatch
+            while len(self._parked) > self.MAX_PARKED:
+                victim = next(r for r in self._parked if r != ref)
+                del self._parked[victim]
+                logging.getLogger(__name__).warning(
+                    "dropping parked MODEL-REF %s (parking full)", victim
+                )
+        try:
+            self.resolve(ref)
+        except OSError:
+            return  # genuinely pending: _materialize will fire it
+        self._fire_parked(ref)
+
+    def _fire_parked(self, ref: str) -> None:
+        import logging
+
+        with self._lock:
+            cb = self._parked.pop(ref, None)
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "parked MODEL-REF re-dispatch failed for %s", ref
+                )
+
     def resolve(self, ref: str) -> str:
         """A readable local path for a MODEL-REF: the path itself when it
         exists, else the bus-assembled cache copy. FileNotFoundError (an
@@ -309,9 +394,14 @@ class ArtifactRelay:
         p = Path(strip_scheme(ref))
         if (p / MODEL_FILENAME).exists() or p.is_file():
             return str(p)
-        with self._lock:
-            c = self._cache.get(ref)
-        if c is not None:
+        c = self._dest(ref)  # derived path: a SIBLING process's
+        if (c / MODEL_FILENAME).exists():  # materialization is a hit too
+            import os
+
+            try:
+                os.utime(c)  # shared LRU stamp: in-use dirs survive
+            except OSError:
+                pass
             return str(c)
         raise FileNotFoundError(
             f"MODEL-REF {ref} is not readable locally and no complete "

@@ -385,6 +385,29 @@ def _grow_one_tree(
     return feature, split_left, node_counts, importance
 
 
+def resolve_mtry(strategy: str | int | None, p: int, classification: bool) -> int:
+    """featureSubsetStrategy -> per-node feature count (the reference's
+    RDFUpdate.java:143-165 passes the same strategy names to MLlib):
+    "auto" = sqrt(P) for classification / P/3 for regression, "all",
+    "sqrt", "log2", "onethird", or an explicit integer."""
+    if strategy is None or strategy == "auto":
+        return max(1, int(math.sqrt(p)) if classification else p // 3)
+    if isinstance(strategy, int) or str(strategy).lstrip("-").isdigit():
+        v = int(strategy)
+        if not 1 <= v <= p:
+            raise ValueError(f"feature-subset {v} outside [1, {p}]")
+        return v
+    named = {
+        "all": p,
+        "sqrt": max(1, int(math.sqrt(p))),
+        "log2": max(1, int(math.log2(p))),
+        "onethird": max(1, p // 3),
+    }
+    if strategy not in named:
+        raise ValueError(f"unknown feature-subset strategy {strategy!r}")
+    return named[strategy]
+
+
 def grow_forest(
     data: BinnedData,
     y: np.ndarray,
@@ -393,6 +416,7 @@ def grow_forest(
     max_depth: int,
     impurity: str,
     n_classes: int,
+    feature_subset: str | int | None = "auto",
     mesh=None,
 ) -> Forest:
     """Train the forest: multinomial bootstrap weights per tree, vmapped
@@ -406,11 +430,10 @@ def grow_forest(
         jax.random.PRNGKey(int(rng.integers(2**31 - 1))), num_trees
     )
     classification = n_classes > 0
+    mtry = resolve_mtry(feature_subset, p, classification)
     if classification:
-        mtry = max(1, int(math.sqrt(p)))
         yy = np.nan_to_num(y, nan=0.0).astype(np.int32)
     else:
-        mtry = max(1, p // 3)
         yy = np.asarray(y, dtype=np.float32)
 
     grow = jax.vmap(

@@ -265,10 +265,22 @@ class AsyncHTTPServer:
                     and headers.get("upgrade", "").lower() == "h2c"
                     and "http2-settings" in headers
                 ):
-                    # h2c upgrade (RFC 7540 §3.2): 101, then serve the
-                    # original request as stream 1 over h2
-                    from oryx_tpu.serving.http2 import Http2Connection
+                    # h2c upgrade (RFC 7540 §3.2): validate the client's
+                    # HTTP2-Settings BEFORE the 101 — a malformed payload
+                    # is a malformed REQUEST (§3.2.1) and must get a 400
+                    # over h1, not a protocol error after switching
+                    from oryx_tpu.serving.http2 import (
+                        Http2Connection,
+                        decode_h2c_settings,
+                    )
 
+                    if decode_h2c_settings(headers["http2-settings"]) is None:
+                        writer.write(
+                            b"HTTP/1.1 400 Bad Request\r\n"
+                            b"Content-Length: 0\r\nConnection: close\r\n\r\n"
+                        )
+                        await writer.drain()
+                        return
                     writer.write(
                         b"HTTP/1.1 101 Switching Protocols\r\n"
                         b"Connection: Upgrade\r\nUpgrade: h2c\r\n\r\n"

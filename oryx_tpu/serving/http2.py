@@ -86,6 +86,22 @@ ACTIVE_READ_TIMEOUT = 300.0
 IDLE_READ_TIMEOUT = 75.0
 
 
+def decode_h2c_settings(value: str) -> bytes | None:
+    """base64url HTTP2-Settings payload -> raw SETTINGS bytes, or None
+    when malformed (bad base64, or a length that is not a multiple of 6).
+    RFC 7540 §3.2.1: a malformed HTTP2-Settings header means a malformed
+    REQUEST — the h1 server must reject it (400) BEFORE sending 101
+    Switching Protocols, so this helper runs in the upgrade gate."""
+    import base64
+    import binascii
+
+    try:
+        raw = base64.urlsafe_b64decode(value + "=" * (-len(value) % 4))
+    except (ValueError, binascii.Error):
+        return None
+    return raw if len(raw) % 6 == 0 else None
+
+
 class ConnectionError2(Exception):
     def __init__(self, code: int, msg: str = ""):
         super().__init__(msg)
@@ -189,17 +205,16 @@ class Http2Connection:
                 # (strict clients treat an overrun as FLOW_CONTROL_ERROR)
                 h2s = self.upgraded_request[2].get("http2-settings", "")
                 if h2s:
-                    import base64
-                    import binascii
-
-                    try:
-                        raw = base64.urlsafe_b64decode(
-                            h2s + "=" * (-len(h2s) % 4)
-                        )
-                    except (ValueError, binascii.Error):
+                    raw = decode_h2c_settings(h2s)
+                    if raw is None:
+                        # defense in depth: aserver validates before the
+                        # 101, but a malformed payload reaching here is a
+                        # malformed REQUEST (RFC 7540 §3.2.1) —
+                        # PROTOCOL_ERROR, not the FRAME_SIZE_ERROR that
+                        # _on_settings would raise for a non-multiple-of-6
                         raise ConnectionError2(
                             PROTOCOL_ERROR, "bad HTTP2-Settings header"
-                        ) from None
+                        )
                     await self._on_settings(0, raw, ack=False)
                 st = _Stream(1, self.peer_initial_window)
                 st.remote_closed = True

@@ -103,6 +103,21 @@ class ServingLayer:
 
         ensure(self.update_uri, self.update_topic, "update")
         update_broker = get_broker(self.update_uri)
+        try:
+            n_parts = update_broker.num_partitions(self.update_topic)
+        except Exception:
+            n_parts = 1
+        if n_parts > 1:
+            # chunked MODEL-REF artifact transfer assumes the publish
+            # order of one partition (MODEL-CHUNK x N, then MODEL-REF);
+            # across partitions the REF can overtake its chunks and rely
+            # on the relay's parked re-dispatch instead of fast delivery
+            log.warning(
+                "update topic %s has %d partitions; model updates assume "
+                "single-partition ordering (the reference's convention) — "
+                "chunked MODEL-REF delivery may be delayed",
+                self.update_topic, n_parts,
+            )
 
         input_producer = None
         if not self.read_only:

@@ -298,3 +298,78 @@ def test_republish_with_new_bytes_restarts_assembly(tmp_path):
     np.testing.assert_array_equal(
         loaded.tensors["centers"], new.tensors["centers"]
     )
+
+
+def test_unresolved_ref_parks_and_redispatches_on_late_arrival(tmp_path):
+    """Round-4 advice: the dispatch loop's short OSError retries gave up
+    ~1.2s after a MODEL-REF arrived, permanently dropping the model when
+    its chunk stream simply hadn't finished (multi-partition lag,
+    sha-mismatch republish). The relay now parks a re-dispatch that fires
+    when the artifact materializes."""
+    from oryx_tpu.api import _dispatch_update
+    from oryx_tpu.bus.api import KeyMessage
+
+    art = _sample_artifact()
+    prod = _CaptureProducer()
+    ref = str(tmp_path / "model" / "777")  # never written: no shared fs
+    publish_model_ref(prod, art.to_string(), ref, max_message_size=1024)
+    chunks = [m for k, m in prod.sent if k == CHUNK_KEY]
+
+    loaded = []
+
+    def handler(key, message):
+        loaded.append(read_artifact_from_update(key, message))
+
+    # the REF arrives BEFORE any chunk (out-of-order delivery): dispatch
+    # exhausts its retries and parks
+    _dispatch_update(handler, KeyMessage("MODEL-REF", ref))
+    assert loaded == []
+    # chunks finally land: materialization must fire the parked dispatch
+    for m in chunks:
+        _dispatch_update(handler, KeyMessage("MODEL-CHUNK", m))
+    assert len(loaded) == 1
+    assert loaded[0].extensions["k"] == "3"
+
+
+def test_resolve_rechecks_existence_after_sibling_eviction(tmp_path):
+    """Round-4 advice: with the cache root shared per-user across
+    processes, a sibling's eviction could delete a dir this process still
+    held in its in-memory map — resolve() must surface the retry class
+    (FileNotFoundError), never a dead path."""
+    import shutil
+
+    art = _sample_artifact()
+    prod = _CaptureProducer()
+    ref = str(tmp_path / "m2")
+    publish_model_ref(prod, art.to_string(), ref, max_message_size=4096)
+    relay = ArtifactRelay()
+    for k, m in prod.sent:
+        if k == CHUNK_KEY:
+            relay.offer(m)
+    cached = Path(relay.resolve(ref))
+    shutil.rmtree(cached)  # the sibling process's eviction
+    with pytest.raises(FileNotFoundError):
+        relay.resolve(ref)
+
+
+def test_cache_eviction_is_cross_process_lru_by_mtime(tmp_path, monkeypatch):
+    """Eviction ranks by shared directory mtimes (bumped on materialize
+    and resolve), so every process sharing the root agrees on the LRU
+    order; recently-touched dirs survive."""
+    import os
+
+    monkeypatch.setattr(ArtifactRelay, "MAX_CACHED", 3)
+    relay = ArtifactRelay()
+    relay._cache_root = tmp_path / "isolated-root"  # not the shared /tmp
+    relay._cache_root.mkdir()
+    paths = []
+    for i in range(5):
+        ref = str(tmp_path / f"gen-{i}")
+        relay._materialize(ref, ModelArtifact("kmeans", {"i": str(i)}, {}, {}))
+        p = relay._dest(ref)
+        os.utime(p, (1000 + i, 1000 + i))  # deterministic LRU order
+        paths.append(p)
+    relay._evict_cache_dirs(keep=paths[-1])
+    alive = [p.exists() for p in paths]
+    # 5 dirs, cap 3: the two oldest stamps go
+    assert alive == [False, False, True, True, True], alive

@@ -316,6 +316,41 @@ def test_digest_auth_over_h2():
         assert r3.stdout.startswith("HTTP/2 401"), r3.stdout[:200]
 
 
+def test_h2c_malformed_settings_rejected_before_101():
+    """RFC 7540 §3.2.1: a malformed HTTP2-Settings header (length not a
+    multiple of 6 after base64url decode) is a malformed REQUEST — the
+    server must answer 400 over HTTP/1.1 and never send 101 (round-4
+    advice: it used to 101 first and then fail the h2 layer with
+    FRAME_SIZE_ERROR)."""
+    import base64
+
+    bus = "mem://h2badsettings"
+    _setup_bus(bus)
+    with ServingLayer(_config(bus, "async")) as sl:
+        _wait_ready(sl.port)
+        bad = base64.urlsafe_b64encode(b"12345").rstrip(b"=")  # 5 % 6 != 0
+        with socket.create_connection(("127.0.0.1", sl.port), 10) as s:
+            s.settimeout(10)
+            s.sendall(
+                b"GET /distinct HTTP/1.1\r\nHost: x\r\n"
+                b"Connection: Upgrade, HTTP2-Settings\r\n"
+                b"Upgrade: h2c\r\nHTTP2-Settings: " + bad + b"\r\n\r\n"
+            )
+            f = s.makefile("rb")
+            status = f.readline()
+            assert b"400" in status and b"101" not in status, status
+        # not-even-base64 is rejected the same way
+        with socket.create_connection(("127.0.0.1", sl.port), 10) as s:
+            s.settimeout(10)
+            s.sendall(
+                b"GET /distinct HTTP/1.1\r\nHost: x\r\n"
+                b"Connection: Upgrade, HTTP2-Settings\r\n"
+                b"Upgrade: h2c\r\nHTTP2-Settings: !!!not-b64!!!\r\n\r\n"
+            )
+            status = s.makefile("rb").readline()
+            assert b"400" in status, status
+
+
 def test_h2c_upgrade_applies_http2_settings_header():
     """RFC 7540 §3.2.1: the HTTP2-Settings upgrade header IS the client's
     initial SETTINGS. A client advertising INITIAL_WINDOW_SIZE=8 must not

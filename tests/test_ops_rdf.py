@@ -141,3 +141,38 @@ def test_mesh_sharded_growth_matches_shapes():
     assert forest.feature.shape[0] == 8
     acc = np.mean(rdf.predict_class_probs(forest, data.binned).argmax(axis=1) == y)
     assert acc > 0.8
+
+
+def test_resolve_mtry_strategies():
+    """featureSubsetStrategy parity (reference RDFUpdate.java:143-165):
+    named strategies, explicit integers, and validation."""
+    import pytest
+
+    from oryx_tpu.ops.rdf import resolve_mtry
+
+    assert resolve_mtry("auto", 54, True) == 7    # sqrt for classification
+    assert resolve_mtry(None, 54, True) == 7
+    assert resolve_mtry("auto", 54, False) == 18  # P/3 for regression
+    assert resolve_mtry("all", 54, True) == 54
+    assert resolve_mtry("sqrt", 54, True) == 7
+    assert resolve_mtry("log2", 54, True) == 5
+    assert resolve_mtry("onethird", 54, True) == 18
+    assert resolve_mtry(14, 54, True) == 14
+    assert resolve_mtry("14", 54, True) == 14
+    with pytest.raises(ValueError):
+        resolve_mtry(0, 54, True)
+    with pytest.raises(ValueError):
+        resolve_mtry(55, 54, True)
+    with pytest.raises(ValueError):
+        resolve_mtry("bogus", 54, True)
+
+
+def test_rdf_config_feature_subset_reaches_trainer(monkeypatch):
+    """oryx.rdf.hyperparams.feature-subset flows from config through the
+    app's build into grow_forest."""
+    from oryx_tpu.apps.rdf.common import RDFConfig
+    from oryx_tpu.common.config import load_config
+
+    cfg = load_config(overlay={"oryx.rdf.hyperparams.feature-subset": 12})
+    assert RDFConfig.from_config(cfg).feature_subset == 12
+    assert RDFConfig.from_config(load_config()).feature_subset == "auto"

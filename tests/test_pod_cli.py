@@ -163,3 +163,10 @@ def test_pod_child_flags_keeps_pod_valued_flags():
     assert _pod_child_flags(["pod", "--compute=8", "--conf", "x.conf"]) == [
         "--conf", "x.conf",
     ]
+    # options BEFORE the positional (argparse allows it): a flag value
+    # spelled 'pod' must not be mistaken for the subcommand token
+    # (round-4 advice)
+    assert _pod_child_flags(["--conf", "pod", "pod", "--compute", "2"]) == [
+        "--conf", "pod",
+    ]
+    assert _pod_child_flags(["--conf=pod", "pod", "--serving"]) == ["--conf=pod"]
