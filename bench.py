@@ -1411,9 +1411,55 @@ def _compact_summary(result: dict) -> dict:
         s["error"] = (
             err if len(err) <= 400 else err[:200] + " ...[truncated]... " + err[-180:]
         )
+    if s.get("platform") != "tpu":
+        _attach_banked_tpu_window(s)
     s["final"] = True
     s["detail"] = "full artifact on the preceding detail:true line"
     return s
+
+
+def _attach_banked_tpu_window(s: dict) -> None:
+    """A forced-CPU final line still carries the LAST measured TPU
+    window, clearly provenance-labeled: the poller (tools/tpu_poll.sh)
+    fires a full bench inside any healthy window and the committed
+    BENCH_TPU_WINDOW_r*.json artifacts bank its numbers — without this, a
+    chip that wedges before the driver's own run erases the round's only
+    hardware evidence (rounds 1-4)."""
+    import glob
+    import re
+
+    try:  # NOTHING here may escape: finish() prints the final line after
+        def round_no(p: str) -> int:
+            m = re.search(r"_r(\d+)\.json$", p)
+            return int(m.group(1)) if m else -1
+
+        paths = sorted(
+            glob.glob(os.path.join(HERE, "BENCH_TPU_WINDOW_r*.json")),
+            key=round_no,
+        )
+        if not paths:
+            return
+        with open(paths[-1]) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            return
+        fin = doc.get("final")
+        if not isinstance(fin, dict) or fin.get("value") is None:
+            return  # a window that died before producing numbers is not
+            # evidence
+        s["last_tpu_window"] = {
+            "captured_at": doc.get("captured_at"),
+            "artifact": os.path.basename(paths[-1]),
+            "metric": fin.get("metric"),
+            "value": fin.get("value"),
+            "vs_baseline": fin.get("vs_baseline"),
+            "pallas_speedup": fin.get("pallas_speedup"),
+            "scaling_best": fin.get("scaling_best"),
+            # provenance, compact: the artifact file carries the details
+            "note": "banked tpu window; NOT from this run",
+        }
+    except Exception:
+        return
 
 
 def _attach_baseline_bound(result: dict, build_s, nnz) -> None:
