@@ -1,0 +1,141 @@
+#!/usr/bin/env python3
+"""Run the three nightly quality gates and write a committed artifact.
+
+Round-4 verdict #7: the env-gated nightly gates only ran when someone
+remembered to run them, and their calibration evidence lived in
+docstrings. This runner executes the SAME harness configurations as
+tests/test_quality_gate.py's ORYX_NIGHTLY gates — the 25M-shape bf16 ALS
+NaN-guard gate, the covertype-shape RDF accuracy floor, and the planted-
+blob k-means floors — and records the numbers with timestamps in
+QUALITY_r{N}.json so quality claims carry the same provenance discipline
+as perf claims.
+
+    python tools/quality_nightly.py [round_number]
+
+Exit 0 only if every gate is green.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from datetime import datetime, timezone
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    round_no = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+    out_path = Path(__file__).resolve().parent.parent / (
+        f"QUALITY_r{round_no:02d}.json" if round_no else "QUALITY.json"
+    )
+
+    from oryx_tpu.common.rng import RandomManager
+    from tests.test_quality_gate import (
+        AUC_FLOOR,
+        KMEANS_SIL_FLOOR,
+        KMEANS_SSE_RATIO_CEIL,
+        ML25M_SHAPE,
+        RDF_ACC_FLOOR,
+    )
+
+    import jax
+
+    doc: dict = {
+        "started_at": datetime.now(timezone.utc).isoformat(timespec="seconds"),
+        "platform": jax.devices()[0].platform,
+        "floors": {
+            "als_auc": AUC_FLOOR,
+            "als_nan_rows": 0,
+            "rdf_accuracy": RDF_ACC_FLOOR,
+            "kmeans_sse_ratio_max": KMEANS_SSE_RATIO_CEIL,
+            "kmeans_silhouette": KMEANS_SIL_FLOOR,
+        },
+        "gates": {},
+    }
+    ok = True
+
+    def record(name: str, fields: dict, green: bool) -> None:
+        nonlocal ok
+        ok = ok and green
+        fields["green"] = green
+        fields["finished_at"] = datetime.now(timezone.utc).isoformat(
+            timespec="seconds"
+        )
+        doc["gates"][name] = fields
+        out_path.write_text(json.dumps(doc, indent=1))
+        print(f"{name}: {'GREEN' if green else 'RED'} {fields}", flush=True)
+
+    # ---- gate 1: 25M-shape bf16 ALS NaN guard + AUC floor ---------------
+    from oryx_tpu.ml.quality import (
+        build_and_evaluate,
+        build_and_evaluate_kmeans,
+        build_and_evaluate_rdf,
+    )
+
+    t0 = time.perf_counter()
+    rep = build_and_evaluate(
+        **ML25M_SHAPE, features=50, iterations=3,
+        compute_dtype="bfloat16", seed=7,
+    )
+    record(
+        "als_25m_bf16",
+        {
+            "auc": round(rep.auc, 4),
+            "nan_rows": rep.nan_rows,
+            "interactions": rep.interactions,
+            "build_s": round(rep.build_s, 1),
+            "wall_s": round(time.perf_counter() - t0, 1),
+        },
+        rep.nan_rows == 0 and rep.auc >= AUC_FLOOR,
+    )
+
+    # ---- gate 2: covertype-shape RDF accuracy floor ---------------------
+    RandomManager.use_test_seed(1)
+    t0 = time.perf_counter()
+    rdf = build_and_evaluate_rdf(num_trees=10)
+    record(
+        "rdf_covertype_shape",
+        {
+            "accuracy": round(rdf.accuracy, 4),
+            "accuracy_ceiling": round(rdf.accuracy_ceiling, 4),
+            "examples": rdf.examples,
+            "trees": rdf.trees,
+            "build_s": round(rdf.build_s, 1),
+            "wall_s": round(time.perf_counter() - t0, 1),
+        },
+        rdf.accuracy >= RDF_ACC_FLOOR,
+    )
+
+    # ---- gate 3: planted-blob k-means floors ----------------------------
+    RandomManager.use_test_seed(1)
+    t0 = time.perf_counter()
+    km = build_and_evaluate_kmeans(
+        n_points=1_000_000, dims=20, k=50, iterations=10
+    )
+    record(
+        "kmeans_planted_blobs",
+        {
+            "sse_ratio": round(km.sse_ratio, 4),
+            "silhouette": round(km.silhouette, 3),
+            "points": km.points,
+            "k": km.k,
+            "build_s": round(km.build_s, 1),
+            "wall_s": round(time.perf_counter() - t0, 1),
+        },
+        km.sse_ratio <= KMEANS_SSE_RATIO_CEIL
+        and km.silhouette >= KMEANS_SIL_FLOOR,
+    )
+
+    doc["all_green"] = ok
+    out_path.write_text(json.dumps(doc, indent=1))
+    print(f"wrote {out_path} all_green={ok}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("ORYX_NIGHTLY", "1")
+    raise SystemExit(main())
