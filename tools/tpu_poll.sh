@@ -20,7 +20,9 @@ while true; do
       echo "$ts HEALTHY -> launching window bench" >> "$LOG"
       (cd "$REPO" && ORYX_BENCH_BUDGET_S=3000 timeout 3300 python bench.py \
         > "$REPO/.tpu_window_bench.out" 2> "$REPO/.tpu_window_bench.err"; \
-       echo "$(date -u +%FT%TZ) window bench rc=$?" >> "$LOG") &
+       echo "$(date -u +%FT%TZ) window bench rc=$?" >> "$LOG"; \
+       python "$REPO/tools/bank_window.py" "${ORYX_ROUND:-auto}" \
+         >> "$LOG" 2>&1) &
     fi
   else
     echo "$ts WEDGED rc=$rc" >> "$LOG"
