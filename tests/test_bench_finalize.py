@@ -233,13 +233,23 @@ def test_cpu_final_line_carries_banked_tpu_window(tmp_path, monkeypatch):
     w = s["last_tpu_window"]
     assert w["value"] == 123.0 and w["vs_baseline"] == 9.9
     assert "NOT from this" in w["note"]
-    # malformed banked artifacts must never break final-line emission
+    # a malformed LATER artifact must neither break final-line emission
+    # nor shadow the good banked window (best-across-files, not newest)
     (tmp_path / "BENCH_TPU_WINDOW_r100.json").write_text("[]")
     s3 = bench._compact_summary(
         {"platform": "cpu", "metric": "x", "value": 1.0, "unit": "qps",
          "vs_baseline": 0.1}
     )
-    assert s3["final"] and "last_tpu_window" not in s3
+    assert s3["final"] and s3["last_tpu_window"]["value"] == 123.0
+    # a LATER but worse (fewer-stage) window must not shadow it either
+    worse = {"final": {"metric": "m", "value": 1.0, "vs_baseline": 0.2,
+                       "stages_done": 0}}
+    (tmp_path / "BENCH_TPU_WINDOW_r101.json").write_text(_json.dumps(worse))
+    s4 = bench._compact_summary(
+        {"platform": "cpu", "metric": "x", "value": 1.0, "unit": "qps",
+         "vs_baseline": 0.1}
+    )
+    assert s4["last_tpu_window"]["value"] == 123.0
     # a TPU run does not attach it
     s2 = bench._compact_summary(
         {"platform": "tpu", "metric": "x", "value": 1.0, "unit": "qps",

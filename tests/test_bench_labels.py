@@ -133,41 +133,40 @@ def test_bank_window_tool_extracts_and_guards(tmp_path):
         )
 
     art = tmp_path / "BENCH_TPU_WINDOW_r99.json"
-    if True:
-        good = (
-            '{"detail": true, "metric": "m", "value": 2.0}\n'
-            '{"final": true, "platform": "tpu", "metric": "m", '
-            '"value": 2.0, "vs_baseline": 5.0, "stages_done": 3}\n'
-        )
-        assert run(good).returncode == 0
-        banked = json.loads(art.read_text())
-        assert banked["final"]["stages_done"] == 3
+    good = (
+        '{"detail": true, "metric": "m", "value": 2.0}\n'
+        '{"final": true, "platform": "tpu", "metric": "m", '
+        '"value": 2.0, "vs_baseline": 5.0, "stages_done": 3}\n'
+    )
+    assert run(good).returncode == 0
+    banked = json.loads(art.read_text())
+    assert banked["final"]["stages_done"] == 3
 
-        # a WORSE capture (fewer stages) must not replace it
-        worse = (
-            '{"final": true, "platform": "tpu", "metric": "m", '
-            '"value": 1.0, "stages_done": 1}\n'
-        )
-        assert run(worse).returncode == 0
-        assert json.loads(art.read_text())["final"]["stages_done"] == 3
+    # a WORSE capture (fewer stages) must not replace it
+    worse = (
+        '{"final": true, "platform": "tpu", "metric": "m", '
+        '"value": 1.0, "stages_done": 1}\n'
+    )
+    assert run(worse).returncode == 0
+    assert json.loads(art.read_text())["final"]["stages_done"] == 3
 
-        # a forced-CPU final is not hardware evidence
-        cpu = '{"final": true, "platform": "cpu", "value": 9}\n'
-        assert run(cpu, "98").returncode == 1
-        assert not (art.parent / "BENCH_TPU_WINDOW_r98.json").exists()
+    # a forced-CPU final is not hardware evidence
+    cpu = '{"final": true, "platform": "cpu", "value": 9}\n'
+    assert run(cpu, "98").returncode == 1
+    assert not (art.parent / "BENCH_TPU_WINDOW_r98.json").exists()
 
-        # equal stages but a worse vs_baseline must not replace either
-        same_stage_worse = (
-            '{"final": true, "platform": "tpu", "metric": "m", '
-            '"value": 1.0, "vs_baseline": 0.5, "stages_done": 3}\n'
-        )
-        assert run(same_stage_worse).returncode == 0
-        assert json.loads(art.read_text())["final"]["value"] == 2.0
+    # equal stages but a worse vs_baseline must not replace either
+    same_stage_worse = (
+        '{"final": true, "platform": "tpu", "metric": "m", '
+        '"value": 1.0, "vs_baseline": 0.5, "stages_done": 3}\n'
+    )
+    assert run(same_stage_worse).returncode == 0
+    assert json.loads(art.read_text())["final"]["value"] == 2.0
 
-        # no FINAL line at all
-        assert run('{"interim": true}\n', "97").returncode == 1
+    # no FINAL line at all
+    assert run('{"interim": true}\n', "97").returncode == 1
 
-        # "auto" derives round from existing BENCH_r*.json in out_dir
-        (tmp_path / "BENCH_r07.json").write_text("{}")
-        assert run(good, "auto").returncode == 0
-        assert (tmp_path / "BENCH_TPU_WINDOW_r08.json").exists()
+    # "auto" derives round from existing BENCH_r*.json in out_dir
+    (tmp_path / "BENCH_r07.json").write_text("{}")
+    assert run(good, "auto").returncode == 0
+    assert (tmp_path / "BENCH_TPU_WINDOW_r08.json").exists()

@@ -295,6 +295,23 @@ print(f"PODPAR_OK {pid}", flush=True)
 '''
 
 
+def _pod_env(device_count: int) -> dict:
+    """CPU-only worker env with exactly device_count virtual devices —
+    the one place the XLA flag surgery lives."""
+    from oryx_tpu.common.executil import cpu_subprocess_env
+
+    env = cpu_subprocess_env(dict(os.environ))
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    env["XLA_FLAGS"] = " ".join(
+        flags + [f"--xla_force_host_platform_device_count={device_count}"]
+    )
+    return env
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -323,15 +340,7 @@ def test_pod_window_agrees_both_edges(tmp_path):
         broker.send("OryxInput", None, f"r{i}")
 
     port = _free_port()
-    from oryx_tpu.common.executil import cpu_subprocess_env
-
-    env = cpu_subprocess_env(dict(os.environ))
-    flags = [
-        f
-        for f in env.get("XLA_FLAGS", "").split()
-        if "xla_force_host_platform_device_count" not in f
-    ]
-    env["XLA_FLAGS"] = " ".join(flags + ["--xla_force_host_platform_device_count=2"])
+    env = _pod_env(2)
 
     procs = [
         subprocess.Popen(
@@ -361,15 +370,7 @@ def test_two_process_pod_parallel_candidates(tmp_path):
     parallelizes across the Spark cluster). Two OS processes x 2 virtual
     CPU devices = a 4-device pod building 2 candidates concurrently."""
     port = _free_port()
-    from oryx_tpu.common.executil import cpu_subprocess_env
-
-    env = cpu_subprocess_env(dict(os.environ))
-    flags = [
-        f
-        for f in env.get("XLA_FLAGS", "").split()
-        if "xla_force_host_platform_device_count" not in f
-    ]
-    env["XLA_FLAGS"] = " ".join(flags + ["--xla_force_host_platform_device_count=2"])
+    env = _pod_env(2)
 
     procs = [
         subprocess.Popen(
@@ -389,6 +390,124 @@ def test_two_process_pod_parallel_candidates(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
         assert f"PODPAR_OK {i}" in out, out[-3000:]
+
+
+_POD_UNEVEN_WORKER = r'''
+import sys
+
+sys.path.insert(0, sys.argv[4])
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import json
+
+import numpy as np
+
+from oryx_tpu.bus.api import KeyMessage, TopicProducer
+from oryx_tpu.bus.broker import get_broker
+from oryx_tpu.common.config import load_config
+from oryx_tpu.parallel.distributed import global_mesh, init_distributed
+from oryx_tpu.parallel.mesh import MeshSpec
+from oryx_tpu.parallel.submesh import current_candidate_mesh
+
+pid, nprocs, port, root, tmp = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4], sys.argv[5]
+)
+
+base = {
+    "oryx.id": "poduneven",
+    "oryx.ml.eval.candidates": 2,
+    "oryx.ml.eval.parallelism": 2,
+    "oryx.ml.eval.hyperparam-search": "grid",
+    "oryx.ml.eval.test-fraction": 0.2,
+    "oryx.als.hyperparams.features": 8,
+    "oryx.als.hyperparams.iterations": 3,
+    "oryx.als.hyperparams.alpha": 10.0,
+    "oryx.als.hyperparams.lambda": [0.01, 500.0],
+    "oryx.als.no-known-items": True,
+    "oryx.compute.distributed.coordinator-address": f"127.0.0.1:{port}",
+    "oryx.compute.distributed.num-processes": nprocs,
+    "oryx.compute.distributed.process-id": pid,
+}
+assert init_distributed(load_config(overlay=base)) is True
+# 3 hosts x 2 local devices; model axis inside a host -> data axis = 3
+mesh = global_mesh(MeshSpec(data=3, model=2))
+
+rng = np.random.default_rng(17)
+msgs = []
+for j in range(900):
+    u = int(rng.integers(0, 40))
+    i = (u % 3) * 10 + int(rng.integers(0, 10))
+    msgs.append(KeyMessage(None, f"u{u},i{i},1,{j}"))
+
+from oryx_tpu.apps.als.batch import ALSUpdate
+
+built = []
+
+
+class Spy(ALSUpdate):
+    def build_model(self, train, hyperparams):
+        built.append((float(hyperparams["lambda"]), current_candidate_mesh()))
+        return super().build_model(train, hyperparams)
+
+
+broker = get_broker(f"mem://poduneven-{pid}")
+broker.create_topic("U", partitions=1)
+upd = Spy(load_config(overlay=base), mesh=mesh)
+upd.run_update(
+    2000, msgs, [], f"{tmp}/p{pid}-model", TopicProducer(broker, "U")
+)
+recs = broker.read("U", 0, 0, 5)
+model_msgs = [m for _, k, m in recs if k == "MODEL"]
+assert model_msgs, recs
+winner = json.loads(model_msgs[0])["extensions"]["lambda"]
+
+# groups over 3 processes at parallelism 2: [[0, 1], [2]] — candidate 0
+# (lambda 0.01) trains on a sub-mesh SPANNING processes 0 and 1 (its
+# psums/gathers cross the process boundary but stay inside the group),
+# candidate 1 on process 2 alone
+assert len(built) == 1, built
+lam, sub = built[0]
+expect_lam = 0.01 if pid in (0, 1) else 500.0
+assert lam == expect_lam, (pid, lam)
+owners = {d.process_index for d in sub.devices.ravel()}
+assert owners == ({0, 1} if pid in (0, 1) else {2}), (pid, owners)
+assert sub.devices.shape == ((2, 2) if pid in (0, 1) else (1, 2))
+
+# winner agreed pod-wide; processes 2 got it via the broadcast
+assert winner == "0.01", winner
+print(f"PODUNEVEN_OK {pid}", flush=True)
+'''
+
+
+def test_three_process_pod_uneven_groups(tmp_path):
+    """Groups that SPAN processes: 3 pod members at parallelism 2 split
+    [[0,1],[2]] — candidate 0's collectives cross the process boundary
+    inside its group while group 1 trains concurrently, and the winner
+    ships to the group that didn't build it. This is the case that
+    required train_als_tp's seed broadcast and factor gather to be
+    mesh-scoped rather than pod-wide."""
+    port = _free_port()
+    env = _pod_env(2)
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _POD_UNEVEN_WORKER, str(i), "3", str(port),
+             str(ROOT), str(tmp_path)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(3)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+        assert f"PODUNEVEN_OK {i}" in out, out[-3000:]
 
 
 def test_two_process_pod_collectives(tmp_path):
@@ -427,16 +546,7 @@ def test_two_process_pod_collectives(tmp_path):
         )
 
     port = _free_port()
-    env = dict(os.environ)
-    from oryx_tpu.common.executil import cpu_subprocess_env
-
-    env = cpu_subprocess_env(env)
-    flags = [
-        f
-        for f in env.get("XLA_FLAGS", "").split()
-        if "xla_force_host_platform_device_count" not in f
-    ]
-    env["XLA_FLAGS"] = " ".join(flags + ["--xla_force_host_platform_device_count=2"])
+    env = _pod_env(2)
 
     procs = [
         subprocess.Popen(
