@@ -1447,6 +1447,20 @@ def _compact_summary(result: dict) -> dict:
     return s
 
 
+def _window_quality_key(fin: dict) -> tuple:
+    """The ONE ordering of "which banked window is better" — shared with
+    tools/bank_window.py's overwrite guard so the bank tool and the
+    final-line selection can never disagree. Stages completed, then
+    vs_baseline; malformed fields rank lowest instead of raising."""
+    def num(x):
+        try:
+            return float(x)
+        except (TypeError, ValueError):
+            return 0.0
+
+    return (num(fin.get("stages_done")), num(fin.get("vs_baseline")))
+
+
 def _attach_banked_tpu_window(s: dict) -> None:
     """A forced-CPU final line still carries the LAST measured TPU
     window, clearly provenance-labeled: the poller (tools/tpu_poll.sh)
@@ -1456,25 +1470,32 @@ def _attach_banked_tpu_window(s: dict) -> None:
     hardware evidence (rounds 1-4)."""
     import glob
 
+    import re
+
     try:  # NOTHING here may escape: finish() prints the final line after
         # the BEST banked window across every round file — not the
         # highest-numbered one: a mislabeled or wedge-shortened later
-        # capture must never shadow a better earlier record
+        # capture must never shadow a better earlier record. Ties break
+        # on the round number so the choice is deterministic.
         best = None
-        for p in glob.glob(os.path.join(HERE, "BENCH_TPU_WINDOW_r*.json")):
-            try:
+        for p in sorted(glob.glob(os.path.join(HERE, "BENCH_TPU_WINDOW_r*.json"))):
+            try:  # one malformed file must not erase the others' evidence
                 with open(p) as f:
                     d = json.load(f)
-            except (OSError, ValueError):
+                if not isinstance(d, dict):
+                    continue
+                fin = d.get("final")
+                if not isinstance(fin, dict) or fin.get("value") is None:
+                    continue  # died before producing numbers: not evidence
+                m = re.search(r"_r(\d+)\.json$", p)
+                key = (
+                    _window_quality_key(fin),
+                    int(m.group(1)) if m else -1,
+                )
+                if best is None or key > best[0]:
+                    best = (key, p, d, fin)
+            except Exception:
                 continue
-            if not isinstance(d, dict):
-                continue
-            fin = d.get("final")
-            if not isinstance(fin, dict) or fin.get("value") is None:
-                continue  # died before producing numbers: not evidence
-            key = (fin.get("stages_done") or 0, fin.get("vs_baseline") or 0)
-            if best is None or key > best[0]:
-                best = (key, p, d, fin)
         if best is None:
             return
         _, path, doc, fin = best

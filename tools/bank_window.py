@@ -94,8 +94,11 @@ def main() -> int:
             old = json.loads(out.read_text()).get("final") or {}
         except ValueError:
             old = {}
-        old_key = (old.get("stages_done") or 0, old.get("vs_baseline") or 0)
-        new_key = (final.get("stages_done") or 0, final.get("vs_baseline") or 0)
+        sys.path.insert(0, str(ROOT))
+        from bench import _window_quality_key
+
+        old_key = _window_quality_key(old)
+        new_key = _window_quality_key(final)
         if old_key > new_key:
             print(
                 f"{out.name} already banks a better window "
