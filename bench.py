@@ -776,6 +776,13 @@ def _bench_speed_body() -> None:
     )
 
 
+# models above _CHUNK_OVER_BYTES score through topk_dot_batch_chunked in
+# ~_CHUNK_TARGET_BYTES row chunks (module constants so tests can lower
+# them and exercise the chunked path at CPU scale)
+_CHUNK_OVER_BYTES = 4 << 30
+_CHUNK_TARGET_BYTES = 2 << 30
+
+
 def _bench_scale_body() -> None:
     """Serving-kernel throughput across the reference's ENTIRE benchmark
     grid (BASELINE.md: items {1M,5M,20M} x features {50,250}; the
@@ -814,26 +821,49 @@ def _bench_scale_body() -> None:
         base_lsh, base_exact = baselines.get((n_items, features), (None, None))
         try:
             t_setup = time.perf_counter()
-            y = jax.random.normal(
-                jax.random.PRNGKey(0), (n_items, features), dtype=jnp.bfloat16
-            )
+            # oversized models score CHUNKED: one (20M, 250) bf16 operand
+            # is 10 GB whose one-shot compile crashed the remote-compile
+            # helper in the round-5 window — bounded ~2 GB chunks hit one
+            # small compiled program per shape and merge exactly
+            # (ops/als.py topk_dot_batch_chunked)
+            from oryx_tpu.ops.als import topk_dot_batch_chunked
+
+            chunk_rows = max(1, _CHUNK_TARGET_BYTES // (features * 2))
+            chunked = n_items * features * 2 > _CHUNK_OVER_BYTES
+            if chunked:
+                y = [
+                    jax.random.normal(
+                        jax.random.PRNGKey(c),
+                        (min(chunk_rows, n_items - c * chunk_rows), features),
+                        dtype=jnp.bfloat16,
+                    )
+                    for c in range((n_items + chunk_rows - 1) // chunk_rows)
+                ]
+            else:
+                y = jax.random.normal(
+                    jax.random.PRNGKey(0), (n_items, features),
+                    dtype=jnp.bfloat16,
+                )
             users = jax.random.normal(
                 jax.random.PRNGKey(1), (batch, features), dtype=jnp.bfloat16
             )
             jax.block_until_ready((y, users))
+
+            def score(recall: float):
+                if chunked:
+                    return topk_dot_batch_chunked(users, y, k=k, recall=recall)
+                return topk_dot_batch(users, y, k=k, recall=recall)
 
             def timed_qps(recall: float) -> tuple[float, float]:
                 """(qps, compile_seconds) — compile measured exactly at
                 the first blocking dispatch, never inferred from loop
                 wall-clock."""
                 tc = time.perf_counter()
-                jax.block_until_ready(
-                    topk_dot_batch(users, y, k=k, recall=recall)
-                )
+                jax.block_until_ready(score(recall))
                 comp = time.perf_counter() - tc
                 n, t0, pending = 0, time.perf_counter(), None
                 while True:
-                    _, idx = topk_dot_batch(users, y, k=k, recall=recall)
+                    _, idx = score(recall)
                     idx.copy_to_host_async()
                     if pending is not None:
                         np.asarray(pending)
@@ -853,6 +883,7 @@ def _bench_scale_body() -> None:
             row = {
                 "items": n_items, "features": features,
                 "qps": round(qps, 1),
+                **({"chunked": len(y)} if chunked else {}),
                 "baseline_lsh_qps": base_lsh,
                 "baseline_exact_qps": base_exact,
                 "compile_s": round(compile_s, 1),

@@ -1183,6 +1183,42 @@ _pallas_failed_shapes: set = set()
 PALLAS_TOPK_MAX_K = 32
 
 
+def topk_dot_batch_chunked(xs, y_chunks, *, k: int, recall: float = 1.0):
+    """Exact batched top-k over an item matrix supplied as row CHUNKS:
+    per-chunk top-k with the normal kernel (every equal-shaped chunk hits
+    the SAME compiled program), then one merge over the C*k candidates
+    with indices rebased to global rows.
+
+    Why: a single (20M, 250) bf16 dispatch is a 10 GB operand whose
+    one-shot compile crashed the remote-compile helper in the round-5
+    window (BENCH_TPU_WINDOW_r05.json scaling row error); bounded chunk
+    shapes keep every compiled program small and reusable. Top-k is
+    associative over row partitions, so the merge is exact; with
+    recall < 1 each chunk's partial reduce carries the same per-chunk
+    recall target."""
+    total = sum(int(y.shape[0]) for y in y_chunks)
+    if k > total:
+        # contract parity with the single-dispatch kernel (lax.top_k
+        # raises there); padded merge slots would otherwise fabricate
+        # (-inf, aliased-index) results
+        raise ValueError(f"k={k} exceeds total rows {total}")
+    vals, idxs = [], []
+    base = 0
+    for y in y_chunks:
+        v, i = topk_dot_batch(xs, y, k=min(k, y.shape[0]), recall=recall)
+        pad = k - v.shape[1]
+        if pad > 0:  # a chunk smaller than k still merges cleanly
+            v = jnp.pad(v, ((0, 0), (0, pad)), constant_values=-jnp.inf)
+            i = jnp.pad(i, ((0, 0), (0, pad)))
+        vals.append(v)
+        idxs.append(i + base)
+        base += y.shape[0]
+    cat_v = jnp.concatenate(vals, axis=1)
+    cat_i = jnp.concatenate(idxs, axis=1)
+    best_v, pos = jax.lax.top_k(cat_v, min(k, cat_v.shape[1]))
+    return best_v, jnp.take_along_axis(cat_i, pos, axis=1)
+
+
 def topk_dot_batch(xs, y, *, k: int, recall: float = 1.0):
     """Batched top-k scoring with automatic kernel selection: recall < 1
     takes the approximate partial-reduce; exact requests take the fused

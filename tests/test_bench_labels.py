@@ -170,3 +170,24 @@ def test_bank_window_tool_extracts_and_guards(tmp_path):
     (tmp_path / "BENCH_r07.json").write_text("{}")
     assert run(good, "auto").returncode == 0
     assert (tmp_path / "BENCH_TPU_WINDOW_r08.json").exists()
+
+
+def test_scale_body_chunked_path(monkeypatch, capsys):
+    """With the chunking thresholds lowered, the CPU-scale sweep takes
+    the chunked scoring path and reports chunk counts — the path the
+    20M x 250 row needs on hardware (its one-shot compile crashed the
+    remote-compile helper in round 5)."""
+    import json as _json
+
+    import bench
+
+    monkeypatch.setattr(bench, "_CHUNK_OVER_BYTES", 64 * 1024)
+    monkeypatch.setattr(bench, "_CHUNK_TARGET_BYTES", 32 * 1024)
+    bench._bench_scale_body()
+    out = capsys.readouterr().out
+    last = [ln for ln in out.splitlines() if ln.strip().startswith("{")][-1]
+    rows = _json.loads(last)["rows"]
+    assert rows and all("error" not in r for r in rows), rows
+    chunked_rows = [r for r in rows if r.get("chunked")]
+    assert chunked_rows, rows  # 100k x 50f bf16 = 10MB > 64KB: chunked
+    assert all(r["qps"] > 0 for r in rows)

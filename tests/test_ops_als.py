@@ -487,3 +487,28 @@ def test_train_timings_breakdown_matches_normal_path():
     assert set(t) == {"lists_s", "compile_s", "train_s", "train_flops"}
     assert t["train_flops"] > 0
     assert all(v >= 0 for v in t.values())
+
+
+def test_topk_chunked_matches_unchunked():
+    """Chunked scoring (bounded per-dispatch shapes for models whose
+    one-shot compile is too large) must agree with the single-dispatch
+    kernel exactly: same values, same GLOBAL indices, ragged last chunk
+    and chunks smaller than k included."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from oryx_tpu.ops.als import topk_dot_batch, topk_dot_batch_chunked
+
+    rng = np.random.default_rng(3)
+    xs = jnp.asarray(rng.standard_normal((7, 16)).astype(np.float32))
+    y = jnp.asarray(rng.standard_normal((1000, 16)).astype(np.float32))
+    ve, ie = topk_dot_batch(xs, y, k=10)
+
+    for sizes in [(400, 400, 200), (512, 488), (999, 1), (5, 995)]:
+        chunks, at = [], 0
+        for n in sizes:
+            chunks.append(y[at : at + n])
+            at += n
+        vc, ic = topk_dot_batch_chunked(xs, chunks, k=10)
+        np.testing.assert_allclose(np.asarray(vc), np.asarray(ve), rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(ic), np.asarray(ie))
