@@ -777,10 +777,16 @@ def _bench_speed_body() -> None:
 
 
 # models above _CHUNK_OVER_BYTES score through topk_dot_batch_chunked in
-# ~_CHUNK_TARGET_BYTES row chunks (module constants so tests can lower
-# them and exercise the chunked path at CPU scale)
-_CHUNK_OVER_BYTES = 4 << 30
-_CHUNK_TARGET_BYTES = 2 << 30
+# ~_CHUNK_TARGET_BYTES row chunks — the SAME thresholds production
+# serving uses (ops/transfer.py), re-exported as module attributes so
+# tests can lower them and exercise the chunked path at CPU scale
+def _chunk_thresholds() -> tuple[int, int]:
+    from oryx_tpu.ops.transfer import CHUNK_TARGET_BYTES, CHUNKED_OVER_BYTES
+
+    return CHUNKED_OVER_BYTES, CHUNK_TARGET_BYTES
+
+
+_CHUNK_OVER_BYTES, _CHUNK_TARGET_BYTES = None, None
 
 
 def _bench_scale_body() -> None:
@@ -828,8 +834,13 @@ def _bench_scale_body() -> None:
             # (ops/als.py topk_dot_batch_chunked)
             from oryx_tpu.ops.als import topk_dot_batch_chunked
 
-            chunk_rows = max(1, _CHUNK_TARGET_BYTES // (features * 2))
-            chunked = n_items * features * 2 > _CHUNK_OVER_BYTES
+            over_b, target_b = (
+                (_CHUNK_OVER_BYTES, _CHUNK_TARGET_BYTES)
+                if _CHUNK_OVER_BYTES is not None
+                else _chunk_thresholds()
+            )
+            chunk_rows = max(1, target_b // (features * 2))
+            chunked = n_items * features * 2 > over_b
             if chunked:
                 y = [
                     jax.random.normal(

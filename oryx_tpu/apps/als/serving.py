@@ -218,9 +218,15 @@ class ALSServingModel(ServingModel):
             # re-rank — row-aligned with the device view by construction,
             # read lock-free on the request path.
             mat = np.asarray(mat, dtype=np.float32)
-            from oryx_tpu.ops.transfer import staged_device_put
+            # oversized models come back as a ChunkedMatrix: a single
+            # (20M, 250)-class operand's program is too large to compile
+            # (ops/transfer.py); the batcher scores it chunk-and-merge
+            from oryx_tpu.ops.transfer import device_put_maybe_chunked
 
-            view = (staged_device_put(mat, dtype=jnp.bfloat16), ids, version, mat)
+            view = (
+                device_put_maybe_chunked(mat, dtype=jnp.bfloat16),
+                ids, version, mat,
+            )
             self._device_view = view
         return view
 
@@ -241,12 +247,20 @@ class ALSServingModel(ServingModel):
             view = self._unit_view
             if view is not None and view[2] == version:
                 return view[0], view[1], view[3], view[4]
-            yf = y.astype(jnp.float32)
-            norms = jnp.maximum(jnp.linalg.norm(yf, axis=1, keepdims=True), 1e-12)
+            from oryx_tpu.ops.transfer import ChunkedMatrix
+
+            def normalize(a):
+                af = a.astype(jnp.float32)
+                n = jnp.maximum(jnp.linalg.norm(af, axis=1, keepdims=True), 1e-12)
+                return (af / n).astype(a.dtype)
+
+            # row normalization is row-local, so a chunked view normalizes
+            # per chunk and stays chunked
+            unit = y.map(normalize) if isinstance(y, ChunkedMatrix) else normalize(y)
             # host row norms cached per version too: the wedged-device
             # cosine fallback must not pay an O(N.K) norm pass per request
             host_norms = np.linalg.norm(host_mat, axis=1)
-            view = ((yf / norms).astype(y.dtype), ids, version, host_mat, host_norms)
+            view = (unit, ids, version, host_mat, host_norms)
             self._unit_view = view
         return view[0], view[1], view[3], view[4]
 

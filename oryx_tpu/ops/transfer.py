@@ -65,3 +65,75 @@ def staged_device_put(a: np.ndarray, dtype=None, chunk_bytes: int = DEFAULT_CHUN
         # the giant-buffered-write profile staging exists to avoid
         buf = _write(buf, jax.block_until_ready(dev), jnp.int32(start))
     return jax.block_until_ready(buf)
+
+
+# ---------------------------------------------------------------------------
+# chunked device matrices: models whose SINGLE-array program shapes are too
+# large to compile (observed: a (20M, 250) bf16 operand — 10 GB — crashed
+# the remote-compile helper, BENCH_TPU_WINDOW_r05.json scaling row). The
+# matrix lives as bounded row chunks; every compiled program sees only a
+# chunk shape, and all equal chunks share one program.
+# ---------------------------------------------------------------------------
+
+# auto-chunk threshold + per-chunk target for serving device views
+CHUNKED_OVER_BYTES = 4 << 30
+CHUNK_TARGET_BYTES = 2 << 30
+
+
+class ChunkedMatrix:
+    """Row-chunked committed device matrix. Quacks like an array exactly
+    where the serving batcher needs it (shape / dtype / devices); scoring
+    dispatches through ops.als.topk_dot_batch_chunked, which merges the
+    per-chunk top-ks with globally rebased indices."""
+
+    __slots__ = ("chunks",)
+
+    def __init__(self, chunks):
+        self.chunks = list(chunks)
+        if not self.chunks:
+            raise ValueError("ChunkedMatrix needs at least one chunk")
+
+    @property
+    def shape(self):
+        return (sum(int(c.shape[0]) for c in self.chunks),) + tuple(
+            self.chunks[0].shape[1:]
+        )
+
+    @property
+    def dtype(self):
+        return self.chunks[0].dtype
+
+    def devices(self):
+        return self.chunks[0].devices()
+
+    def map(self, fn):
+        """Per-chunk transform (e.g. row normalization for the cosine
+        view) — row-local operations only; anything cross-chunk belongs
+        in the merge step of the chunked kernel."""
+        return ChunkedMatrix([fn(c) for c in self.chunks])
+
+
+def device_put_maybe_chunked(
+    a: np.ndarray,
+    dtype=None,
+    over_bytes: int | None = None,
+    chunk_bytes: int | None = None,
+):
+    """staged_device_put for matrices that fit one program; ChunkedMatrix
+    above `over_bytes` (in TARGET dtype), with ~`chunk_bytes` chunks.
+    Thresholds resolve at call time so tests can lower the module
+    constants and exercise the chunked path at toy scale."""
+    if over_bytes is None:
+        over_bytes = CHUNKED_OVER_BYTES
+    if chunk_bytes is None:
+        chunk_bytes = CHUNK_TARGET_BYTES
+    a = np.asarray(a)
+    itemsize = jnp.dtype(dtype).itemsize if dtype is not None else a.itemsize
+    target_bytes = int(np.prod(a.shape, dtype=np.int64)) * itemsize
+    if a.ndim != 2 or target_bytes <= over_bytes:
+        return staged_device_put(a, dtype=dtype)
+    rows_per = max(1, chunk_bytes // max(1, a.shape[1] * itemsize))
+    return ChunkedMatrix(
+        staged_device_put(a[at : at + rows_per], dtype=dtype)
+        for at in range(0, a.shape[0], rows_per)
+    )

@@ -242,3 +242,41 @@ def test_factor_store_get_many_matches_get():
     # empty input
     mat, present = st.x.get_many([])
     assert mat.shape == (0, 3) and present.shape == (0,)
+
+
+def test_chunked_device_view_serves_identically(monkeypatch):
+    """Models above the chunking threshold serve through a ChunkedMatrix
+    device view (bounded per-program shapes — a single (20M, 250) bf16
+    operand crashed the remote-compile helper): /recommend and cosine
+    /similarity results must be identical to the single-array view."""
+    import numpy as np
+
+    import oryx_tpu.ops.transfer as transfer
+    from oryx_tpu.ops.transfer import ChunkedMatrix
+
+    rng = np.random.default_rng(8)
+    n, k = 300, 8
+
+    def build():
+        st = ALSState(k, True)
+        for i in range(n):
+            st.y.set(f"i{i}", rng.standard_normal(k).astype(np.float32))
+        return ALSServingModel(st)
+
+    rng = np.random.default_rng(8)
+    plain = build()
+    # materialize plain's views BEFORE lowering the thresholds: the view
+    # builds lazily on first use, and a late build would silently make
+    # this a chunked-vs-chunked self-comparison
+    assert not isinstance(plain._y_view_full()[0], ChunkedMatrix)
+    plain._y_unit_view()
+    rng = np.random.default_rng(8)
+    monkeypatch.setattr(transfer, "CHUNKED_OVER_BYTES", 1024)
+    monkeypatch.setattr(transfer, "CHUNK_TARGET_BYTES", 2048)
+    chunked = build()
+
+    assert isinstance(chunked._y_view_full()[0], ChunkedMatrix)
+    assert chunked._y_view_full()[0].shape == (n, k)
+    q = rng.standard_normal(k).astype(np.float32)
+    assert chunked.top_n(q, 12) == plain.top_n(q, 12)
+    assert chunked.top_n(q, 12, cosine=True) == plain.top_n(q, 12, cosine=True)
