@@ -136,3 +136,33 @@ def test_configure_compilation_cache(tmp_path):
                 jax.config.update(flag, default)
             except AttributeError:
                 pass
+
+
+def test_host_broadcast_bytes_single_process():
+    """Single-process degenerate forms: payload passes through, None and
+    empty become b"" (the multi-process paths run in test_multihost.py
+    via the pod winner shipping)."""
+    from oryx_tpu.parallel.distributed import host_broadcast_bytes
+
+    assert host_broadcast_bytes(b"abc", 0) == b"abc"
+    assert host_broadcast_bytes(None, 0) == b""
+    assert host_broadcast_bytes(b"", 0) == b""
+
+
+def test_window_quality_key_ordering():
+    """bench._window_quality_key is the ONE ordering of banked TPU
+    windows (shared with tools/bank_window.py): stages first, then
+    vs_baseline, malformed fields rank lowest instead of raising."""
+    from bench import _window_quality_key as key  # repo root on sys.path
+    # via tests/conftest.py
+
+    assert key({"stages_done": 3, "vs_baseline": 1.0}) > key(
+        {"stages_done": 2, "vs_baseline": 99.0}
+    )
+    assert key({"stages_done": 2, "vs_baseline": 5.0}) > key(
+        {"stages_done": 2, "vs_baseline": 4.0}
+    )
+    # numeric strings coerce and order correctly; junk ranks lowest
+    assert key({"stages_done": "3", "vs_baseline": None}) == (3.0, 0.0)
+    assert key({"stages_done": "wedged", "vs_baseline": [1]}) == (0.0, 0.0)
+    assert key({}) == (0.0, 0.0)

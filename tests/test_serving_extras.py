@@ -375,3 +375,34 @@ def test_fast_segments_respect_context_path():
     assert app.is_fast("/api/ready")
     assert not app.is_fast("/ready")      # outside the context: 404 path
     assert not app.is_fast("/api/ingest")
+
+
+def test_multipartition_update_topic_warns(caplog):
+    """Chunked MODEL-REF transfer assumes single-partition publish order;
+    a multi-partition update topic must be called out loudly at startup
+    (round-4 advice: the REF can overtake its chunks across partitions)."""
+    import logging
+
+    from oryx_tpu.bus.broker import topics
+
+    bus = "mem://multipart-upd"
+    topics.maybe_create(bus, "OryxInput", partitions=1)
+    topics.maybe_create(bus, "OryxUpdate", partitions=3)
+    cfg = _config(bus, _free_port())
+    with caplog.at_level(logging.WARNING, logger="oryx_tpu.serving.server"):
+        with ServingLayer(cfg) as sl:
+            _wait_ready(sl.port)
+    assert any(
+        "3 partitions" in r.message and "single-partition" in r.message
+        for r in caplog.records
+    ), [r.message for r in caplog.records][:10]
+
+    # the single-partition default stays silent
+    bus2 = "mem://singlepart-upd"
+    topics.maybe_create(bus2, "OryxInput", partitions=1)
+    topics.maybe_create(bus2, "OryxUpdate", partitions=1)
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="oryx_tpu.serving.server"):
+        with ServingLayer(_config(bus2, _free_port())) as sl:
+            pass
+    assert not any("partitions" in r.message for r in caplog.records)
