@@ -95,6 +95,12 @@ def _parse_args(argv):
         "over TLS) instead of HTTP/1.1",
     )
     p.add_argument(
+        "--loops", type=int, default=None,
+        help="serving: async-frontend event-loop threads, each with its "
+        "own SO_REUSEPORT listener sharing ONE model (overrides "
+        "oryx.serving.api.loops; 0 = one per CPU core)",
+    )
+    p.add_argument(
         "--pmml",
         help="PMML file to import (import-pmml): published to the update "
         "topic as a MODEL so running speed/serving layers pick it up",
@@ -436,7 +442,7 @@ def _pod_child_flags(raw_argv: list[str]) -> list[str]:
     value_opts = {
         "--compute", "--local-start", "--local-count", "--coordinator",
         "--conf", "--url", "--paths", "--rate", "--duration", "--workers",
-        "--pmml", "--set",
+        "--pmml", "--set", "--loops",
     }
     pod_only = {
         "--compute", "--local-start", "--local-count", "--coordinator",
@@ -736,6 +742,46 @@ class _H2LoadConn:
             pass
 
 
+def _scrape_serving_metrics(host: str, port: int, tls: bool, prefix: str):
+    """Best-effort post-run /metrics scrape: how many frontend event
+    loops actually served traffic and the batcher's achieved mean batch
+    size. None when the endpoint is unreachable/disabled/authed — the
+    loadtest report simply omits the server block then."""
+    import http.client
+    import re
+
+    try:
+        conn = (
+            http.client.HTTPSConnection(host, port, timeout=5)
+            if tls
+            else http.client.HTTPConnection(host, port, timeout=5)
+        )
+        conn.request("GET", (prefix or "") + "/metrics")
+        r = conn.getresponse()
+        text = r.read().decode("utf-8", "replace")
+        conn.close()
+        if r.status != 200:
+            return None
+    except Exception:
+        return None
+    loops: dict[str, float] = {}
+    mean_batch = None
+    for line in text.splitlines():
+        m = re.match(r'oryx_http_loop_requests\{loop="(\d+)"\} (\S+)', line)
+        if m:
+            loops[m.group(1)] = float(m.group(2))
+        elif line.startswith("oryx_topk_mean_batch "):
+            mean_batch = float(line.split()[1])
+    out = {}
+    if loops:
+        out["loops"] = len(loops)
+        out["loops_serving"] = sum(1 for v in loops.values() if v > 0)
+        out["loop_requests"] = {k: int(v) for k, v in sorted(loops.items())}
+    if mean_batch is not None:
+        out["mean_device_batch"] = round(mean_batch, 2)
+    return out or None
+
+
 def cmd_loadtest(config: Config, args) -> int:
     """Replay request paths against a running serving layer at a target
     rate and report throughput + latency percentiles — the operational
@@ -857,22 +903,25 @@ def cmd_loadtest(config: Config, args) -> int:
         print(json.dumps({"requests": 0, "errors": n_err, "seconds": round(dt, 2)}))
         return 1
     pct = lambda p: round(lats[min(len(lats) - 1, int(p / 100 * len(lats)))], 2)
-    print(
-        json.dumps(
-            {
-                "requests": n_ok,
-                "errors": n_err,
-                "seconds": round(dt, 2),
-                "qps": round(n_ok / dt, 1),
-                "latency_ms": {
-                    "p50": pct(50), "p90": pct(90), "p99": pct(99),
-                    "max": round(lats[-1], 2),
-                },
-                "target_rate": rate or "unlimited",
-                "workers": n_workers,
-            }
-        )
-    )
+    report = {
+        "requests": n_ok,
+        "errors": n_err,
+        "seconds": round(dt, 2),
+        "qps": round(n_ok / dt, 1),
+        "latency_ms": {
+            "p50": pct(50), "p90": pct(90), "p99": pct(99),
+            "max": round(lats[-1], 2),
+        },
+        "target_rate": rate or "unlimited",
+        "workers": n_workers,
+    }
+    # server-side view of the same run: loop fan-out coverage + achieved
+    # device batch size, so a frontend-scaling regression (one loop doing
+    # all the work, batches collapsing to 1) is visible in the report
+    server_stats = _scrape_serving_metrics(host, port, tls, prefix)
+    if server_stats is not None:
+        report["server"] = server_stats
+    print(json.dumps(report))
     return 0
 
 
@@ -882,6 +931,10 @@ def main(argv=None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    if args.loops is not None:
+        # plain config sugar: rides args.set so replica children and pod
+        # spawns inherit it like any other override
+        args.set.append(f"oryx.serving.api.loops={args.loops}")
     config = _build_config(args)
     _apply_platform_env(config)
     seed = config.get("oryx.test.seed", None)

@@ -36,9 +36,10 @@ MICROBATCH_BUCKETS = (
 
 
 class GaugeSeriesGone(Exception):
-    """Raised by a bound gauge callable to permanently remove its series
-    (e.g. the object it reports on was garbage-collected). Any other
-    exception from a callable skips the series for this scrape only."""
+    """Raised by a bound gauge/counter callable to permanently remove its
+    series (e.g. the object it reports on was garbage-collected). Any
+    other exception from a callable skips the series for this scrape
+    only."""
 
 
 def _label_key(labels: dict[str, str]) -> tuple[tuple[str, str], ...]:
@@ -69,7 +70,12 @@ def _fmt_value(v: float) -> str:
 
 
 class Counter:
-    """Monotonically increasing metric, per label set."""
+    """Monotonically increasing metric, per label set. A series may also
+    be bound to a callable (set_function) evaluated at scrape time — for
+    counters whose source of truth is owned by one thread (e.g. an event
+    loop's request tally), so the hot path increments a plain int and
+    only the scrape crosses threads. The callable must be monotonic to
+    keep counter semantics."""
 
     kind = "counter"
 
@@ -77,6 +83,7 @@ class Counter:
         self.name = name
         self.help = help
         self._values: dict[tuple, float] = {}
+        self._fns: dict[tuple, object] = {}
         self._lock = threading.Lock()
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
@@ -84,16 +91,48 @@ class Counter:
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
+    def set_function(self, fn, **labels: str) -> None:
+        with self._lock:
+            self._fns[_label_key(labels)] = fn
+
+    def unbind_function(self, fn=None, **labels: str) -> None:
+        """Drop a callback-bound series. When `fn` is given, only that
+        exact binding is removed — a closed owner unbinding on shutdown
+        cannot clobber a newer owner's binding under the same labels."""
+        key = _label_key(labels)
+        with self._lock:
+            if fn is None or self._fns.get(key) is fn:
+                self._fns.pop(key, None)
+
     def value(self, **labels: str) -> float:
-        return self._values.get(_label_key(labels), 0.0)
+        key = _label_key(labels)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return float(fn())
+        return self._values.get(key, 0.0)
 
     def render(self) -> list[str]:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
-            items = sorted(self._values.items())
-        if not items:
+            keys = sorted(set(self._values) | set(self._fns))
+            snapshot = dict(self._values)
+            fns = dict(self._fns)
+        if not keys:
             lines.append(f"{self.name} 0")
-        for key, v in items:
+        for key in keys:
+            fn = fns.get(key)
+            if fn is not None:
+                try:
+                    v = float(fn())
+                except GaugeSeriesGone:
+                    with self._lock:
+                        self._fns.pop(key, None)
+                    continue
+                except Exception:
+                    # transient callback failure: skip this scrape only
+                    continue
+            else:
+                v = snapshot.get(key, 0.0)
             lines.append(f"{self.name}{_fmt_labels(key)} {_fmt_value(v)}")
         return lines
 

@@ -385,13 +385,25 @@ def _grow_one_tree(
     return feature, split_left, node_counts, importance
 
 
-def resolve_mtry(strategy: str | int | None, p: int, classification: bool) -> int:
-    """featureSubsetStrategy -> per-node feature count (the reference's
-    RDFUpdate.java:143-165 passes the same strategy names to MLlib):
-    "auto" = sqrt(P) for classification / P/3 for regression, "all",
-    "sqrt", "log2", "onethird", or an explicit integer."""
+def resolve_mtry(
+    strategy: str | int | None,
+    p: int,
+    classification: bool,
+    num_trees: int | None = None,
+) -> int:
+    """featureSubsetStrategy -> per-node feature count, MLlib semantics
+    (the reference's RDFUpdate.java:143-165 passes the same strategy
+    names to RandomForest): "auto" = "all" for a single tree, else
+    sqrt(P) for classification / ceil(P/3) for regression; "all",
+    "sqrt", "log2", "onethird" = ceil(P/3), or an explicit integer.
+    num_trees=None (unknown) treats the forest as multi-tree."""
+    onethird = max(1, -(-p // 3))  # ceil(p/3), matching MLlib
     if strategy is None or strategy == "auto":
-        return max(1, int(math.sqrt(p)) if classification else p // 3)
+        # MLlib: a single tree has no inter-tree decorrelation to buy
+        # with feature subsampling, so "auto" degrades to "all"
+        if num_trees == 1:
+            return p
+        return max(1, int(math.sqrt(p))) if classification else onethird
     if isinstance(strategy, int) or str(strategy).lstrip("-").isdigit():
         v = int(strategy)
         if not 1 <= v <= p:
@@ -401,7 +413,7 @@ def resolve_mtry(strategy: str | int | None, p: int, classification: bool) -> in
         "all": p,
         "sqrt": max(1, int(math.sqrt(p))),
         "log2": max(1, int(math.log2(p))),
-        "onethird": max(1, p // 3),
+        "onethird": onethird,
     }
     if strategy not in named:
         raise ValueError(f"unknown feature-subset strategy {strategy!r}")
@@ -430,7 +442,7 @@ def grow_forest(
         jax.random.PRNGKey(int(rng.integers(2**31 - 1))), num_trees
     )
     classification = n_classes > 0
-    mtry = resolve_mtry(feature_subset, p, classification)
+    mtry = resolve_mtry(feature_subset, p, classification, num_trees=num_trees)
     if classification:
         yy = np.nan_to_num(y, nan=0.0).astype(np.int32)
     else:

@@ -89,6 +89,14 @@ def pod_group_submesh(mesh: Mesh, k: int) -> tuple[int, list[list[int]], Mesh] |
     procs = sorted(set(row_owner))
     if len(procs) <= 1:
         return None
+    if set(procs) != set(range(jax.process_count())):
+        # Pod-global determinism guard: a custom training_mesh that
+        # excludes some process would send the excluded member down the
+        # serial fallback while the included ones enter the parallel
+        # search — divergent control flow that wedges the pod's
+        # collectives. Every member computes this same set comparison
+        # from the same mesh, so the whole pod falls back together.
+        return None
     groups = process_groups(procs, k)
     if len(groups) <= 1:
         return None

@@ -176,6 +176,12 @@ class ServingApp:
         # patterns whose first segment is a parameter (scanned after the
         # group). Dispatch touches ~2 candidate routes instead of all.
         self._route_index: dict[str | None, list[_Route]] = {}
+        # fully-literal patterns resolved by ONE dict lookup on
+        # (method, path) — no regex on the hot path. Consistent with the
+        # precedence contract: an exact hit IS the winning literal route
+        # (first registration wins via setdefault; a miss — unknown path
+        # or method — falls through to the indexed scan for 404/405).
+        self._exact_routes: dict[tuple[str, str], _Route] = {}
         self.fast_segments: set[str] = set()
         self._slow_segments: set[str] = set()
         self._wildcard_blocking = False
@@ -222,6 +228,10 @@ class ServingApp:
         def deco(fn):
             r = _Route(method.upper(), _compile(pattern), fn, nonblocking)
             self.routes.append(r)
+            if "{" not in pattern:
+                stripped = pattern.strip("/")
+                norm = f"/{stripped}" if stripped else "/"
+                self._exact_routes.setdefault((r.method, norm), r)
             seg = _first_literal(pattern)
             self._route_index.setdefault(seg, []).append(r)
             # a first segment is "fast" only while EVERY route under it is
@@ -328,6 +338,20 @@ class ServingApp:
                 return _render_error(
                     404, f"outside context path {self.context_path}", req
                 )
+        # Literal fast path: a parameterless route resolves with one dict
+        # probe and zero regex work (the /recommend-family hot paths are
+        # parameterized and take the indexed scan below; /ready, /metrics
+        # and the console land here).
+        exact = self._exact_routes.get((req.method, req.path))
+        if exact is not None:
+            req.params = {}
+            try:
+                result = exact.handler(self, req)
+            except Exception as e:  # noqa: BLE001 - boundary: render error
+                return _render_exception(e, req)
+            if isinstance(result, Deferred):
+                return result  # rendered at completion by dispatch_nowait
+            return _render(result, req)
         # Precedence contract: literal-first-segment routes match before
         # parameter-first ones; within each group, registration order wins.
         # (This differs from a pure registration-order scan only when a

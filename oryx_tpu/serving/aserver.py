@@ -3,13 +3,25 @@
 The reference serving layer runs a 400-thread Tomcat with HTTP/1.1-NIO2 +
 HTTP/2 connectors (framework/oryx-lambda-serving .../ServingLayer.java:
 58-339). A thread-per-connection stdlib server is the Python analogue of
-old blocking Tomcat; this module is the NIO analogue: one event loop owns
-every connection (accept/read/write never hold a thread each), and only
+old blocking Tomcat; this module is the NIO analogue: an event loop owns
+its connections (accept/read/write never hold a thread each), and only
 the blocking part of a request — ``ServingApp.dispatch``, which may park
 on the device micro-batcher — occupies a worker-pool thread. Connection
 count therefore scales independently of thread count, and the worker pool
 bounds in-flight dispatches the way Tomcat's executor bounds request
 threads.
+
+Multi-loop fan-out (``oryx.serving.api.loops``): the frontend runs N
+acceptor/event-loop threads, EACH with its own ``SO_REUSEPORT`` listener
+socket on the same port — the kernel balances connections across them —
+but all sharing ONE ServingApp, ONE model manager, ONE worker pool, and
+the ONE process-wide TopKBatcher. Unlike the full-replica mode
+(``oryx.serving.api.processes``), which forks whole processes and
+duplicates the HBM-resident factor matrices per replica, concurrent
+requests from every loop coalesce into the SAME device dispatches:
+bigger batches, fewer compiles, one model copy. Each loop's state
+(connection registry, request counter) is touched only by its own
+thread, so the loops share nothing mutable but the app itself.
 
 Selected by ``oryx.serving.api.server = "async"`` (the default;
 ``"threaded"`` keeps the stdlib ThreadingHTTPServer path). Both frontends
@@ -22,8 +34,10 @@ from __future__ import annotations
 import asyncio
 import gzip
 import logging
+import socket
 import ssl
 import threading
+import weakref
 from concurrent.futures import ThreadPoolExecutor
 from urllib.parse import parse_qs, urlsplit
 
@@ -48,10 +62,72 @@ _COMMON_STATUS = {
 }
 
 
-class AsyncHTTPServer:
-    """Event-loop HTTP/1.1 server wrapping a ServingApp.
+def _split_target(target: str) -> tuple[str, dict[str, list[str]]]:
+    """Request target -> (path, query dict), skipping urlsplit + parse_qs
+    allocation on the hot path. The common serving shapes
+    (``?howMany=10``, ``?offsetSince=...``) carry no percent-escapes, no
+    '+', and no blank values, so a straight split is exact; anything
+    escaped/odd falls back to the stdlib parsers, byte-for-byte."""
+    if target.startswith("/") and "#" not in target:
+        q = target.find("?")
+        if q < 0:
+            return target, {}
+        path, qs = target[:q], target[q + 1 :]
+        if not qs:
+            return path, {}
+        if "%" not in qs and "+" not in qs:
+            out: dict[str, list[str]] = {}
+            for part in qs.split("&"):
+                k, sep, v = part.partition("=")
+                # parse_qs drops blank values and bare keys by default
+                if sep and v:
+                    bucket = out.get(k)
+                    if bucket is None:
+                        out[k] = [v]
+                    else:
+                        bucket.append(v)
+            return path, out
+        return path, parse_qs(qs)
+    split = urlsplit(target)
+    return split.path, parse_qs(split.query)
 
-    Runs its asyncio loop on a dedicated thread so it presents the same
+
+class _LoopState:
+    """One event loop's private world: its thread, its SO_REUSEPORT
+    listener, its live-connection registry, and its request counter.
+    Everything here is touched only by the owning loop's thread (the
+    counter is read, never written, by /metrics scrapes), so none of it
+    needs a lock."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.thread: threading.Thread | None = None
+        self.loop: asyncio.AbstractEventLoop | None = None
+        self.server: asyncio.AbstractServer | None = None
+        # live per-connection tasks -> parked-between-requests flag
+        self.conns: dict = {}
+        # h1 requests + h2 streams served by this loop
+        self.requests = 0
+        self.started = threading.Event()
+        self.error: BaseException | None = None
+
+
+def _loop_requests_reader(ref):
+    from oryx_tpu.common.metrics import GaugeSeriesGone
+
+    def read() -> float:
+        ls = ref()
+        if ls is None:
+            raise GaugeSeriesGone("event loop gone")
+        return float(ls.requests)
+
+    return read
+
+
+class AsyncHTTPServer:
+    """Multi-event-loop HTTP/1.1(+h2) server wrapping a ServingApp.
+
+    Runs each asyncio loop on a dedicated thread so it presents the same
     synchronous start()/close() surface as the threaded frontend.
     """
 
@@ -63,52 +139,143 @@ class AsyncHTTPServer:
         ssl_context: ssl.SSLContext | None = None,
         workers: int = 128,
         reuse_port: bool = False,
+        loops: int = 1,
     ):
         self.app = app
         self.auth = auth
         self.port = port
         self._ssl = ssl_context
         self._reuse_port = reuse_port
+        self.loops = max(1, loops)
         self._pool = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix="oryx-serving-worker"
         )
-        self._loop: asyncio.AbstractEventLoop | None = None
-        self._server: asyncio.AbstractServer | None = None
-        self._thread: threading.Thread | None = None
-        self._started = threading.Event()
-        self._start_error: BaseException | None = None
-        # live per-connection tasks -> parked-between-requests flag
-        self._conns: dict = {}
+        self._loopstates: list[_LoopState] = []
+        self._want_reuse = False
+        # (reader fn, loop label) bindings registered on the global
+        # metrics registry, so close() can drop exactly them
+        self._metric_bindings: list[tuple[object, str]] = []
+
+    # -- introspection (tests + threaded-era callers) ----------------------
+
+    @property
+    def _conns(self) -> dict:
+        """Merged view of every loop's live-connection registry (read-only:
+        each loop owns its own dict)."""
+        merged: dict = {}
+        for ls in self._loopstates:
+            merged.update(ls.conns)
+        return merged
+
+    @property
+    def _thread(self) -> threading.Thread | None:
+        return self._loopstates[0].thread if self._loopstates else None
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._run_loop, name="oryx-serving-aio", daemon=True
-        )
-        self._thread.start()
-        self._started.wait(timeout=30)
-        if self._start_error is not None:
-            raise self._start_error
-        if self._server is None:
+        n = self.loops
+        if n > 1 and not hasattr(socket, "SO_REUSEPORT"):
+            log.warning(
+                "oryx.serving.api.loops=%d but this platform has no "
+                "SO_REUSEPORT; running a single event loop", n,
+            )
+            n = 1
+        self._want_reuse = self._reuse_port or n > 1
+
+        # loop 0 binds first and resolves an ephemeral port; the remaining
+        # loops then join that CONCRETE port with SO_REUSEPORT
+        first = _LoopState(0)
+        self._loopstates = [first]
+        self._start_loop(first)
+        first.started.wait(timeout=30)
+        if first.error is not None:
+            raise first.error
+        if first.server is None:
             raise RuntimeError("async serving frontend failed to start")
 
+        rest = [_LoopState(i) for i in range(1, n)]
+        self._loopstates.extend(rest)
+        for ls in rest:
+            self._start_loop(ls)
+        for ls in rest:
+            ls.started.wait(timeout=30)
+            if ls.error is not None or ls.server is None:
+                err = ls.error or RuntimeError(
+                    f"serving event loop {ls.index} failed to start"
+                )
+                self.close()  # don't leave the earlier loops listening
+                raise err
+        self._register_metrics()
+
+    def _start_loop(self, ls: _LoopState) -> None:
+        ls.thread = threading.Thread(
+            target=self._run_loop, args=(ls,),
+            name=f"oryx-serving-aio-{ls.index}", daemon=True,
+        )
+        ls.thread.start()
+
+    def _register_metrics(self) -> None:
+        """Per-loop request counters on the process-global registry:
+        `oryx_http_loop_requests{loop="i"}`. Callback-bound (the loop
+        thread owns the int; scrapes read it live) and weakly referenced
+        so a closed server's series disappear instead of pinning it."""
+        from oryx_tpu.common.metrics import get_registry
+
+        c = get_registry().counter(
+            "oryx_http_loop_requests",
+            "HTTP requests served, by frontend event loop",
+        )
+        for ls in self._loopstates:
+            reader = _loop_requests_reader(weakref.ref(ls))
+            c.set_function(reader, loop=str(ls.index))
+            self._metric_bindings.append((reader, str(ls.index)))
+
     def close(self) -> None:
-        loop = self._loop
-        if loop is not None and loop.is_running():
-            fut = asyncio.run_coroutine_threadsafe(self._shutdown(), loop)
+        # drain all loops CONCURRENTLY: each close is bounded by its own
+        # grace window, and serializing N of them would multiply shutdown
+        # latency by the loop count
+        pending = []
+        for ls in self._loopstates:
+            if ls.loop is not None and ls.loop.is_running():
+                pending.append(
+                    (ls, asyncio.run_coroutine_threadsafe(
+                        self._shutdown(ls), ls.loop
+                    ))
+                )
+        for ls, fut in pending:
             try:
                 fut.result(timeout=10)
             except Exception:  # pragma: no cover - defensive
                 pass
-            loop.call_soon_threadsafe(loop.stop)
-        if self._thread is not None:
-            self._thread.join(timeout=10)
+            ls.loop.call_soon_threadsafe(ls.loop.stop)
+        for ls in self._loopstates:
+            if ls.thread is not None:
+                ls.thread.join(timeout=10)
         self._pool.shutdown(wait=False)
+        if self._metric_bindings:
+            # drop OUR per-loop series now rather than waiting for GC: a
+            # closed server's stale series would mislabel loop counts (and
+            # ghost counter resets) on every later /metrics scrape. The
+            # exact-fn unbind leaves a newer server's same-label bindings
+            # untouched.
+            from oryx_tpu.common.metrics import get_registry
 
-    async def _shutdown(self) -> None:
-        if self._server is not None:
-            self._server.close()
+            c = get_registry().counter("oryx_http_loop_requests")
+            for reader, label in self._metric_bindings:
+                c.unbind_function(reader, loop=label)
+            self._metric_bindings = []
+
+    def join(self) -> None:
+        """Block until every loop thread exits (serving-layer
+        await_termination)."""
+        for ls in self._loopstates:
+            if ls.thread is not None:
+                ls.thread.join()
+
+    async def _shutdown(self, ls: _LoopState) -> None:
+        if ls.server is not None:
+            ls.server.close()
         # Drain BEFORE wait_closed(): python 3.12's Server.wait_closed
         # waits for all connection handlers, so waiting first silently
         # burned close()'s full timeout and abandoned tasks to die noisily
@@ -125,40 +292,42 @@ class AsyncHTTPServer:
             # connection registers only on its first step — checking
             # before yielding would miss it entirely
             await asyncio.sleep(0)
-            if not self._conns:
+            if not ls.conns:
                 break
             past_grace = loop.time() >= grace_until
-            for task, idle in list(self._conns.items()):
+            for task, idle in list(ls.conns.items()):
                 if past_grace or idle:
                     task.cancel()
-            await asyncio.wait(list(self._conns), timeout=0.25)
-        if self._server is not None:
-            await self._server.wait_closed()
+            await asyncio.wait(list(ls.conns), timeout=0.25)
+        if ls.server is not None:
+            await ls.server.wait_closed()
 
-    def _run_loop(self) -> None:
+    def _run_loop(self, ls: _LoopState) -> None:
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
-        self._loop = loop
+        ls.loop = loop
         try:
-            self._server = loop.run_until_complete(
+            ls.server = loop.run_until_complete(
                 asyncio.start_server(
-                    self._handle_conn,
+                    lambda r, w: self._handle_conn(ls, r, w),
                     "0.0.0.0",
                     self.port,
                     ssl=self._ssl,
                     backlog=1024,
-                    # lets N replica processes share one port, the kernel
-                    # load-balancing connections across them
-                    reuse_port=self._reuse_port or None,
+                    # one listener per loop (and/or per replica process)
+                    # on the same port; the kernel load-balances
+                    # connections across them
+                    reuse_port=self._want_reuse or None,
                 )
             )
-            self.port = self._server.sockets[0].getsockname()[1]
+            if ls.index == 0:
+                self.port = ls.server.sockets[0].getsockname()[1]
         except BaseException as e:  # surface bind errors to start()
-            self._start_error = e
-            self._started.set()
+            ls.error = e
+            ls.started.set()
             loop.close()
             return
-        self._started.set()
+        ls.started.set()
         try:
             loop.run_forever()
         finally:
@@ -168,12 +337,15 @@ class AsyncHTTPServer:
     # -- per-connection protocol ------------------------------------------
 
     async def _handle_conn(
-        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+        self,
+        ls: _LoopState,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
     ) -> None:
         task = asyncio.current_task()
         if task is not None:
-            self._conns[task] = True  # idle until a request head arrives
-            task.add_done_callback(lambda t: self._conns.pop(t, None))
+            ls.conns[task] = True  # idle until a request head arrives
+            task.add_done_callback(lambda t: ls.conns.pop(t, None))
         try:
             while True:
                 try:
@@ -193,12 +365,13 @@ class AsyncHTTPServer:
                     await self._simple_response(writer, 400, b"headers too large")
                     return
                 if task is not None:
-                    self._conns[task] = False  # request in flight
+                    ls.conns[task] = False  # request in flight
 
                 if head == b"PRI * HTTP/2.0\r\n\r\n":
                     # HTTP/2 with prior knowledge (also the path ALPN-
                     # negotiated h2-over-TLS arrives on): consume the
-                    # rest of the 24-byte preface and hand over
+                    # rest of the 24-byte preface and hand over; the h2
+                    # connection stays bound to THIS loop's state
                     from oryx_tpu.serving.http2 import Http2Connection
 
                     rest = await asyncio.wait_for(
@@ -206,7 +379,7 @@ class AsyncHTTPServer:
                     )
                     if rest != b"SM\r\n\r\n":
                         return
-                    await Http2Connection(self, reader, writer).run(
+                    await Http2Connection(self, reader, writer, owner=ls).run(
                         preface_read=True
                     )
                     return
@@ -289,6 +462,7 @@ class AsyncHTTPServer:
                     await Http2Connection(
                         self, reader, writer,
                         upgraded_request=(method, target, headers, body),
+                        owner=ls,
                     ).run(preface_read=False)
                     return
 
@@ -297,8 +471,9 @@ class AsyncHTTPServer:
                     and version_b != b"HTTP/1.0"
                 )
                 await self._handle_request(writer, method, target, headers, body)
+                ls.requests += 1
                 if task is not None:
-                    self._conns[task] = True  # parked between requests
+                    ls.conns[task] = True  # parked between requests
                 if not keep_alive:
                     return
         finally:
@@ -315,9 +490,9 @@ class AsyncHTTPServer:
         headers: dict[str, str],
         body: bytes,
     ) -> tuple[int, bytes, str, tuple[tuple[str, str], ...]]:
-        """Auth + gzip-decode + route dispatch, shared by the HTTP/1.1
-        loop and the HTTP/2 streams (serving/http2.py): returns (status,
-        payload, content-type, extra response headers)."""
+        """Auth + gzip-decode + route dispatch, shared by every loop's
+        HTTP/1.1 handler and the HTTP/2 streams (serving/http2.py):
+        returns (status, payload, content-type, extra response headers)."""
         if self.auth is not None:
             verdict = self.auth.check(method, target, headers.get("authorization"))
             if verdict is not True:
@@ -328,7 +503,7 @@ class AsyncHTTPServer:
                     (("WWW-Authenticate", verdict),),
                 )
 
-        split = urlsplit(target)
+        path, query = _split_target(target)
         if headers.get("content-encoding", "").lower() == "gzip" and body:
             try:
                 body = gzip.decompress(body)
@@ -336,15 +511,15 @@ class AsyncHTTPServer:
                 return 400, b"bad gzip body", "text/plain", ()
         req = Request(
             method=method,
-            path=split.path,
+            path=path,
             params={},
-            query=parse_qs(split.query),
+            query=query,
             body=body,
             headers=headers,
         )
         loop = asyncio.get_running_loop()
         try:
-            if self.app.is_fast(split.path):
+            if self.app.is_fast(path):
                 # every route under this segment is declared nonblocking
                 # (state lookups + submit_nowait only): dispatch inline on
                 # the event loop, skipping two thread hops per request
@@ -381,8 +556,12 @@ class AsyncHTTPServer:
         )
 
     # (status, ctype) -> precomputed header prefix; statuses and content
-    # types are a tiny closed set, so this never grows unbounded
+    # types are a tiny closed set, so this never grows unbounded.
+    # _clen_cache extends the same pattern to the length-dependent tail:
+    # rendered JSON responses cluster on a few dozen byte lengths, so the
+    # common response writes two cached byte strings and the payload.
     _prefix_cache: dict = {}
+    _clen_cache: dict = {}
 
     async def _write_response(
         self,
@@ -409,7 +588,13 @@ class AsyncHTTPServer:
             parts.append(b"\r\nContent-Encoding: gzip")
         for k, v in extra:
             parts.append(f"\r\n{k}: {v}".encode("latin-1"))
-        parts.append(f"\r\nContent-Length: {len(payload)}\r\n\r\n".encode("ascii"))
+        n = len(payload)
+        tail = self._clen_cache.get(n)
+        if tail is None:
+            tail = f"\r\nContent-Length: {n}\r\n\r\n".encode("ascii")
+            if n < 8192 and len(self._clen_cache) < 8192:
+                self._clen_cache[n] = tail
+        parts.append(tail)
         if method != "HEAD":
             parts.append(payload)
         writer.write(b"".join(parts))

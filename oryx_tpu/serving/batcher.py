@@ -263,6 +263,13 @@ class TopKBatcher:
             ("oryx_topk_coalesced",
              "requests coalesced into device dispatches",
              lambda: float(self.coalesced)),
+            ("oryx_topk_mean_batch",
+             "achieved mean coalesced batch size (coalesced/dispatches "
+             "over the process lifetime; >1 means requests are sharing "
+             "device dispatches)",
+             lambda: (
+                 self.coalesced / self.dispatches if self.dispatches else 0.0
+             )),
             ("oryx_topk_host_fallbacks",
              "requests scored on the host because the device was down",
              lambda: float(self.host_fallbacks)),

@@ -88,15 +88,30 @@ IDLE_READ_TIMEOUT = 75.0
 
 def decode_h2c_settings(value: str) -> bytes | None:
     """base64url HTTP2-Settings payload -> raw SETTINGS bytes, or None
-    when malformed (bad base64, or a length that is not a multiple of 6).
-    RFC 7540 §3.2.1: a malformed HTTP2-Settings header means a malformed
-    REQUEST — the h1 server must reject it (400) BEFORE sending 101
-    Switching Protocols, so this helper runs in the upgrade gate."""
+    when malformed (bad base64url, or a length that is not a multiple of
+    6). RFC 7540 §3.2.1: a malformed HTTP2-Settings header means a
+    malformed REQUEST — the h1 server must reject it (400) BEFORE sending
+    101 Switching Protocols, so this helper runs in the upgrade gate.
+
+    Strict on the alphabet: urlsafe_b64decode silently DISCARDS invalid
+    characters, so garbage whose surviving length happened to be a
+    multiple of 6 decoded to nonsense and was accepted. validate=True
+    rejects characters outside the translated alphabet, and the explicit
+    pre-check also rejects standard-alphabet '+'/'/' input (valid base64,
+    but NOT the base64url encoding §3.2.1 requires)."""
     import base64
     import binascii
+    import re
 
+    if re.fullmatch(r"[A-Za-z0-9_-]*={0,2}", value) is None:
+        return None
+    unpadded = value.rstrip("=")
     try:
-        raw = base64.urlsafe_b64decode(value + "=" * (-len(value) % 4))
+        raw = base64.b64decode(
+            unpadded + "=" * (-len(unpadded) % 4),
+            altchars=b"-_",
+            validate=True,
+        )
     except (ValueError, binascii.Error):
         return None
     return raw if len(raw) % 6 == 0 else None
@@ -129,12 +144,17 @@ class Http2Connection:
 
     def __init__(
         self,
-        server,  # AsyncHTTPServer (duck-typed: _process, _conns)
+        server,  # AsyncHTTPServer (duck-typed: _process)
         reader: asyncio.StreamReader,
         writer: asyncio.StreamWriter,
         upgraded_request: tuple[str, str, dict, bytes] | None = None,
+        owner=None,  # the _LoopState owning this connection's event loop
     ):
         self.server = server
+        # every stream task this connection spawns runs on the owning
+        # loop; its conns registry and request counter are that loop's —
+        # never another loop's — so loop-affine state stays loop-affine
+        self.owner = owner
         self.reader = reader
         self.writer = writer
         self.upgraded_request = upgraded_request
@@ -257,10 +277,14 @@ class Http2Connection:
 
     def _mark_busy(self, busy: bool) -> None:
         # graceful-shutdown bookkeeping shared with the H1 path: idle
-        # connections cancel immediately on drain, busy ones get grace
+        # connections cancel immediately on drain, busy ones get grace.
+        # The registry is the OWNING loop's — a multi-loop frontend drains
+        # each loop's connections from that loop's own shutdown sweep.
+        if self.owner is None:
+            return
         task = asyncio.current_task()
-        conns = getattr(self.server, "_conns", None)
-        if conns is not None and task in conns:
+        conns = self.owner.conns
+        if task in conns:
             conns[task] = not busy
 
     # -- receive path ------------------------------------------------------
@@ -482,6 +506,8 @@ class Http2Connection:
             await self._respond(
                 st, status, payload, ctype, method, gzip_ok, extra
             )
+            if self.owner is not None:
+                self.owner.requests += 1  # h2 streams count as requests
         except asyncio.CancelledError:
             raise
         except Exception:  # pragma: no cover - defensive

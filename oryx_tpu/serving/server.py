@@ -187,6 +187,15 @@ class ServingLayer:
         if frontend == "async":
             from oryx_tpu.serving.aserver import AsyncHTTPServer
 
+            # event-loop fan-out: 0 = auto (one loop per CPU core). All
+            # loops share THIS app/model/batcher — the in-process
+            # alternative to `processes`, which duplicates model state
+            # per replica.
+            loops = self.config.get_int("oryx.serving.api.loops", 0)
+            if loops <= 0:
+                import os
+
+                loops = os.cpu_count() or 1
             self._aio_server = AsyncHTTPServer(
                 self.app,
                 auth,
@@ -194,6 +203,7 @@ class ServingLayer:
                 ssl_context=ctx,
                 workers=self.config.get_int("oryx.serving.api.workers", 128),
                 reuse_port=self.config.get_int("oryx.serving.api.processes", 1) > 1,
+                loops=loops,
             )
             self._aio_server.start()
             self.port = self._aio_server.port
@@ -225,11 +235,17 @@ class ServingLayer:
                 target=self._httpd.serve_forever, name="oryx-serving-http", daemon=True
             )
             self._http_thread.start()
-        log.info("serving layer listening on :%d (%s)", self.port, frontend)
+        if self._aio_server is not None:
+            log.info(
+                "serving layer listening on :%d (async, %d event loops)",
+                self.port, len(self._aio_server._loopstates),
+            )
+        else:
+            log.info("serving layer listening on :%d (%s)", self.port, frontend)
 
     def await_termination(self) -> None:
-        if self._aio_server and self._aio_server._thread:
-            self._aio_server._thread.join()
+        if self._aio_server:
+            self._aio_server.join()
         if self._http_thread:
             self._http_thread.join()
 

@@ -429,6 +429,203 @@ def test_wedge_failover_under_concurrent_http_load(monkeypatch):
                 TopKBatcher._shared = None
 
 
+def test_multi_loop_frontend_serves_on_every_loop():
+    """loops=4: four SO_REUSEPORT event loops share one port and ONE app;
+    under many short-lived connections the kernel spreads traffic so every
+    loop serves requests, responses stay correct, and the per-loop
+    counters surface in /metrics."""
+    import re
+
+    bus = "mem://aserver-loops"
+    _setup_bus(bus)
+    cfg = _config(bus, "async", **{"oryx.serving.api.loops": 4})
+    with ServingLayer(cfg) as sl:
+        _wait_ready(sl.port)
+        states = sl._aio_server._loopstates
+        assert len(states) == 4
+        errs: list[str] = []
+
+        def worker():
+            try:
+                # fresh connection per request: each new 4-tuple re-rolls
+                # the kernel's reuseport balancing, spreading load across
+                # loops (128 connections over 4 loops never miss one)
+                for _ in range(8):
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", sl.port, timeout=10
+                    )
+                    conn.request("GET", "/distinct/word")
+                    r = conn.getresponse()
+                    body = r.read()
+                    conn.close()
+                    if r.status != 200 or json.loads(body) != 2:
+                        errs.append(f"bad response {r.status} {body[:80]!r}")
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        threads = [threading.Thread(target=worker) for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs, errs[:5]
+        assert all(ls.requests > 0 for ls in states), [
+            ls.requests for ls in states
+        ]
+        # the same counters are scrapeable: oryx_http_loop_requests{loop=i}
+        conn = http.client.HTTPConnection("127.0.0.1", sl.port, timeout=5)
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        conn.close()
+        series = dict(
+            re.findall(r'oryx_http_loop_requests\{loop="(\d)"\} (\d+)', text)
+        )
+        for i in range(4):
+            assert int(series[str(i)]) > 0, series
+
+
+def test_multi_loop_cross_loop_coalescing(monkeypatch):
+    """Requests arriving on DIFFERENT event loops must coalesce into
+    shared device dispatches: under concurrent /recommend load the
+    process-wide batcher's dispatch count stays below its coalesced
+    request count (mean batch > 1), and more than one loop carried
+    traffic — coalescing across loops, not just port sharding."""
+    import time as _time
+
+    import numpy as np
+
+    import oryx_tpu.ops.als as als_mod
+    from oryx_tpu.apps.als.serving import ALSServingModel, ALSServingModelManager
+    from oryx_tpu.apps.als.state import ALSState
+    from oryx_tpu.bus.broker import topics
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.serving.batcher import TopKBatcher
+
+    rng = np.random.default_rng(0)
+    state = ALSState(8, implicit=True)
+    state.y.bulk_set(
+        [f"i{j}" for j in range(500)],
+        rng.standard_normal((500, 8), dtype=np.float32),
+    )
+    state.x.bulk_set(
+        [f"u{j}" for j in range(32)],
+        rng.standard_normal((32, 8), dtype=np.float32),
+    )
+    state.set_expected(state.x.ids(), state.y.ids())
+    cfg = load_config(overlay={
+        "oryx.id": "xloop",
+        "oryx.input-topic.broker": "mem://xloop",
+        "oryx.update-topic.broker": "mem://xloop",
+        "oryx.serving.api.port": 0,
+        "oryx.serving.api.read-only": True,
+        "oryx.serving.api.loops": 4,
+        "oryx.serving.application-resources": [
+            "oryx_tpu.serving.resources.common",
+            "oryx_tpu.serving.resources.als",
+        ],
+    })
+    topics.maybe_create("mem://xloop", "OryxUpdate", partitions=1)
+    mgr = ALSServingModelManager(cfg)
+    mgr.model = ALSServingModel(state, sample_rate=1.0)
+
+    # hold each device dispatch briefly so concurrent arrivals pile into
+    # the NEXT batch deterministically (the batcher's natural backpressure,
+    # made test-stable on a fast CPU where dispatches are sub-ms)
+    real = als_mod.topk_dot_batch
+
+    def slowed(*a, **k):
+        _time.sleep(0.01)
+        return real(*a, **k)
+
+    monkeypatch.setattr(als_mod, "topk_dot_batch", slowed)
+    b = TopKBatcher.shared()
+    d0, c0 = b.dispatches, b.coalesced
+
+    with ServingLayer(cfg, model_manager=mgr) as sl:
+        results: list = [None] * 32
+        # each client keeps ONE keep-alive connection, so the 32
+        # connections land on distinct loops via the kernel's balancing
+        def client(i):
+            conn = http.client.HTTPConnection("127.0.0.1", sl.port, timeout=60)
+            ok = True
+            for _ in range(4):
+                conn.request("GET", f"/recommend/u{i}?howMany=5")
+                r = conn.getresponse()
+                body = r.read()
+                ok = ok and r.status == 200 and len(json.loads(body)) == 5
+            conn.close()
+            results[i] = ok
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert all(results), results
+        served = [ls.requests for ls in sl._aio_server._loopstates]
+
+    coalesced = b.coalesced - c0
+    dispatches = b.dispatches - d0
+    assert coalesced == 128
+    assert dispatches < coalesced, (dispatches, coalesced)
+    assert sum(1 for n in served if n > 0) >= 2, served
+
+
+def test_multi_loop_close_drains_every_loop():
+    """close() on a multi-loop server must drain EVERY loop's parked
+    keep-alive connections and stop every loop thread — not just loop 0's."""
+    import time as _time
+
+    from oryx_tpu.api import ServingModelManager
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.serving.app import ServingApp
+    from oryx_tpu.serving.aserver import AsyncHTTPServer
+
+    class Manager(ServingModelManager):
+        def __init__(self, config):
+            self.config = config
+
+        def consume(self, it):
+            pass
+
+        def get_model(self):
+            return None
+
+    cfg = load_config(overlay={
+        "oryx.serving.application-resources": ["oryx_tpu.serving.resources.common"],
+    })
+    srv = AsyncHTTPServer(ServingApp(cfg, Manager(cfg)), None, 0, loops=3)
+    srv.start()
+    assert len(srv._loopstates) == 3
+    conns = []
+    try:
+        for _ in range(12):
+            c = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+            c.request("GET", "/metrics")
+            c.getresponse().read()  # keep-alive: stays parked on its loop
+            conns.append(c)
+        deadline = _time.time() + 5
+        while len(srv._conns) < 12 and _time.time() < deadline:
+            _time.sleep(0.02)
+        assert len(srv._conns) == 12, "connection tasks never registered"
+        t0 = _time.time()
+    finally:
+        srv.close()
+    assert _time.time() - t0 < 4, "close() hung on parked connections"
+    for ls in srv._loopstates:
+        assert not ls.conns, f"loop {ls.index} leaked connection tasks"
+        assert not ls.thread.is_alive(), f"loop {ls.index} thread survived close()"
+    # close() must unbind its per-loop /metrics series immediately (not
+    # wait for GC): stale series from a closed server would mislabel
+    # loop counts on every later scrape — even while `srv` stays alive
+    from oryx_tpu.common.metrics import get_registry
+
+    text = get_registry().render_prometheus()
+    assert 'oryx_http_loop_requests{loop=' not in text, text[:500]
+    for c in conns:
+        c.close()
+
+
 def test_context_path_mounts_the_app():
     """oryx.serving.api.context-path prefixes every route (the reference's
     Tomcat context path); requests outside the prefix 404."""

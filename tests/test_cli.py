@@ -218,6 +218,64 @@ def test_loadtest_http2(tmp_path):
     assert report["latency_ms"]["p50"] > 0
 
 
+def test_loadtest_multiloop_smoke(tmp_path):
+    """Tier-1 frontend-throughput smoke: an unpaced ~2s loadtest against
+    an in-process MULTI-LOOP server must push real traffic with zero
+    errors, and the report's post-run /metrics scrape must show more than
+    one event loop carrying it — a cheap canary so frontend-throughput
+    regressions fail here instead of only in bench.py."""
+    import io
+    import json as _json
+    from contextlib import redirect_stdout
+
+    from oryx_tpu.bus.broker import get_broker
+    from oryx_tpu.cli import main as cli_main
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.serving.server import ServingLayer
+
+    bus = "mem://cliltml"
+    broker = get_broker(bus)
+    for t in ("OryxInput", "OryxUpdate"):
+        if not broker.topic_exists(t):
+            broker.create_topic(t, 1)
+    broker.send("OryxUpdate", "MODEL", _json.dumps({"word": 7}))
+    cfg = load_config(overlay={
+        "oryx.input-topic.broker": bus,
+        "oryx.update-topic.broker": bus,
+        "oryx.serving.api.port": 0,
+        "oryx.serving.api.loops": 4,
+        "oryx.serving.model-manager-class": "oryx_tpu.apps.example.serving.ExampleServingModelManager",
+        "oryx.serving.application-resources": [
+            "oryx_tpu.serving.resources.common",
+            "oryx_tpu.serving.resources.example",
+        ],
+    })
+    paths = tmp_path / "paths.txt"
+    paths.write_text("/distinct/word\n/ready\n")
+    with ServingLayer(cfg) as sl:
+        time.sleep(0.3)
+        out = io.StringIO()
+        with redirect_stdout(out):
+            rc = cli_main([
+                "loadtest",
+                "--url", f"http://127.0.0.1:{sl.port}",
+                "--paths", str(paths),
+                "--duration", "2",
+                "--workers", "8",
+            ])
+    assert rc == 0
+    report = _json.loads(out.getvalue().strip().splitlines()[-1])
+    assert report["errors"] == 0
+    # unpaced on loopback: anything below this floor is a real frontend
+    # regression, not CI noise (the in-process client shares the GIL with
+    # the server, so the floor is far below the external-client ceiling)
+    assert report["requests"] > 150, report
+    srv = report.get("server")
+    assert srv is not None, "loadtest never scraped the server's /metrics"
+    assert srv["loops"] == 4
+    assert srv["loops_serving"] >= 2, srv
+
+
 def test_serving_replicas_share_port(tmp_path):
     """oryx.serving.api.processes=2: the CLI supervises two full serving
     replicas on ONE port via SO_REUSEPORT over a file:// broker; requests

@@ -351,6 +351,37 @@ def test_h2c_malformed_settings_rejected_before_101():
             assert b"400" in status, status
 
 
+def test_decode_h2c_settings_strict_base64url():
+    """decode_h2c_settings must reject anything outside the base64url
+    alphabet (ADVICE.md round 5): urlsafe_b64decode silently DISCARDED
+    invalid characters, so garbage whose surviving length happened to be
+    a multiple of 6 bytes decoded to nonsense and was accepted — and
+    standard-alphabet '+'/'/' input is valid base64 but not the base64url
+    encoding RFC 7540 §3.2.1 requires."""
+    import base64
+    import struct
+
+    from oryx_tpu.serving.http2 import decode_h2c_settings
+
+    one_setting = struct.pack(">HI", 0x4, 65535)
+    good = base64.urlsafe_b64encode(one_setting).decode().rstrip("=")
+    assert decode_h2c_settings(good) == one_setting
+    assert decode_h2c_settings("") == b""  # empty SETTINGS is legal
+
+    # invalid characters interleaved with an otherwise-valid payload:
+    # the old decoder dropped them and accepted the remainder
+    assert decode_h2c_settings("!" + good) is None
+    assert decode_h2c_settings(good[:4] + "\n" + good[4:]) is None
+    # standard-alphabet base64 of the same bytes (only when it actually
+    # differs from base64url): must be rejected as non-base64url
+    payload = struct.pack(">HI", 0x4, 0x3EFBFBFF)  # encodes with '+/'
+    std = base64.b64encode(payload).decode().rstrip("=")
+    assert ("+" in std) or ("/" in std)
+    assert decode_h2c_settings(std) is None
+    # misplaced padding
+    assert decode_h2c_settings("AA=A") is None
+
+
 def test_h2c_upgrade_applies_http2_settings_header():
     """RFC 7540 §3.2.1: the HTTP2-Settings upgrade header IS the client's
     initial SETTINGS. A client advertising INITIAL_WINDOW_SIZE=8 must not

@@ -69,6 +69,49 @@ def test_pod_group_submesh_single_process_falls_back():
     assert pod_group_submesh(mesh, 2) is None
 
 
+def test_pod_group_submesh_partial_process_set_falls_back(monkeypatch):
+    """ADVICE.md round 5: a custom training_mesh whose rows cover only a
+    SUBSET of the pod's processes must send EVERY member down the serial
+    fallback — partitioning while one member (not in the mesh) returns
+    None would diverge control flow across the pod and wedge its
+    collectives. The guard is pod-global: procs must equal
+    range(process_count()) exactly."""
+    from types import SimpleNamespace
+
+    import jax
+
+    import oryx_tpu.parallel.submesh as sm
+
+    class FakeDev:
+        def __init__(self, proc):
+            self.process_index = proc
+
+    def fake_mesh(owners):
+        devs = np.array([[FakeDev(p)] for p in owners], dtype=object)
+        return SimpleNamespace(devices=devs)
+
+    # the fallback path never constructs a Mesh; the positive control
+    # does, so stub the constructor (fake devices aren't jax Devices)
+    monkeypatch.setattr(
+        sm, "Mesh", lambda devs, axes: ("submesh", devs.shape)
+    )
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+
+    # mesh rows owned by processes {0, 1} in a THREE-process pod: the
+    # excluded member (2) could never enter the parallel search, so all
+    # members must serially fall back together
+    monkeypatch.setattr(jax, "process_count", lambda: 3)
+    assert sm.pod_group_submesh(fake_mesh([0, 0, 1, 1]), 2) is None
+
+    # same mesh in a two-process pod covers every process: partitions
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    res = sm.pod_group_submesh(fake_mesh([0, 0, 1, 1]), 2)
+    assert res is not None
+    my_group, groups, sub = res
+    assert my_group == 1 and groups == [[0], [1]]
+    assert sub == ("submesh", (2, 1))
+
+
 def test_candidate_mesh_is_thread_local():
     import jax
 

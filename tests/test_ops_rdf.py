@@ -159,6 +159,14 @@ def test_resolve_mtry_strategies():
     assert resolve_mtry("onethird", 54, True) == 18
     assert resolve_mtry(14, 54, True) == 14
     assert resolve_mtry("14", 54, True) == 14
+    # MLlib parity (ADVICE.md round 5): "auto" for a SINGLE tree resolves
+    # to "all" (no inter-tree decorrelation to buy), and "onethird" is
+    # ceil(P/3), not floor
+    assert resolve_mtry("auto", 54, True, num_trees=1) == 54
+    assert resolve_mtry("auto", 54, False, num_trees=1) == 54
+    assert resolve_mtry("auto", 54, True, num_trees=20) == 7
+    assert resolve_mtry("onethird", 10, True) == 4   # ceil(10/3)
+    assert resolve_mtry("auto", 10, False) == 4      # regression auto = ceil too
     with pytest.raises(ValueError):
         resolve_mtry(0, 54, True)
     with pytest.raises(ValueError):
