@@ -515,10 +515,53 @@ def _bench_http_body(sample_rate: float = 1.0) -> None:
         port, phase2_warm, duration
     )
 
-    def pctl(q: float) -> float:
-        if not all_lat_ms:
+    # Phase 2b — per-stage latency attribution: a SHORT separate window
+    # with span tracing on (common/tracing.py), so queue-wait vs device
+    # time vs HTTP tier each get their own p50/p99 in the report while the
+    # primary qps window above stays untraced (tracing default-off must
+    # not color the headline number).
+    def _pctl_of(vals, q: float) -> float:
+        """Nearest-rank percentile of a sorted list (the one convention
+        for both the latency report and the stage breakdown)."""
+        if not vals:
             return 0.0
-        return all_lat_ms[min(len(all_lat_ms) - 1, int(q * len(all_lat_ms)))]
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    stage_breakdown = None
+    if not lsh:
+        from oryx_tpu.common.tracing import get_tracer
+
+        tracer = get_tracer()
+        prev_enabled, prev_capacity = tracer.enabled, tracer.capacity
+        tracer.configure(enabled=True, capacity=65536)
+        try:
+            _drive(port, 0.5, 3.0)
+            stage_spans = tracer.snapshot()
+        finally:
+            # restore the PRE-PHASE state (a user-configured tracer must
+            # survive this side window) — shrinking the ring also frees
+            # the 65536 pinned Span objects for the remaining phases
+            tracer.configure(enabled=prev_enabled, capacity=prev_capacity)
+        by_stage: dict[str, list[float]] = {}
+        for s in stage_spans:
+            by_stage.setdefault(s.name, []).append(s.duration * 1000.0)
+        stage_breakdown = {}
+        for name, key_out in (
+            ("http.request", "request"),
+            ("http.dispatch", "dispatch"),
+            ("batcher.queue_wait", "queue_wait"),
+            ("batcher.device", "device"),
+        ):
+            vals = sorted(by_stage.get(name, ()))
+            if vals:
+                stage_breakdown[key_out] = {
+                    "p50": round(_pctl_of(vals, 0.50), 2),
+                    "p99": round(_pctl_of(vals, 0.99), 2),
+                    "n": len(vals),
+                }
+
+    def pctl(q: float) -> float:
+        return _pctl_of(all_lat_ms, q)
     dt = duration
     qps = total / dt
     # model memory at this scale, against the reference's heap table
@@ -616,6 +659,10 @@ def _bench_http_body(sample_rate: float = 1.0) -> None:
         out["http_tier_efficiency"] = (
             round(tier_efficiency, 3) if tier_efficiency else None
         )
+        if stage_breakdown:
+            # where request latency goes (traced side-window, ms): HTTP
+            # request total, dispatch, batcher queue-wait, device time
+            out["stage_latency_ms"] = stage_breakdown
         if qps_single is not None:
             # frontend fan-out effect, same run, same model, same clients:
             # multi-loop (the primary number above) vs one event loop

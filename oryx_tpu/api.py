@@ -28,6 +28,34 @@ from oryx_tpu.common.config import Config
 _log = logging.getLogger(__name__)
 
 
+def _note_model_freshness(
+    key: str | None,
+    loaded: bool,
+    parked: bool = False,
+    message: str | None = None,
+) -> None:
+    """Feed the model-freshness tracker (common/freshness.py) after a
+    MODEL/MODEL-REF dispatch — no-op for other keys, and NEVER lets its
+    own failure (e.g. a metric registration collision at tracker
+    construction) escape into the update-listener thread, which must
+    survive anything per _dispatch_update's isolation contract. `parked`
+    marks a MODEL-REF awaiting a late artifact: its stamp is held for the
+    re-dispatched load instead of dropped."""
+    if key not in ("MODEL", "MODEL-REF"):
+        return
+    try:
+        from oryx_tpu.common.freshness import model_freshness
+
+        if loaded:
+            model_freshness().note_loaded(key, message=message)
+        else:
+            # the model did NOT load: its stamp must not claim an earlier
+            # successful load (but a parked one may be claimed later)
+            model_freshness().note_load_failed(parked=parked, message=message)
+    except Exception:  # pragma: no cover - defensive
+        _log.exception("model freshness hook failed")
+
+
 def _dispatch_update(handler, km: KeyMessage) -> None:
     """Per-message dispatch with error isolation: a poison message must not
     kill the listener thread (it replays from earliest on restart and would
@@ -49,10 +77,23 @@ def _dispatch_update(handler, km: KeyMessage) -> None:
         except Exception:
             _log.exception("ignoring bad MODEL-CHUNK message")
         return
+    if km.key == "TRACE":
+        # framework-level publish stamp (common/freshness.py): follows its
+        # MODEL/MODEL-REF on the update topic and feeds the
+        # oryx_update_to_serve_seconds / oryx_model_staleness_seconds
+        # metrics; app handlers never see it
+        from oryx_tpu.common.freshness import model_freshness
+
+        try:
+            model_freshness().note_stamp(km.message)
+        except Exception:
+            _log.exception("ignoring bad TRACE publish stamp")
+        return
     retries = 3 if km.key in ("MODEL", "MODEL-REF") else 0
     for attempt in range(retries + 1):
         try:
             handler(km.key, km.message)
+            _note_model_freshness(km.key, loaded=True, message=km.message)
             return
         except OSError:
             if attempt < retries:
@@ -88,7 +129,11 @@ def _dispatch_update(handler, km: KeyMessage) -> None:
                     _log.exception(
                         "giving up on update message (key=%r)", km.key
                     )
+                _note_model_freshness(
+                    km.key, loaded=False, parked=parked, message=km.message,
+                )
         except Exception:
+            _note_model_freshness(km.key, loaded=False, message=km.message)
             _log.exception("ignoring bad update message (key=%r)", km.key)
             return
 

@@ -101,6 +101,12 @@ def _parse_args(argv):
         "oryx.serving.api.loops; 0 = one per CPU core)",
     )
     p.add_argument(
+        "--trace", action="store_true",
+        help="enable request/generation span tracing "
+        "(oryx.monitoring.tracing.enabled=true); inspect recorded spans "
+        "at GET /debug/traces on the serving layer",
+    )
+    p.add_argument(
         "--pmml",
         help="PMML file to import (import-pmml): published to the update "
         "topic as a MODEL so running speed/serving layers pick it up",
@@ -935,6 +941,9 @@ def main(argv=None) -> int:
         # plain config sugar: rides args.set so replica children and pod
         # spawns inherit it like any other override
         args.set.append(f"oryx.serving.api.loops={args.loops}")
+    if args.trace:
+        # same sugar: tracing propagates to replica/pod children via --set
+        args.set.append("oryx.monitoring.tracing.enabled=true")
     config = _build_config(args)
     _apply_platform_env(config)
     seed = config.get("oryx.test.seed", None)

@@ -54,7 +54,15 @@ def _fmt_labels(key: tuple[tuple[str, str], ...]) -> str:
 
 
 def _escape(v: str) -> str:
+    """Label-VALUE escaping: backslash, double quote, newline."""
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    """# HELP text escaping: the text format allows ONLY \\\\ and \\n here —
+    escaping quotes (as label values must) would itself be an invalid
+    escape sequence and corrupt the whole exposition."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt_value(v: float) -> str:
@@ -79,9 +87,13 @@ class Counter:
 
     kind = "counter"
 
-    def __init__(self, name: str, help: str):
+    def __init__(self, name: str, help: str, labeled: bool = False):
         self.name = name
         self.help = help
+        # labeled=True declares every series carries labels: with zero
+        # series the metric then renders no sample at all instead of a
+        # bogus unlabeled `name 0`
+        self.labeled = labeled
         self._values: dict[tuple, float] = {}
         self._fns: dict[tuple, object] = {}
         self._lock = threading.Lock()
@@ -106,18 +118,25 @@ class Counter:
 
     def value(self, **labels: str) -> float:
         key = _label_key(labels)
-        fn = self._fns.get(key)
+        # snapshot under the lock (like render): an unlocked dict read can
+        # race a concurrent first-insert resize and miss/see-torn state
+        with self._lock:
+            fn = self._fns.get(key)
+            v = self._values.get(key, 0.0)
         if fn is not None:
-            return float(fn())
-        return self._values.get(key, 0.0)
+            return float(fn())  # outside the lock: callables may be slow
+        return v
 
     def render(self) -> list[str]:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        lines = [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} counter",
+        ]
         with self._lock:
             keys = sorted(set(self._values) | set(self._fns))
             snapshot = dict(self._values)
             fns = dict(self._fns)
-        if not keys:
+        if not keys and not self.labeled:
             lines.append(f"{self.name} 0")
         for key in keys:
             fn = fns.get(key)
@@ -126,7 +145,12 @@ class Counter:
                     v = float(fn())
                 except GaugeSeriesGone:
                     with self._lock:
-                        self._fns.pop(key, None)
+                        # identity-conditioned like unbind_function: a NEW
+                        # owner may have re-bound these labels since the
+                        # snapshot, and its fresh series must survive the
+                        # dead reader's eviction
+                        if self._fns.get(key) is fn:
+                            self._fns.pop(key, None)
                     continue
                 except Exception:
                     # transient callback failure: skip this scrape only
@@ -143,9 +167,10 @@ class Gauge:
 
     kind = "gauge"
 
-    def __init__(self, name: str, help: str):
+    def __init__(self, name: str, help: str, labeled: bool = False):
         self.name = name
         self.help = help
+        self.labeled = labeled  # see Counter: suppress the zero-series sample
         self._values: dict[tuple, float] = {}
         self._fns: dict[tuple, object] = {}
         self._lock = threading.Lock()
@@ -168,18 +193,23 @@ class Gauge:
 
     def value(self, **labels: str) -> float:
         key = _label_key(labels)
-        fn = self._fns.get(key)
+        with self._lock:  # snapshot like render(); see Counter.value
+            fn = self._fns.get(key)
+            v = self._values.get(key, 0.0)
         if fn is not None:
             return float(fn())
-        return self._values.get(key, 0.0)
+        return v
 
     def render(self) -> list[str]:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        lines = [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} gauge",
+        ]
         with self._lock:
             keys = sorted(set(self._values) | set(self._fns))
             snapshot = dict(self._values)
             fns = dict(self._fns)
-        if not keys:
+        if not keys and not self.labeled:
             lines.append(f"{self.name} 0")
         for key in keys:
             fn = fns.get(key)
@@ -188,7 +218,12 @@ class Gauge:
                     v = float(fn())
                 except GaugeSeriesGone:
                     with self._lock:
-                        self._fns.pop(key, None)
+                        # identity-conditioned like unbind_function: a NEW
+                        # owner may have re-bound these labels since the
+                        # snapshot, and its fresh series must survive the
+                        # dead reader's eviction
+                        if self._fns.get(key) is fn:
+                            self._fns.pop(key, None)
                     continue
                 except Exception:
                     # transient callback failure: skip this scrape only
@@ -233,13 +268,18 @@ class Histogram:
             self.observe(time.monotonic() - start, **labels)
 
     def count(self, **labels: str) -> int:
-        return self._totals.get(_label_key(labels), 0)
+        with self._lock:  # snapshot like render(); see Counter.value
+            return self._totals.get(_label_key(labels), 0)
 
     def sum(self, **labels: str) -> float:
-        return self._sums.get(_label_key(labels), 0.0)
+        with self._lock:
+            return self._sums.get(_label_key(labels), 0.0)
 
     def render(self) -> list[str]:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        lines = [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} histogram",
+        ]
         with self._lock:
             items = sorted(self._totals)
             counts = {k: list(v) for k, v in self._counts.items()}
@@ -277,11 +317,11 @@ class MetricsRegistry:
             self._metrics[name] = m
             return m
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_create(Counter, name, help)
+    def counter(self, name: str, help: str = "", labeled: bool = False) -> Counter:
+        return self._get_or_create(Counter, name, help, labeled=labeled)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get_or_create(Gauge, name, help)
+    def gauge(self, name: str, help: str = "", labeled: bool = False) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labeled=labeled)
 
     def histogram(
         self, name: str, help: str = "", buckets: tuple[float, ...] = DEFAULT_BUCKETS

@@ -23,6 +23,7 @@ from oryx_tpu.common.classutil import load_instance_of
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.ioutil import delete_older_than, strip_scheme
 from oryx_tpu.common.metrics import GENERATION_BUCKETS, get_registry, maybe_profile
+from oryx_tpu.common.tracing import configure_tracing, get_tracer, swap_current
 from oryx_tpu.layers.datastore import load_all_data, save_generation
 from oryx_tpu.layers.watchdog import running_seconds, start_wedge_watchdog
 
@@ -101,6 +102,7 @@ class BatchLayer:
         self._watchdog: threading.Thread | None = None
         self._consumer: ConsumeDataIterator | None = None
         self.generation_count = 0
+        configure_tracing(config)
         self._profile_dir = config.get_string("oryx.monitoring.profile-dir", None)
         reg = get_registry()
         self._m_generations = reg.counter(
@@ -221,26 +223,52 @@ class BatchLayer:
             self.ensure_streams()
         ts = timestamp_ms if timestamp_ms is not None else int(time.time() * 1000)
         ts, up_to = self._pod_window(ts)
+        tr = get_tracer()
+        t_ingest = time.monotonic() if tr.enabled else 0.0
         new_data = self._consumer.poll_available(up_to=up_to)
         past_data = load_all_data(self.data_dir)
+        root = None
         if new_data or past_data:
+            # per-generation span tree: ingest -> build -> persist. The
+            # build span is installed as the thread-current span so
+            # MLUpdate's publish stamp carries this generation's trace
+            # context onto the update topic (common/freshness.py).
+            root = tr.start(
+                "batch.generation", start=t_ingest or None, generation=ts,
+                new_records=len(new_data), past_records=len(past_data),
+            )
+            if root is not None and t_ingest:
+                tr.record_interval("batch.ingest", t_ingest, parent=root)
             self._gen_started = time.monotonic()
             try:
-                with self._m_duration.time(), maybe_profile(self._profile_dir, "batch-gen"):
-                    self.update.run_update(
-                        ts, new_data, past_data, self.model_dir, self._producer
-                    )
+                t_build = time.monotonic()
+                prev = swap_current(root) if root is not None else None
+                try:
+                    with self._m_duration.time(), maybe_profile(self._profile_dir, "batch-gen"):
+                        self.update.run_update(
+                            ts, new_data, past_data, self.model_dir, self._producer
+                        )
+                finally:
+                    if root is not None:
+                        swap_current(prev)
+                        tr.record_interval("batch.build", t_build, parent=root)
             except Exception:
                 # a failed build must not lose the window: persist + commit
                 # still run, and the next generation retries over history
                 log.exception("model build failed at generation %d", ts)
                 self._m_failures.inc()
+                if root is not None:
+                    root.attrs["error"] = True
             finally:
                 self._gen_started = None
         else:
             log.info("generation %d: no data yet", ts)
+        t_persist = time.monotonic() if root is not None else 0.0
         save_generation(self.data_dir, ts, new_data)
         self._consumer.commit()
+        if root is not None:
+            tr.record_interval("batch.persist", t_persist, parent=root)
+            tr.finish(root)
         delete_older_than(self.data_dir, self.max_age_data)
         delete_older_than(self.model_dir, self.max_age_model)
         self.generation_count += 1

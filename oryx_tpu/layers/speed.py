@@ -22,6 +22,7 @@ from oryx_tpu.bus.broker import get_broker
 from oryx_tpu.common.classutil import load_instance_of
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.metrics import MICROBATCH_BUCKETS, get_registry
+from oryx_tpu.common.tracing import configure_tracing, get_tracer
 from oryx_tpu.layers.watchdog import running_seconds, start_wedge_watchdog
 
 log = logging.getLogger(__name__)
@@ -49,6 +50,7 @@ class SpeedLayer:
         self._input_consumer: ConsumeDataIterator | None = None
         self._update_consumer: ConsumeDataIterator | None = None
         self.batch_count = 0
+        configure_tracing(config)
         reg = get_registry()
         self._m_batches = reg.counter(
             "oryx_speed_batches_total", "Completed speed micro-batches"
@@ -111,21 +113,39 @@ class SpeedLayer:
         the consumer rewinds to the committed offsets and reprocesses."""
         if self._input_consumer is None:
             self.ensure_streams()
+        tr = get_tracer()
+        t_ingest = time.monotonic() if tr.enabled else 0.0
         window_start = self._input_consumer.positions()
         batch = self._input_consumer.poll_available()
         if batch:
+            # per-generation span tree: ingest -> build -> publish, so a
+            # slow micro-batch shows WHERE the interval went (tf.data-style
+            # stage attribution; empty polls record nothing)
+            root = tr.start(
+                "speed.batch", start=t_ingest or None, records=len(batch),
+            )
+            if root is not None and t_ingest:
+                tr.record_interval("speed.ingest", t_ingest, parent=root)
             self._batch_started = time.monotonic()
             try:
+                t_build = time.monotonic()
                 with self._m_duration.time():
                     updates = list(self.manager.build_updates(batch))
+                if root is not None:
+                    tr.record_interval("speed.build", t_build, parent=root)
+                t_pub = time.monotonic()
                 if updates:
                     self._producer.send_batch(updates)
+                if root is not None:
+                    tr.record_interval("speed.publish", t_pub, parent=root)
                 self._m_updates.inc(len(updates))
+                tr.finish(root, updates=len(updates))
             except Exception:
                 # rewind to where this window began (NOT the committed
                 # offsets — on a fresh group those fall back to the log end,
                 # which would silently drop the failed window)
                 log.exception("speed update build failed; window will be reprocessed")
+                tr.finish(root, error=True)
                 self._input_consumer.seek(window_start)
                 self.batch_count += 1
                 return len(batch)

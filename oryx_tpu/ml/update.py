@@ -383,6 +383,7 @@ class MLUpdate(BatchLayerUpdate):
         filesystem (common/artifact.py ArtifactRelay; the reference leans
         on a shared Hadoop FileSystem instead, AppPMMLUtils.java:261-275)."""
         from oryx_tpu.common.artifact import publish_model_ref
+        from oryx_tpu.common.freshness import publish_stamp
 
         serialized = model.to_string()
         if len(serialized.encode("utf-8")) <= self.max_message_size:
@@ -392,3 +393,13 @@ class MLUpdate(BatchLayerUpdate):
                 producer, serialized, model_path, self.max_message_size,
                 transfer=self.artifact_transfer,
             )
+        # publish-time stamp AFTER the model message (app-visible record
+        # order is unchanged; consumers claim the stamp for the model that
+        # just loaded): feeds oryx_update_to_serve_seconds and
+        # oryx_model_staleness_seconds on every consuming tier, and
+        # carries the batch generation's trace context when tracing is on
+        try:
+            generation = int(Path(model_path).name)
+        except (TypeError, ValueError):
+            generation = None
+        producer.send("TRACE", publish_stamp(generation=generation))

@@ -24,6 +24,7 @@ from oryx_tpu.api import ServingModelManager
 from oryx_tpu.bus.api import TopicProducer
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.metrics import GaugeSeriesGone, get_registry
+from oryx_tpu.common.tracing import configure_tracing, swap_current
 
 
 @dataclass
@@ -113,6 +114,10 @@ class Request:
     query: dict[str, list[str]]
     body: bytes
     headers: dict[str, str]
+    # the request's tracing span (common/tracing.py), set by the frontend
+    # when tracing is enabled; dispatch installs it as the thread-current
+    # span so batcher/bus instrumentation parents to it
+    trace: Any = None
 
     def q1(self, name: str, default: str | None = None) -> str | None:
         vals = self.query.get(name)
@@ -189,9 +194,16 @@ class ServingApp:
         # generic /console renders each as its own table — the equivalent
         # of the reference's per-app Console subclasses (e.g. als/Console.java)
         self.console_sections: list[tuple[str, Callable[["ServingApp"], list[tuple[str, Any]]]]] = []
+        # tracing follows THIS app's config (last constructed wins — one
+        # config per process); /healthz reports uptime + frontend fan-out
+        configure_tracing(config)
+        self.started_at = time.monotonic()
+        self.loop_count = 1  # the async frontend overwrites with its fan-out
         reg = get_registry()
         self._m_requests = reg.counter(
-            "oryx_serving_requests_total", "Serving requests by method and status"
+            "oryx_serving_requests_total",
+            "Serving requests by method and status",
+            labeled=True,
         )
         self._m_latency = reg.histogram(
             "oryx_serving_request_seconds", "Serving request latency by method"
@@ -205,6 +217,12 @@ class ServingApp:
         ).set_function(
             lambda: _load_fraction(ref), manager=type(model_manager).__name__
         )
+        # model-freshness metrics (oryx_update_to_serve_seconds and
+        # friends, common/freshness.py) register on first touch so the
+        # serving /metrics page always exposes them
+        from oryx_tpu.common.freshness import model_freshness
+
+        model_freshness()
         self._load_resources()
 
     def _load_resources(self) -> None:
@@ -304,7 +322,17 @@ class ServingApp:
         either a rendered (status, body, content_type) tuple or a Deferred
         of one (the async frontend awaits it off-thread)."""
         start = time.monotonic()
-        resp = self._dispatch(req)
+        if req.trace is not None:
+            # install the request span as this thread's current span for
+            # the synchronous handler call, so instrumentation below it
+            # (batcher submit) parents without signature threading
+            prev = swap_current(req.trace)
+            try:
+                resp = self._dispatch(req)
+            finally:
+                swap_current(prev)
+        else:
+            resp = self._dispatch(req)
         if isinstance(resp, Deferred):
             rendered: Future = Future()
 

@@ -37,14 +37,21 @@ import logging
 import socket
 import ssl
 import threading
+import time
 import weakref
 from concurrent.futures import ThreadPoolExecutor
 from urllib.parse import parse_qs, urlsplit
 
+from oryx_tpu.common.tracing import get_tracer, parse_traceparent
 from oryx_tpu.serving.app import Deferred, Request, ServingApp
 from oryx_tpu.serving.auth import Authenticator
 
 log = logging.getLogger(__name__)
+
+# the tracer is a process singleton mutated in place by configure_tracing;
+# binding it once keeps the disabled-tracing cost to one attribute read
+# per request instead of a function call per stage
+_TRACER = get_tracer()
 
 MAX_HEADER_BYTES = 64 * 1024
 MAX_BODY_BYTES = 256 * 1024 * 1024
@@ -206,6 +213,7 @@ class AsyncHTTPServer:
                 )
                 self.close()  # don't leave the earlier loops listening
                 raise err
+        self.app.loop_count = len(self._loopstates)  # surfaced by /healthz
         self._register_metrics()
 
     def _start_loop(self, ls: _LoopState) -> None:
@@ -225,6 +233,7 @@ class AsyncHTTPServer:
         c = get_registry().counter(
             "oryx_http_loop_requests",
             "HTTP requests served, by frontend event loop",
+            labeled=True,  # zero series after close() renders no bogus `name 0`
         )
         for ls in self._loopstates:
             reader = _loop_requests_reader(weakref.ref(ls))
@@ -364,6 +373,9 @@ class AsyncHTTPServer:
                 if len(head) > MAX_HEADER_BYTES:
                     await self._simple_response(writer, 400, b"headers too large")
                     return
+                # head received: the parse stage (and the request span)
+                # starts here when tracing is on
+                t_parse = time.monotonic() if _TRACER.enabled else 0.0
                 if task is not None:
                     ls.conns[task] = False  # request in flight
 
@@ -470,7 +482,9 @@ class AsyncHTTPServer:
                     headers.get("connection", "").lower() != "close"
                     and version_b != b"HTTP/1.0"
                 )
-                await self._handle_request(writer, method, target, headers, body)
+                await self._handle_request(
+                    writer, method, target, headers, body, parse_start=t_parse
+                )
                 ls.requests += 1
                 if task is not None:
                     ls.conns[task] = True  # parked between requests
@@ -489,55 +503,95 @@ class AsyncHTTPServer:
         target: str,
         headers: dict[str, str],
         body: bytes,
+        span=None,
     ) -> tuple[int, bytes, str, tuple[tuple[str, str], ...]]:
         """Auth + gzip-decode + route dispatch, shared by every loop's
         HTTP/1.1 handler and the HTTP/2 streams (serving/http2.py):
-        returns (status, payload, content-type, extra response headers)."""
-        if self.auth is not None:
-            verdict = self.auth.check(method, target, headers.get("authorization"))
-            if verdict is not True:
-                return (
-                    401,
-                    b'{"status":401,"error":"unauthorized"}',
-                    "application/json",
-                    (("WWW-Authenticate", verdict),),
-                )
+        returns (status, payload, content-type, extra response headers).
 
-        path, query = _split_target(target)
-        if headers.get("content-encoding", "").lower() == "gzip" and body:
-            try:
-                body = gzip.decompress(body)
-            except OSError:
-                return 400, b"bad gzip body", "text/plain", ()
-        req = Request(
-            method=method,
-            path=path,
-            params={},
-            query=query,
-            body=body,
-            headers=headers,
-        )
-        loop = asyncio.get_running_loop()
+        ``span`` is the request span when the h1 path already opened one;
+        h2 streams call with span=None and (when tracing is on) get a
+        request span owned — opened AND finished — here."""
+        tr = _TRACER
+        own_span = False
+        if span is None and tr.enabled:
+            span = tr.start(
+                "http.request",
+                parent=parse_traceparent(headers.get("traceparent")),
+                method=method, target=target, proto="h2",
+            )
+            own_span = True
         try:
-            if self.app.is_fast(path):
-                # every route under this segment is declared nonblocking
-                # (state lookups + submit_nowait only): dispatch inline on
-                # the event loop, skipping two thread hops per request
-                resp = self.app.dispatch_nowait(req)
-            else:
-                resp = await loop.run_in_executor(
-                    self._pool, self.app.dispatch_nowait, req
-                )
-            if isinstance(resp, Deferred):
-                # deferred endpoints (device-batched top-k) complete on the
-                # event loop: the worker thread is already free, so in-flight
-                # requests are bounded by memory, not by pool size
-                resp = await asyncio.wrap_future(resp.future)
-            status, payload, ctype = resp
-        except Exception:  # pragma: no cover - dispatch renders its own 500s
-            log.exception("dispatch failed")
-            status, payload, ctype = 500, b"internal error", "text/plain"
-        return status, payload, ctype, ()
+            if self.auth is not None:
+                t_auth = time.monotonic() if span is not None else 0.0
+                verdict = self.auth.check(method, target, headers.get("authorization"))
+                if span is not None:
+                    tr.record_interval("http.auth", t_auth, parent=span)
+                if verdict is not True:
+                    if span is not None:
+                        span.attrs["status"] = 401
+                    return (
+                        401,
+                        b'{"status":401,"error":"unauthorized"}',
+                        "application/json",
+                        (("WWW-Authenticate", verdict),),
+                    )
+
+            path, query = _split_target(target)
+            if headers.get("content-encoding", "").lower() == "gzip" and body:
+                import zlib
+
+                try:
+                    body = gzip.decompress(body)
+                except (OSError, EOFError, zlib.error):
+                    # OSError: bad magic; EOFError: truncated stream;
+                    # zlib.error: corrupt deflate — all must 400, not
+                    # escape and silently drop the connection
+                    if span is not None:
+                        span.attrs["status"] = 400
+                    return 400, b"bad gzip body", "text/plain", ()
+            req = Request(
+                method=method,
+                path=path,
+                params={},
+                query=query,
+                body=body,
+                headers=headers,
+                trace=span,
+            )
+            loop = asyncio.get_running_loop()
+            dspan = (
+                tr.start("http.dispatch", parent=span, path=path)
+                if span is not None
+                else None
+            )
+            try:
+                if self.app.is_fast(path):
+                    # every route under this segment is declared nonblocking
+                    # (state lookups + submit_nowait only): dispatch inline on
+                    # the event loop, skipping two thread hops per request
+                    resp = self.app.dispatch_nowait(req)
+                else:
+                    resp = await loop.run_in_executor(
+                        self._pool, self.app.dispatch_nowait, req
+                    )
+                if isinstance(resp, Deferred):
+                    # deferred endpoints (device-batched top-k) complete on the
+                    # event loop: the worker thread is already free, so in-flight
+                    # requests are bounded by memory, not by pool size
+                    resp = await asyncio.wrap_future(resp.future)
+                status, payload, ctype = resp
+            except Exception:  # pragma: no cover - dispatch renders its own 500s
+                log.exception("dispatch failed")
+                status, payload, ctype = 500, b"internal error", "text/plain"
+            if dspan is not None:
+                tr.finish(dspan, status=status)
+                span.attrs["status"] = status
+            return status, payload, ctype, ()
+        finally:
+            if own_span:
+                tr.finish(span)
+                tr.log_if_slow(span, log)
 
     async def _handle_request(
         self,
@@ -546,14 +600,33 @@ class AsyncHTTPServer:
         target: str,
         headers: dict[str, str],
         body: bytes,
+        parse_start: float = 0.0,
     ) -> None:
+        tr = _TRACER
+        span = None
+        if tr.enabled:
+            # the request span opens at head-received time so header parse
+            # + body read are inside it; "http.parse" covers that stage
+            start = parse_start or None
+            span = tr.start(
+                "http.request",
+                parent=parse_traceparent(headers.get("traceparent")),
+                start=start, method=method, target=target,
+            )
+            if parse_start:
+                tr.record_interval("http.parse", parse_start, parent=span)
         status, payload, ctype, extra = await self._process(
-            method, target, headers, body
+            method, target, headers, body, span=span
         )
         gzip_ok = "gzip" in headers.get("accept-encoding", "").lower()
+        t_resp = time.monotonic() if span is not None else 0.0
         await self._write_response(
             writer, status, payload, ctype, method, gzip_ok=gzip_ok, extra=extra
         )
+        if span is not None:
+            tr.record_interval("http.respond", t_resp, parent=span)
+            tr.finish(span, status=status)
+            tr.log_if_slow(span, log)
 
     # (status, ctype) -> precomputed header prefix; statuses and content
     # types are a tiny closed set, so this never grows unbounded.

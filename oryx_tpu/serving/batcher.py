@@ -44,11 +44,16 @@ import threading
 import time
 from concurrent.futures import Future
 
+from oryx_tpu.common.tracing import current_span, get_tracer
 from oryx_tpu.serving.futureutil import try_set_exception, try_set_result
 
 import numpy as np
 
 log = logging.getLogger(__name__)
+
+# process-singleton tracer, bound once: the disabled-tracing submit cost
+# is a single attribute read (common/tracing.py)
+_TRACER = get_tracer()
 
 from oryx_tpu.ops.als import PALLAS_TOPK_MAX_K
 
@@ -143,7 +148,7 @@ def host_topk(
 class _Pending:
     __slots__ = (
         "vec", "k", "y", "future", "host_mat", "cosine", "host_norms",
-        "recall",
+        "recall", "t_enq", "trace_parent", "dev_span",
     )
 
     def __init__(self, vec, k, y, future, host_mat=None, cosine=False,
@@ -156,6 +161,26 @@ class _Pending:
         self.cosine = cosine
         self.host_norms = host_norms
         self.recall = recall
+        # tracing (only populated while tracing is enabled): enqueue time
+        # for the queue-wait span, the submitting request's span as
+        # parent, and a one-element box holding the in-flight device span
+        self.t_enq = 0.0
+        self.trace_parent = None
+        self.dev_span = None
+
+    def take_dev_span(self):
+        """Claim the in-flight device span, exactly once: the dispatcher's
+        resolve and the watchdog's host-drain may race to finish it, and
+        list.pop is a single GIL-atomic call so only one caller wins (a
+        double finish would record the span into two ring slots and
+        duplicate its subtree in /debug/traces)."""
+        box = self.dev_span
+        if not box:
+            return None
+        try:
+            return box.pop()
+        except IndexError:
+            return None
 
     def resolve_on_host(self, reason: Exception | None = None) -> bool:
         """Host-score this request. Returns True if a result was delivered,
@@ -164,6 +189,10 @@ class _Pending:
         inflate the degraded-traffic metric."""
         if self.future.done():
             return False
+        span = self.take_dev_span()
+        if span is not None:
+            # the wedged device span ends where host scoring takes over
+            _TRACER.finish(span, failover="host")
         if self.host_mat is None:
             try_set_exception(
                 self.future,
@@ -171,16 +200,21 @@ class _Pending:
             )
             return False
         try:
+            tr = _TRACER
+            t0 = time.monotonic() if tr.enabled else 0.0
+            result = host_topk(
+                self.vec, self.k, self.host_mat, self.cosine,
+                self.host_norms,
+            )
+            if tr.enabled and self.t_enq:
+                tr.record_interval(
+                    "batcher.host_score", t0, parent=self.trace_parent,
+                    k=self.k,
+                )
             # a lost try_set race means the wedged dispatcher unwedged
             # mid-drain and delivered its device result first — that
             # request succeeded, just not here
-            return try_set_result(
-                self.future,
-                host_topk(
-                    self.vec, self.k, self.host_mat, self.cosine,
-                    self.host_norms,
-                ),
-            )
+            return try_set_result(self.future, result)
         except Exception as e:  # pragma: no cover - defensive
             try_set_exception(self.future, e)
             return False
@@ -359,6 +393,12 @@ class TopKBatcher:
             vec, int(k), y, fut, host_mat, cosine, host_norms,
             float(recall),
         )
+        if _TRACER.enabled:
+            # parent = the submitting request's span (thread-current, set
+            # by ServingApp.dispatch_nowait); queue-wait measures from here
+            # to the dispatcher picking the batch up
+            p.t_enq = time.monotonic()
+            p.trace_parent = current_span()
         with self._cond:
             if self._closed:
                 raise RuntimeError("batcher is closed")
@@ -467,6 +507,17 @@ class TopKBatcher:
 
         from oryx_tpu.ops.als import topk_dot_batch
 
+        tr = _TRACER
+        if tr.enabled:
+            # queue-wait ends now: the dispatcher owns the batch
+            t_pick = time.monotonic()
+            for p in batch:
+                if p.t_enq:
+                    tr.record_interval(
+                        "batcher.queue_wait", p.t_enq, t_pick,
+                        parent=p.trace_parent,
+                    )
+
         groups: dict[tuple[int, int, float], list[_Pending]] = {}
         for p in batch:
             n = p.y.shape[0]
@@ -506,6 +557,16 @@ class TopKBatcher:
                 xs = np.zeros((padded, y.shape[1]), dtype=np.float32)
                 for i, p in enumerate(group):
                     xs[i] = p.vec
+                if tr.enabled:
+                    # device span: dispatch issue until the host fetch
+                    # resolves (_resolve); one span per request so every
+                    # request's trace tree shows its own device time
+                    for p in group:
+                        if p.t_enq:
+                            p.dev_span = [tr.start(
+                                "batcher.device", parent=p.trace_parent,
+                                k=kb, batch=b, rows=padded,
+                            )]
                 vals, idx = topk_dot_batch(
                     jnp.asarray(xs), y, k=kb, recall=recall
                 )
@@ -526,6 +587,9 @@ class TopKBatcher:
                 # the watchdog's drain may be host-resolving these same
                 # futures concurrently — a lost race must not propagate
                 for p in group:
+                    span = p.take_dev_span()
+                    if span is not None:
+                        _TRACER.finish(span, error=type(e).__name__)
                     try_set_exception(p.future, e)
         return launched
 
@@ -546,6 +610,9 @@ class TopKBatcher:
                 self._compiling.pop(shape_key, None)
             for i, p in enumerate(group):
                 k_eff = min(p.k, kb)
+                span = p.take_dev_span()
+                if span is not None:
+                    _TRACER.finish(span)
                 # the watchdog may have host-resolved this request while the
                 # fetch above sat on a wedged transport — and may win the
                 # race BETWEEN a done() check and the set; try_set absorbs
@@ -556,6 +623,9 @@ class TopKBatcher:
             with self._cond:
                 self._compiling.pop(shape_key, None)
             for p in group:
+                span = p.take_dev_span()
+                if span is not None:
+                    _TRACER.finish(span, error=type(e).__name__)
                 try_set_exception(p.future, e)
 
     # -- watchdog: wedged-transport failover -------------------------------

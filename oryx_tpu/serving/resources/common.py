@@ -1,13 +1,21 @@
-"""Resources every app shares: /ready health check and /ingest bulk input.
+"""Resources every app shares: /ready, /healthz, /ingest, /metrics,
+/debug/traces.
 
 Mirrors the reference's Ready.java:33-46 (GET/HEAD 200-or-503 on model
 load fraction) and Ingest.java (bulk lines -> input topic, gzip-aware via
-the server's request decoding).
+the server's request decoding), plus the observability endpoints the
+reference never had: Prometheus /metrics, a /healthz liveness probe
+(distinct from /ready readiness), and the /debug/traces span lens
+(common/tracing.py).
 """
 
 from __future__ import annotations
 
+import json
+import time
+
 from oryx_tpu.common.metrics import get_registry
+from oryx_tpu.common.tracing import chrome_trace, get_tracer, span_forest
 from oryx_tpu.serving.app import OryxServingException, RawResponse, Request, ServingApp
 
 
@@ -81,10 +89,60 @@ def register(app: ServingApp) -> None:
         a.get_serving_model()
         return 200, None
 
+    @app.route("GET", "/healthz", nonblocking=True)
+    def healthz(a: ServingApp, req: Request):
+        """Liveness (vs /ready readiness): 200 whenever the frontend can
+        dispatch at all — even with no model loaded — reporting uptime,
+        event-loop fan-out, and the generation id of the model being
+        served (from the update topic's publish stamps)."""
+        from oryx_tpu.common.freshness import model_freshness
+
+        return 200, {
+            "status": "up",
+            "uptime_seconds": round(time.monotonic() - a.started_at, 3),
+            "loops": a.loop_count,
+            "model_generation": model_freshness().generation,
+        }
+
+    @app.route("HEAD", "/healthz", nonblocking=True)
+    def healthz_head(a: ServingApp, req: Request):
+        return 200, None
+
     @app.route("POST", "/ingest")
     def ingest(a: ServingApp, req: Request):
         n = send_input_lines(a, _ingest_text(req), "ingest body")
         return 200, {"ingested": n}
+
+    # NOT nonblocking: serializing a full ring (thousands of spans) on an
+    # event loop would stall that loop's other connections
+    @app.route("GET", "/debug/traces")
+    def debug_traces(a: ServingApp, req: Request):
+        """Recent finished spans from the process ring buffer as a span
+        forest (default) or Chrome trace-event JSON (?format=chrome —
+        opens directly in Perfetto, alongside maybe_profile TPU traces).
+        ?limit=N keeps only the newest N spans. Empty until
+        oryx.monitoring.tracing.enabled = true."""
+        tr = get_tracer()
+        spans = tr.snapshot()
+        try:
+            limit = int(req.q1("limit", "0") or 0)
+        except ValueError:
+            raise OryxServingException(400, "bad limit")
+        if limit > 0:
+            spans = spans[-limit:]
+        if req.q1("format") == "chrome":
+            body = json.dumps(chrome_trace(spans), default=str)
+        else:
+            body = json.dumps(
+                {
+                    "enabled": tr.enabled,
+                    "capacity": tr.capacity,
+                    "spans": len(spans),
+                    "traces": span_forest(spans),
+                },
+                default=str,
+            )
+        return RawResponse(200, body.encode("utf-8"), "application/json")
 
     if app.config.get_bool("oryx.monitoring.metrics", True):
 

@@ -22,6 +22,7 @@ from oryx_tpu.bus.api import ConsumeDataIterator, TopicProducer
 from oryx_tpu.bus.broker import get_broker
 from oryx_tpu.common.classutil import load_instance_of
 from oryx_tpu.common.config import Config
+from oryx_tpu.common.tracing import get_tracer, parse_traceparent
 from oryx_tpu.serving.app import Request, ServingApp
 from oryx_tpu.serving.auth import Authenticator, make_authenticator
 
@@ -301,7 +302,21 @@ def _make_handler(app: ServingApp, auth: Authenticator | None):
                     return
             split = urlsplit(self.path)
             if self.headers.get("Content-Encoding", "").lower() == "gzip" and body:
-                body = gzip.decompress(body)
+                import zlib
+
+                try:
+                    body = gzip.decompress(body)
+                except (OSError, EOFError, zlib.error):
+                    # truncated/corrupt gzip must 400, not kill the
+                    # handler mid-connection (same contract as aserver)
+                    payload = b"bad gzip body"
+                    self.send_response(400)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    if method != "HEAD":
+                        self.wfile.write(payload)
+                    return
             req = Request(
                 method=method,
                 path=split.path,
@@ -310,7 +325,19 @@ def _make_handler(app: ServingApp, auth: Authenticator | None):
                 body=body,
                 headers={k.lower(): v for k, v in self.headers.items()},
             )
+            tr = get_tracer()
+            span = None
+            if tr.enabled:
+                span = tr.start(
+                    "http.request",
+                    parent=parse_traceparent(req.headers.get("traceparent")),
+                    method=method, target=self.path, frontend="threaded",
+                )
+                req.trace = span
             status, payload, ctype = app.dispatch(req)
+            if span is not None:
+                tr.finish(span, status=status)
+                tr.log_if_slow(span, log)
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             # compress sizable responses for clients that accept it (the

@@ -1,0 +1,58 @@
+"""tools/check_metrics.py wired as a tier-1 gate: metric docs can't drift."""
+
+from __future__ import annotations
+
+import importlib.util
+import pathlib
+
+
+def _load_tool():
+    root = pathlib.Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics", root / "tools" / "check_metrics.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_every_metric_name_documented_and_valid(capsys):
+    tool = _load_tool()
+    rc = tool.main()
+    out = capsys.readouterr()
+    assert rc == 0, f"metric/docs drift:\n{out.err}"
+
+
+def test_checker_catches_undocumented_and_stale_names(monkeypatch):
+    """The checker itself must actually fail on drift in both directions."""
+    tool = _load_tool()
+
+    real_code = tool.code_metric_names
+
+    def with_extra():
+        names = real_code()
+        names["oryx_totally_new_metric"] = "somewhere.py"
+        return names
+
+    monkeypatch.setattr(tool, "code_metric_names", with_extra)
+    assert tool.main() == 1  # registered but undocumented
+
+    monkeypatch.setattr(tool, "code_metric_names", real_code)
+    real_doc = tool.doc_metric_names
+    monkeypatch.setattr(
+        tool, "doc_metric_names", lambda: real_doc() | {"oryx_ghost_metric"}
+    )
+    assert tool.main() == 1  # documented but gone from code
+
+
+def test_checker_rejects_invalid_names(monkeypatch):
+    tool = _load_tool()
+    real_code = tool.code_metric_names
+
+    def with_bad():
+        names = real_code()
+        names["oryx_BadName"] = "somewhere.py"
+        return names
+
+    monkeypatch.setattr(tool, "code_metric_names", with_bad)
+    assert tool.main() == 1

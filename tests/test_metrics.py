@@ -12,11 +12,31 @@ import threading
 from oryx_tpu.common.metrics import (
     Counter,
     Gauge,
+    GaugeSeriesGone,
     Histogram,
     MetricsRegistry,
     get_registry,
     maybe_profile,
 )
+
+
+def test_series_gone_eviction_spares_newer_binding():
+    """A dead reader raising GaugeSeriesGone mid-scrape must evict only
+    ITS binding: a new owner re-binding the same labels between the
+    render snapshot and the raise keeps its fresh series."""
+    g = Gauge("gone_rebind", "x")
+
+    def new_reader():
+        return 42.0
+
+    def dead_reader():
+        g.set_function(new_reader, loop="0")  # new owner rebinds mid-scrape
+        raise GaugeSeriesGone("old owner gone")
+
+    g.set_function(dead_reader, loop="0")
+    g.render()  # dead reader raises; must NOT clobber the new binding
+    assert g.value(loop="0") == 42.0
+    assert 'gone_rebind{loop="0"} 42' in "\n".join(g.render())
 
 
 def test_counter_inc_and_labels():
@@ -94,6 +114,65 @@ def test_registry_thread_safety():
     for t in threads:
         t.join()
     assert c.value() == 8000
+
+
+def test_help_text_escaped_in_exposition():
+    """Newlines/backslashes in help must not corrupt the # HELP line (a
+    raw newline would split the exposition mid-comment) — and quotes must
+    NOT be escaped there (HELP allows only \\\\ and \\n escapes; \\" is
+    itself invalid and would corrupt the scrape)."""
+    c = Counter("esc", 'multi\nline help with \\backslash')
+    text = "\n".join(c.render())
+    assert '# HELP esc multi\\nline help with \\\\backslash' in text
+    assert "\nline help" not in text  # no raw newline leaked
+    q = Gauge("escq", 'the "auto" mode')
+    assert '# HELP escq the "auto" mode' in "\n".join(q.render())
+
+
+def test_labeled_only_metric_emits_no_zero_sample():
+    """A labeled-only metric with zero series renders HELP/TYPE but NO
+    bogus unlabeled `name 0` sample; an unlabeled counter keeps its 0."""
+    c = Counter("labeled_reqs", "by loop", labeled=True)
+    text = "\n".join(c.render())
+    assert "# TYPE labeled_reqs counter" in text
+    assert "labeled_reqs 0" not in text
+    g = Gauge("labeled_g", "by shard", labeled=True)
+    assert "labeled_g 0" not in "\n".join(g.render())
+    # unlabeled metrics keep the explicit zero sample
+    assert "plain 0" in "\n".join(Counter("plain", "x").render())
+    c.inc(loop="0")
+    assert 'labeled_reqs{loop="0"} 1' in "\n".join(c.render())
+
+
+def test_read_paths_snapshot_under_lock():
+    """value()/count()/sum() take the lock like render(): hammer reads
+    against concurrent first-inserts (dict resizes) and verify totals."""
+    c = Counter("rc", "")
+    h = Histogram("rh", "", buckets=(1.0,))
+    stop = []
+
+    def write():
+        for i in range(2000):
+            c.inc(series=str(i))
+            h.observe(0.5, series=str(i))
+        stop.append(True)
+
+    def read():
+        while not stop:
+            c.value(series="1")
+            h.count(series="1")
+            h.sum(series="1")
+
+    w = threading.Thread(target=write)
+    readers = [threading.Thread(target=read) for _ in range(4)]
+    w.start()
+    for r in readers:
+        r.start()
+    w.join()
+    for r in readers:
+        r.join()
+    assert c.value(series="7") == 1.0
+    assert h.count(series="7") == 1 and h.sum(series="7") == 0.5
 
 
 def test_maybe_profile_noop_without_dir():

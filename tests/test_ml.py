@@ -159,10 +159,14 @@ def test_harness_picks_best_candidate_and_publishes(tmp_path):
     assert model.get_extension("q") == "0.9"
     # no candidate litter left behind
     assert not (tmp_path / "models" / ".candidates").exists()
-    # published inline as MODEL
+    # published inline as MODEL, followed by its framework publish stamp
+    # (key TRACE — intercepted by _dispatch_update, app handlers never see it)
     recs = broker.read("U", 0, 0, 10)
-    assert len(recs) == 1 and recs[0][1] == "MODEL"
+    assert [k for _, k, _ in recs] == ["MODEL", "TRACE"]
     assert ModelArtifact.from_string(recs[0][2]).get_extension("q") == "0.9"
+    import json as _json
+
+    assert _json.loads(recs[1][2])["published_ms"] > 0
 
 
 def test_harness_threshold_rejects_bad_model(tmp_path):

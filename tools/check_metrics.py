@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Static metric-name consistency check (wired as a tier-1 test).
+
+Two invariants, so metric docs cannot drift from the code:
+
+1. Every metric name used under ``oryx_tpu/`` (any string literal that is
+   exactly an ``oryx_``-prefixed identifier) matches the naming contract
+   ``^oryx_[a-z0-9_]+$``.
+2. Every such name appears in the reference table of
+   ``docs/observability.md`` (a row whose first column is the backticked
+   name) — and every name in the table exists in code.
+
+Histogram series suffixes (``_bucket``/``_sum``/``_count``) are derived by
+the exposition layer and are documented under the base name only.
+
+Exit status 0 = consistent; 1 = drift (each problem printed on stderr).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = ROOT / "oryx_tpu"
+DOC = ROOT / "docs" / "observability.md"
+
+VALID_NAME = re.compile(r"^oryx_[a-z0-9_]+$")
+# A whole string literal that is an oryx_-prefixed identifier. Literals
+# with any other characters (spaces, braces, dots) are scrape patterns or
+# prose, not metric registrations, and are skipped on purpose.
+CODE_LITERAL = re.compile(r"""["'](oryx_[A-Za-z0-9_]+)["']""")
+# A reference-table row whose first cell is the backticked metric name.
+DOC_ROW = re.compile(r"^\|\s*`(oryx_[^`]+)`", re.M)
+
+# Not metrics: the package's own name appears as a string in a few places.
+IGNORE = {"oryx_tpu"}
+
+
+def code_metric_names() -> dict[str, str]:
+    """name -> first file using it, for every metric-shaped literal."""
+    names: dict[str, str] = {}
+    for py in sorted(PACKAGE.rglob("*.py")):
+        text = py.read_text(encoding="utf-8")
+        for m in CODE_LITERAL.finditer(text):
+            name = m.group(1)
+            if name not in IGNORE:
+                names.setdefault(name, str(py.relative_to(ROOT)))
+    return names
+
+
+def doc_metric_names() -> set[str]:
+    return set(DOC_ROW.findall(DOC.read_text(encoding="utf-8")))
+
+
+def main() -> int:
+    problems: list[str] = []
+    if not DOC.exists():
+        print(f"missing {DOC.relative_to(ROOT)}", file=sys.stderr)
+        return 1
+    code = code_metric_names()
+    doc = doc_metric_names()
+    for name in sorted(code):
+        where = code[name]
+        if not VALID_NAME.match(name):
+            problems.append(
+                f"{name} ({where}): does not match ^oryx_[a-z0-9_]+$"
+            )
+        elif name not in doc:
+            problems.append(
+                f"{name} ({where}): missing from the docs/observability.md "
+                "metric reference table"
+            )
+    for name in sorted(doc - set(code)):
+        problems.append(
+            f"{name}: documented in docs/observability.md but not found "
+            "anywhere under oryx_tpu/"
+        )
+    for p in problems:
+        print(p, file=sys.stderr)
+    if not problems:
+        print(f"ok: {len(code)} metric names consistent with docs")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
