@@ -792,6 +792,164 @@ def _train_once(
     return row
 
 
+def _bench_update_storm_body() -> None:
+    """Update-storm serving scenario: continuous speed-layer row writes
+    during the query window. Measures the post-update latency cliff the
+    incremental view sync removes — steady-state query p99 vs p99 under a
+    sustained write stream (`update_stall_p99_ms`), host->device bytes per
+    row-level update (`device_sync_bytes`, which must be delta-sized, not
+    full-matrix-sized), and write->servable lag (`update_to_serve_s`, the
+    row-level analogue of PR 2's oryx_update_to_serve_seconds publish
+    stamp). Drives the serving model directly (the stall lives in the view
+    sync, not the HTTP tier, and both phases share the same in-process
+    harness so the ratio is apples-to-apples)."""
+    import threading
+
+    import numpy as np
+    import jax
+
+    from oryx_tpu.apps.als.serving import ALSServingModel
+    from oryx_tpu.apps.als.state import ALSState
+    from oryx_tpu.common.metrics import get_registry
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    n_items, features, k = (1_000_000, 50, 10) if on_accel else (100_000, 50, 10)
+    steady_s, storm_s = (6.0, 8.0) if on_accel else (4.0, 6.0)
+    n_query_threads = 4
+
+    rng = np.random.default_rng(17)
+    state = ALSState(features, implicit=True)
+    state.y.bulk_set(
+        [f"i{j}" for j in range(n_items)],
+        rng.standard_normal((n_items, features), dtype=np.float32),
+    )
+    state.x.bulk_set(["u0"], rng.standard_normal((1, features), dtype=np.float32))
+    state.set_expected(state.x.ids(), state.y.ids())
+    model = ALSServingModel(state)  # default sync: delta + background
+    queries = rng.standard_normal((256, features)).astype(np.float32)
+    model.top_n(queries[0], k)  # build the capacity-padded view + compile
+    capacity = int(model._y_view_full()[0].shape[0])
+
+    lat_sink: list[list[float]] = [[] for _ in range(n_query_threads)]
+    stop_q = threading.Event()
+
+    def query_loop(ti: int) -> None:
+        j = ti
+        while not stop_q.is_set():
+            t0 = time.perf_counter()
+            model.top_n(queries[j % len(queries)], k)
+            lat_sink[ti].append((time.perf_counter() - t0) * 1000.0)
+            j += n_query_threads
+
+    qthreads = [
+        threading.Thread(target=query_loop, args=(i,), daemon=True)
+        for i in range(n_query_threads)
+    ]
+    for t in qthreads:
+        t.start()
+
+    def window(seconds: float) -> list[float]:
+        marks = [len(ls) for ls in lat_sink]
+        time.sleep(seconds)
+        return sorted(
+            l for ls, m in zip(lat_sink, marks) for l in ls[m:]
+        )
+
+    def pctl(vals: list[float], q: float) -> float:
+        if not vals:
+            return 0.0
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    # phase A — steady state, no writes. The warm slice pays the
+    # concurrent-batch-shape compiles so the steady p99 measures serving,
+    # not the jit ramp (which would flatter the storm ratio).
+    window(2.0)
+    steady = window(steady_s)
+
+    # phase B — the storm: bursts of row rewrites on existing items (the
+    # speed-layer UP pattern), with a freshness sampler timing each
+    # burst's write->servable lag off the served view version
+    reg = get_registry()
+    bytes0 = reg.counter("oryx_device_sync_bytes").value()
+    delta0 = reg.counter("oryx_view_resync_total").value(kind="delta")
+    full0 = reg.counter("oryx_view_resync_total").value(kind="full")
+    stop_w = threading.Event()
+    rows_written = [0]
+    serve_lags: list[float] = []
+
+    def writer() -> None:
+        burst = 16
+        while not stop_w.is_set():
+            for _ in range(burst):
+                j = int(rng.integers(0, n_items))
+                state.y.set(
+                    f"i{j}", rng.standard_normal(features).astype(np.float32)
+                )
+            rows_written[0] += burst
+            t_w, v_w = time.perf_counter(), state.y.get_version()
+            while not stop_w.is_set():
+                if (model.served_version() or 0) >= v_w:
+                    serve_lags.append(time.perf_counter() - t_w)
+                    break
+                time.sleep(0.001)
+            time.sleep(0.02)
+
+    wthread = threading.Thread(target=writer, daemon=True)
+    wthread.start()
+    storm = window(storm_s)
+    stop_w.set()
+    wthread.join(timeout=10)
+    stop_q.set()
+    for t in qthreads:
+        t.join(timeout=10)
+    sync_bytes = reg.counter("oryx_device_sync_bytes").value() - bytes0
+    resync_delta = reg.counter("oryx_view_resync_total").value(kind="delta") - delta0
+    resync_full = reg.counter("oryx_view_resync_total").value(kind="full") - full0
+    model.close()
+
+    steady_p99 = pctl(steady, 0.99)
+    storm_p99 = pctl(storm, 0.99)
+    serve_lags.sort()
+    full_matrix_bytes = capacity * features * 2  # one bf16 re-upload
+    per_update = sync_bytes / max(1, rows_written[0])
+    print(
+        f"update storm: {rows_written[0]} row writes over {storm_s:.0f}s, "
+        f"query p99 {steady_p99:.1f} -> {storm_p99:.1f} ms, "
+        f"{resync_delta:.0f} delta / {resync_full:.0f} full resyncs, "
+        f"{per_update:.0f} sync B/update (full matrix {full_matrix_bytes} B) "
+        f"on {platform}",
+        file=sys.stderr,
+    )
+    print(json.dumps({
+        "metric": _metric_name(
+            "als_update_storm_stall_p99", n_items, features, platform
+        ),
+        "value": round(storm_p99, 2),
+        "unit": "ms",
+        "vs_baseline": None,  # no reference row exists for this scenario
+        "platform": platform,
+        "n_items": n_items,
+        "update_stall_p99_ms": round(storm_p99, 2),
+        "steady_p99_ms": round(steady_p99, 2),
+        # the acceptance bar: storm p99 <= 2x steady p99
+        "stall_ratio": round(storm_p99 / steady_p99, 2) if steady_p99 else None,
+        "steady_qps": round(len(steady) / steady_s, 1),
+        "storm_qps": round(len(storm) / storm_s, 1),
+        "updates_applied": rows_written[0],
+        "device_sync_bytes": int(sync_bytes),
+        "device_sync_bytes_per_update": round(per_update, 1),
+        "full_matrix_bytes": full_matrix_bytes,
+        "update_to_serve_s": {
+            "p50": round(pctl(serve_lags, 0.50), 4),
+            "p99": round(pctl(serve_lags, 0.99), 4),
+            "n": len(serve_lags),
+        },
+        "resync_delta": int(resync_delta),
+        "resync_full": int(resync_full),
+    }))
+
+
 def _bench_speed_body() -> None:
     """Speed-tier throughput: raw input events -> parse -> aggregate ->
     vmapped fold-in solves -> UP messages, through the real
@@ -1258,6 +1416,26 @@ def _merge_http(result: dict, http: dict) -> None:
     result.update(http)
 
 
+def _merge_update_storm(result: dict, row: dict) -> None:
+    """The update-storm block lands nested (its own scenario, not the
+    headline), with the stall p99 promoted to the compact final line."""
+    result["update_storm"] = {
+        key: row[key]
+        for key in (
+            "update_stall_p99_ms", "steady_p99_ms", "stall_ratio",
+            "steady_qps", "storm_qps", "updates_applied",
+            "device_sync_bytes", "device_sync_bytes_per_update",
+            "full_matrix_bytes", "update_to_serve_s",
+            "resync_delta", "resync_full", "platform",
+        )
+        if key in row
+    }
+    if row.get("update_stall_p99_ms") is not None:
+        result["update_stall_p99_ms"] = row["update_stall_p99_ms"]
+    if row.get("stall_ratio") is not None:
+        result["update_stall_ratio"] = row["stall_ratio"]
+
+
 def _merge_lsh(result: dict, row: dict) -> None:
     result["lsh_qps"] = row.get("value")
     result["lsh_vs_baseline"] = row.get("vs_baseline")
@@ -1290,6 +1468,7 @@ _SUITE_STAGES = (
     ("_bench_speed_body", 300, False, _merge_speed, False),
     ("_bench_kmeans_rdf_body", 420, False, _merge_kmeans_rdf, False),
     ("_bench_http_lsh_body", 240, False, _merge_lsh, True),
+    ("_bench_update_storm_body", 240, False, _merge_update_storm, False),
     ("_bench_scale_body", 900, True, _merge_scaling, False),
 )
 
@@ -1302,8 +1481,8 @@ _SUITE_STAGES = (
 # the tunnel when killed mid-transfer, and nothing survived).
 _ACCEL_STAGE_ORDER = (
     "_bench_body", "_bench_scale_body", "_bench_http_body",
-    "_bench_train_body", "_bench_speed_body", "_bench_kmeans_rdf_body",
-    "_bench_http_lsh_body",
+    "_bench_update_storm_body", "_bench_train_body", "_bench_speed_body",
+    "_bench_kmeans_rdf_body", "_bench_http_lsh_body",
 )
 
 
@@ -1543,6 +1722,7 @@ _SUMMARY_KEYS = (
     "pallas_speedup", "als_build_seconds", "als_build_auc", "train_mfu",
     "speed_events_per_sec", "kmeans_build_seconds", "rdf_build_seconds",
     "rdf_accuracy", "lsh_qps", "lsh_vs_baseline", "qps_per_core_vs_baseline",
+    "update_stall_p99_ms", "update_stall_ratio",
     "speedup_vs_mllib", "partial", "stages_done", "tpu_wait",
 )
 

@@ -12,8 +12,11 @@ knownItems ingestion rides the X update flood like the reference.
 from __future__ import annotations
 
 import logging
+import os
 import threading
+import time
 from concurrent.futures import Future
+from dataclasses import dataclass
 
 import numpy as np
 
@@ -21,16 +24,119 @@ import jax.numpy as jnp
 
 from oryx_tpu.api import AbstractServingModelManager, ServingModel
 from oryx_tpu.common.config import Config
+from oryx_tpu.common.metrics import MICROBATCH_BUCKETS, get_registry
+from oryx_tpu.common.tracing import get_tracer
 from oryx_tpu.ops.als import compute_updated_xu
 from oryx_tpu.apps.als.common import ALSConfig
 from oryx_tpu.serving.app import chain_future
-from oryx_tpu.serving.batcher import TopKBatcher, cosine_scale, select_topk
+from oryx_tpu.serving.batcher import TopKBatcher, cosine_scale, host_topk, select_topk
 from oryx_tpu.apps.als.state import ALSState, apply_update_message
 
 log = logging.getLogger(__name__)
 
 # Max LSH partition-rebuild frequency under live update ingestion.
 _LSH_REFRESH_SEC = 1.0
+
+# Background resync poll interval: the thread also wakes immediately on
+# _request_resync, so this only bounds how long a pure speed-layer write
+# storm (no queries observing the drift) can stay un-synced.
+_RESYNC_POLL_S = 0.05
+
+
+@dataclass
+class SyncConfig:
+    """How the serving model keeps its device/host scoring views in step
+    with the live factor store (oryx.serving.api.sync.*).
+
+    mode:
+      - "delta" (default): dirty rows since the served view's version are
+        scattered into the device matrix in place and the host mirror /
+        norms / unit view / LSH partitions update the same rows; a
+        background thread does all of it off the query path and swaps
+        consistent view tuples atomically.
+      - "full": every resync rebuilds from a snapshot (still in the
+        background) — the debugging/bisection mode when delta application
+        is suspected.
+      - "blocking": the pre-incremental behavior — the next query after a
+        version bump rebuilds the whole view synchronously under the sync
+        lock. Kept for comparison benchmarks; it re-creates the
+        first-query latency cliff on purpose.
+    capacity_headroom: device matrix rows are allocated for the CURRENT
+      store size grown by this fraction (then bucket-laddered,
+      ops/transfer.py row_capacity), so speed-layer growth neither
+      reallocates the device buffer nor changes the batcher's compiled
+      dispatch shapes until a bucket boundary.
+    max_delta_fraction: a dirty set larger than this fraction of the store
+      full-resyncs instead — past that point the delta costs more than the
+      snapshot it replaces.
+    """
+
+    mode: str = "delta"
+    capacity_headroom: float = 0.125
+    max_delta_fraction: float = 0.2
+
+    @staticmethod
+    def from_config(config: Config) -> "SyncConfig":
+        g = lambda k, d: config.get(f"oryx.serving.api.sync.{k}", d)
+        mode = str(g("mode", "delta"))
+        if mode not in ("delta", "full", "blocking"):
+            raise ValueError(
+                "oryx.serving.api.sync.mode must be delta, full or "
+                f"blocking, got {mode!r}"
+            )
+        headroom = float(g("capacity-headroom", 0.125))
+        if headroom < 0.0:
+            raise ValueError(
+                "oryx.serving.api.sync.capacity-headroom must be >= 0"
+            )
+        frac = float(g("max-delta-fraction", 0.2))
+        if not (0.0 < frac <= 1.0):
+            raise ValueError(
+                "oryx.serving.api.sync.max-delta-fraction must be in (0, 1]"
+            )
+        return SyncConfig(mode, headroom, frac)
+
+
+_SYNC_METRICS = None
+_SYNC_METRICS_LOCK = threading.Lock()
+
+
+def _sync_metrics():
+    """(bytes counter, seconds histogram, resync counter, lsh histogram) —
+    process-wide, lazily registered so importing this module never touches
+    the registry."""
+    global _SYNC_METRICS
+    if _SYNC_METRICS is None:
+        with _SYNC_METRICS_LOCK:
+            if _SYNC_METRICS is None:
+                reg = get_registry()
+                _SYNC_METRICS = (
+                    reg.counter(
+                        "oryx_device_sync_bytes",
+                        "host->device bytes moved keeping serving views in "
+                        "sync (delta scatters move dirty rows; full "
+                        "resyncs move the whole matrix)",
+                    ),
+                    reg.histogram(
+                        "oryx_device_sync_seconds",
+                        "wall-clock per serving view resync (delta or full)",
+                        buckets=MICROBATCH_BUCKETS,
+                    ),
+                    reg.counter(
+                        "oryx_view_resync_total",
+                        "serving view resyncs by kind (delta = dirty-row "
+                        "scatter; full = snapshot rebuild, including the "
+                        "initial load)",
+                        labeled=True,
+                    ),
+                    reg.histogram(
+                        "oryx_lsh_rebuild_seconds",
+                        "wall-clock per full LSH partition-index rebuild "
+                        "(delta reassignments ride oryx_device_sync_seconds)",
+                        buckets=MICROBATCH_BUCKETS,
+                    ),
+                )
+    return _SYNC_METRICS
 
 _POST_POOL = None
 _POST_POOL_LOCK = threading.Lock()
@@ -63,6 +169,22 @@ def _post_pool():
     return _POST_POOL
 
 
+def _extend_ids(ids: list, delta) -> list | None:
+    """Extend a view's id list with the delta's appended rows, in row
+    order. Every index in [len(ids), delta.n) was dirty-logged by the
+    write that created it, so the delta must carry its id; None (with a
+    warning — the caller falls back to a full resync) if that invariant
+    ever breaks."""
+    if delta.n <= len(ids):
+        return ids
+    by_row = dict(zip((int(r) for r in delta.rows), delta.ids))
+    try:
+        return ids + [by_row[r] for r in range(len(ids), delta.n)]
+    except KeyError:  # pragma: no cover - log invariant broken
+        log.warning("delta missing ids for appended rows; full resync")
+        return None
+
+
 class _LshPartitions:
     """Per-partition contiguous scoring blocks for the LSH host path:
     rows[p] maps block rows back to store rows, mats[p] is the contiguous
@@ -85,17 +207,31 @@ class ALSServingModel(ServingModel):
         num_cores: int | None = None,
         approx_recall: float = 1.0,
         lsh_max_bits_differing: int | None = None,
+        sync: SyncConfig | None = None,
     ):
         self.state = state
         # < 1.0: serve via the on-device approximate top-k (the TPU
         # replacement for the reference's LSH sampling); the exact f32
         # re-rank still runs over the returned candidates
         self.approx_recall = approx_recall
-        # (device matrix, ids, version) swapped as ONE tuple: readers always
-        # see a matched pair, no lock on the read path
+        self.sync = sync or SyncConfig()
+        # (device matrix [capacity,K], ids [n], version, host f32 mirror
+        # [capacity,K]) swapped as ONE tuple: readers always see a matched
+        # set, no lock on the read path. capacity >= n rows the device
+        # buffer at headroom (row_capacity) so store growth scatters into
+        # existing rows instead of re-uploading Y
         self._device_view: tuple | None = None
         self._unit_view: tuple | None = None  # row-normalized Y, same keying
         self._sync_lock = threading.Lock()
+        # background resync: queries observing version drift set the event
+        # and keep serving the previous consistent snapshot; the thread
+        # applies deltas / rebuilds and swaps the view tuples atomically
+        self._resync_thread: threading.Thread | None = None
+        self._resync_evt = threading.Event()
+        self._stop = threading.Event()
+        # last completed resync, for bench/debug introspection:
+        # {kind, rows, bytes, seconds, version}
+        self.last_resync: dict | None = None
         # LSH candidate subsampling (CPU-parity approximation; the TPU path
         # scores everything exactly): built lazily at first query
         self.sample_rate = sample_rate
@@ -112,27 +248,24 @@ class ALSServingModel(ServingModel):
         # thread count — measured as a 14x collapse (64 threads on one
         # core thrashing ~3GB of concurrent gathers). Cores-many scorers
         # keep the CPUs busy with bounded memory; the rest queue.
-        import os as _os
-
         self._host_score_sem = threading.Semaphore(
-            max(1, num_cores if num_cores else (_os.cpu_count() or 1))
+            max(1, num_cores if num_cores else (os.cpu_count() or 1))
         )
 
-    def _lsh_index(self):
-        """(lsh, ids, partitions-per-row, partition index) — ONE matched
-        snapshot: id list, partition assignment and partition blocks all
-        from the same store version (concurrent UP ingestion bumps the
-        version; rows from a fresher partitioning must never index an
-        older matrix), the partitioning done once per version. The
-        partition index stores each partition's rows as a CONTIGUOUS
-        matrix block (the reference's partitioned-store layout,
-        ALSServingModel.java candidate partitions): per-query scoring dots
-        the candidate blocks directly instead of gathering an
-        O(sample_rate·N·F) candidate copy per request — the gather was
-        ~40% of per-request cost at 1M x 50f. The blocks ARE the snapshot
-        (the flat arena copy is not retained alongside them), so the LSH
-        path holds one grouped copy of Y, rebuilt at most once per
-        refresh window."""
+    def close(self) -> None:
+        """Stop the background resync thread (the manager calls this when
+        a MODEL update replaces the serving model)."""
+        self._stop.set()
+        self._resync_evt.set()
+
+    def served_version(self) -> int | None:
+        """Store version of the currently SERVED device view (None before
+        the first build) — `served_version() == state.y.get_version()`
+        means every published update is visible to queries."""
+        view = self._device_view
+        return None if view is None else view[2]
+
+    def _ensure_lsh(self):
         from oryx_tpu.apps.als.lsh import LocalitySensitiveHash
 
         if self._lsh is None:
@@ -142,53 +275,90 @@ class ALSServingModel(ServingModel):
                         self.sample_rate, self.state.features, self._num_cores,
                         max_bits_differing=self._lsh_max_bits,
                     )
+        return self._lsh
+
+    def _build_partition_view(self) -> tuple:
+        """Full LSH re-partition from a store snapshot — O(N.H.F) plus the
+        O(N.F) snapshot copy, so its cost is recorded (lsh.rebuild span +
+        oryx_lsh_rebuild_seconds): with resyncs in the background this
+        work no longer sits on a request, but it still burns a core and
+        delays view freshness. Call under _sync_lock."""
+        t0 = time.monotonic()
+        mat, ids, version = self.state.y.snapshot()
+        mat = np.asarray(mat, dtype=np.float32)
+        parts = self._lsh.indices_for(mat)
+        # partition -> (row indices, contiguous block, norms), grouped
+        # once per snapshot: the query path touches only candidate
+        # partitions — no O(N) isin scan and no per-request gather
+        order = np.argsort(parts, kind="stable")
+        sorted_parts = parts[order]
+        bounds = np.searchsorted(
+            sorted_parts, np.arange(self._lsh.num_partitions + 1)
+        )
+        rows_by_part = [
+            order[bounds[p]:bounds[p + 1]]
+            for p in range(self._lsh.num_partitions)
+        ]
+        mats = [np.ascontiguousarray(mat[r]) for r in rows_by_part]
+        pindex = _LshPartitions(
+            rows=rows_by_part,
+            mats=mats,
+            norms=[np.linalg.norm(m, axis=1) for m in mats],
+        )
+        # the flat arena copy is NOT kept in the view — the partition
+        # blocks are a complete copy already, and retaining both would
+        # double the LSH host footprint
+        view = (ids, parts, version, pindex)
+        self._partition_view = view
+        self._partition_built_at = time.monotonic()
+        dur = time.monotonic() - t0
+        _sync_metrics()[3].observe(dur)
+        tr = get_tracer()
+        if tr.enabled:
+            tr.record_interval(
+                "lsh.rebuild", t0, rows=len(ids), version=version,
+            )
+        return view
+
+    def _lsh_index(self):
+        """(lsh, ids, partitions-per-row, partition index) — ONE matched
+        snapshot: id list, partition assignment and partition blocks all
+        from the same store version (concurrent UP ingestion bumps the
+        version; rows from a fresher partitioning must never index an
+        older matrix). The partition index stores each partition's rows as
+        a CONTIGUOUS matrix block (the reference's partitioned-store
+        layout, ALSServingModel.java candidate partitions): per-query
+        scoring dots the candidate blocks directly instead of gathering an
+        O(sample_rate·N·F) candidate copy per request — the gather was
+        ~40% of per-request cost at 1M x 50f.
+
+        Freshness: in the background sync modes a stale view is served
+        as-is and the resync thread reassigns only DIRTY rows between
+        partitions (full re-partitions only on drift overflow, at most
+        once per refresh window). Blocking mode keeps the old inline
+        rebuild, rate-limited to once per refresh window — every single
+        UP write bumps the store version, and rebuilding the O(N.F)
+        snapshot + O(N.H.F) partitioning per write would dwarf the
+        subsampled scoring LSH exists for."""
+        self._ensure_lsh()
         view = self._partition_view
         version = self.state.y.get_version()
-        # Every single UP write bumps the store version; rebuilding the
-        # O(N.F) snapshot + O(N.H.F) partitioning per write would dwarf the
-        # subsampled scoring LSH exists for. Refresh at most once a second —
-        # queries in between serve the previous consistent snapshot (the
-        # whole read path is snapshot-based anyway).
-        import time as _time
-
-        now = _time.monotonic()
-        if view is None or (
-            view[2] != version and now - self._partition_built_at >= _LSH_REFRESH_SEC
-        ):
+        if view is not None and view[2] == version:
+            return self._lsh, view[0], view[1], view[3]
+        if view is not None and self.sync.mode != "blocking":
+            # serve the previous consistent snapshot; catch up off-path
+            self._request_resync()
+            return self._lsh, view[0], view[1], view[3]
+        now = time.monotonic()
+        if view is None or now - self._partition_built_at >= _LSH_REFRESH_SEC:
             with self._sync_lock:
                 view = self._partition_view
                 if view is None or (
                     view[2] != self.state.y.get_version()
-                    and _time.monotonic() - self._partition_built_at >= _LSH_REFRESH_SEC
+                    and time.monotonic() - self._partition_built_at
+                    >= _LSH_REFRESH_SEC
                 ):
-                    mat, ids, version = self.state.y.snapshot()
-                    mat = np.asarray(mat, dtype=np.float32)
-                    parts = self._lsh.indices_for(mat)
-                    # partition -> (row indices, contiguous block, norms),
-                    # grouped once per snapshot: the query path touches
-                    # only candidate partitions — no O(N) isin scan and
-                    # no per-request gather
-                    order = np.argsort(parts, kind="stable")
-                    sorted_parts = parts[order]
-                    bounds = np.searchsorted(
-                        sorted_parts, np.arange(self._lsh.num_partitions + 1)
-                    )
-                    rows_by_part = [
-                        order[bounds[p]:bounds[p + 1]]
-                        for p in range(self._lsh.num_partitions)
-                    ]
-                    mats = [np.ascontiguousarray(mat[r]) for r in rows_by_part]
-                    pindex = _LshPartitions(
-                        rows=rows_by_part,
-                        mats=mats,
-                        norms=[np.linalg.norm(m, axis=1) for m in mats],
-                    )
-                    # the flat arena copy is NOT kept in the view — the
-                    # partition blocks are a complete copy already, and
-                    # retaining both would double the LSH host footprint
-                    view = (ids, parts, version, pindex)
-                    self._partition_view = view
-                    self._partition_built_at = _time.monotonic()
+                    view = self._build_partition_view()
         return self._lsh, view[0], view[1], view[3]
 
     def fraction_loaded(self) -> float:
@@ -197,38 +367,29 @@ class ALSServingModel(ServingModel):
     # -- device scoring view ----------------------------------------------
 
     def _y_view_full(self) -> tuple:
-        """(device Y matrix, row ids, version, host Y matrix) resynced
-        lazily on version drift — a double-buffered atomic tuple swap
-        instead of the reference's fine-grained read locks on the hot path.
-        Staleness probe is a cheap version read; the full arena copies only
-        on drift."""
+        """(device Y matrix [capacity,K], row ids [n], version, host Y
+        matrix [capacity,K]) — an atomic tuple swap instead of the
+        reference's fine-grained read locks on the hot path. Staleness
+        probe is a cheap version read. On drift the background sync modes
+        serve the PREVIOUS consistent snapshot and hand the catch-up to
+        the resync thread (delta scatter or full rebuild, swap when
+        ready); only the first build — and every drift in blocking mode —
+        runs inline."""
         view = self._device_view
-        version = self.state.y.get_version()
-        if view is not None and view[2] == version:
-            return view
+        if view is not None:
+            if view[2] == self.state.y.get_version():
+                return view
+            if self.sync.mode != "blocking":
+                self._request_resync()
+                return view
         with self._sync_lock:
             view = self._device_view
-            if view is not None and view[2] == self.state.y.get_version():
+            if view is not None and (
+                self.sync.mode != "blocking"
+                or view[2] == self.state.y.get_version()
+            ):
                 return view
-            mat, ids, version = self.state.y.snapshot()
-            # bf16 scoring view: halves the HBM traffic of the memory-bound
-            # top-k scan. Scores accumulate in f32 on the MXU; at 1M x 50f
-            # the bf16 ranking matched f32 index-for-index (pallas_topk.py).
-            # The f32 host matrix rides along for the exact candidate
-            # re-rank — row-aligned with the device view by construction,
-            # read lock-free on the request path.
-            mat = np.asarray(mat, dtype=np.float32)
-            # oversized models come back as a ChunkedMatrix: a single
-            # (20M, 250)-class operand's program is too large to compile
-            # (ops/transfer.py); the batcher scores it chunk-and-merge
-            from oryx_tpu.ops.transfer import device_put_maybe_chunked
-
-            view = (
-                device_put_maybe_chunked(mat, dtype=jnp.bfloat16),
-                ids, version, mat,
-            )
-            self._device_view = view
-        return view
+            return self._build_views_full()
 
     def _y_view(self):
         view = self._y_view_full()
@@ -236,33 +397,338 @@ class ALSServingModel(ServingModel):
 
     def _y_unit_view(self):
         """Row-normalized Y for cosine queries, cached per store version so
-        the O(N.K) normalization runs once per model drift, not per request.
-        y/ids/version/host matrix come from ONE view tuple — re-reading the
-        version separately could cache a stale matrix under a newer stamp."""
-        y, ids, version, host_mat = self._y_view_full()
+        the O(N.K) normalization runs once per model drift, not per
+        request. unit/ids/host matrix/norms come from ONE view tuple — in
+        the background sync modes a stale unit view is served as-is (the
+        resync thread updates its dirty rows in step with the device
+        view); only the FIRST cosine query pays the inline build."""
         view = self._unit_view
-        if view is not None and view[2] == version:
-            return view[0], view[1], view[3], view[4]
+        if view is not None:
+            if view[2] != self.state.y.get_version():
+                if self.sync.mode != "blocking":
+                    self._request_resync()
+                    return view[0], view[1], view[3], view[4]
+            else:
+                return view[0], view[1], view[3], view[4]
+        y, ids, version, host_mat = self._y_view_full()
         with self._sync_lock:
             view = self._unit_view
-            if view is not None and view[2] == version:
+            if view is not None and (
+                view[2] == version or self.sync.mode != "blocking"
+            ):
                 return view[0], view[1], view[3], view[4]
-            from oryx_tpu.ops.transfer import ChunkedMatrix
-
-            def normalize(a):
-                af = a.astype(jnp.float32)
-                n = jnp.maximum(jnp.linalg.norm(af, axis=1, keepdims=True), 1e-12)
-                return (af / n).astype(a.dtype)
-
-            # row normalization is row-local, so a chunked view normalizes
-            # per chunk and stays chunked
-            unit = y.map(normalize) if isinstance(y, ChunkedMatrix) else normalize(y)
-            # host row norms cached per version too: the wedged-device
-            # cosine fallback must not pay an O(N.K) norm pass per request
-            host_norms = np.linalg.norm(host_mat, axis=1)
-            view = (unit, ids, version, host_mat, host_norms)
-            self._unit_view = view
+            # re-read the CURRENT device view under the lock: a background
+            # swap may have advanced it since the unlocked read above, and
+            # the unit view must mirror exactly one device snapshot
+            dv = self._device_view
+            if dv is not None:
+                y, ids, version, host_mat = dv
+            view = self._build_unit_view(y, ids, version, host_mat)
         return view[0], view[1], view[3], view[4]
+
+    def _build_unit_view(self, y, ids, version, host_mat) -> tuple:
+        """Normalize the device view into the cosine-scoring unit view +
+        cached host norms. Call under _sync_lock."""
+        from oryx_tpu.ops.transfer import ChunkedMatrix
+
+        def normalize(a):
+            af = a.astype(jnp.float32)
+            n = jnp.maximum(jnp.linalg.norm(af, axis=1, keepdims=True), 1e-12)
+            return (af / n).astype(a.dtype)
+
+        # row normalization is row-local, so a chunked view normalizes
+        # per chunk and stays chunked; capacity padding rows are zero and
+        # normalize to zero (they never reach callers: _post drops
+        # out-of-range indices)
+        unit = y.map(normalize) if isinstance(y, ChunkedMatrix) else normalize(y)
+        # host row norms cached per version too: the wedged-device cosine
+        # fallback must not pay an O(N.K) norm pass per request
+        host_norms = np.linalg.norm(host_mat, axis=1)
+        view = (unit, ids, version, host_mat, host_norms)
+        self._unit_view = view
+        return view
+
+    def _build_views_full(self) -> tuple:
+        """Full snapshot rebuild of the device + host scoring views (and
+        the unit view, when materialized): the initial load, and the
+        fallback when a delta can't serve (drift overflow, capacity
+        exhausted, arena compaction). Call under _sync_lock."""
+        from oryx_tpu.ops.transfer import (
+            CHUNKED_OVER_BYTES, ChunkedMatrix, device_put_maybe_chunked,
+            row_capacity,
+        )
+
+        t0 = time.monotonic()
+        mat, ids, version = self.state.y.snapshot()
+        mat = np.asarray(mat, dtype=np.float32)
+        n = len(ids)
+        # capacity-padded rows: store growth within the headroom scatters
+        # into existing rows — no realloc, no new batcher dispatch shape.
+        # Oversized (chunked) models skip the padding: their chunks are
+        # bounded already and growth full-resyncs (blocking mode also
+        # skips it — it rebuilds per drift anyway, and unpadded views
+        # keep its behavior exactly pre-incremental)
+        cap = n
+        if self.sync.mode != "blocking":
+            cap = row_capacity(n, self.sync.capacity_headroom)
+            if cap * self.state.features * 2 > CHUNKED_OVER_BYTES:
+                cap = n
+        if cap > n:
+            host = np.zeros((cap, self.state.features), dtype=np.float32)
+            host[:n] = mat
+        else:
+            host = mat
+        # bf16 scoring view: halves the HBM traffic of the memory-bound
+        # top-k scan. Scores accumulate in f32 on the MXU; at 1M x 50f
+        # the bf16 ranking matched f32 index-for-index (pallas_topk.py).
+        # The f32 host matrix rides along for the exact candidate
+        # re-rank — row-aligned with the device view by construction,
+        # read lock-free on the request path. Oversized models come back
+        # as a ChunkedMatrix: a single (20M, 250)-class operand's program
+        # is too large to compile (ops/transfer.py); the batcher scores
+        # it chunk-and-merge.
+        y_dev = device_put_maybe_chunked(host, dtype=jnp.bfloat16)
+        view = (y_dev, ids, version, host)
+        self._device_view = view
+        if self._unit_view is not None:
+            self._build_unit_view(y_dev, ids, version, host)
+        dur = time.monotonic() - t0
+        # the unit view normalizes ON device from the fresh upload, so a
+        # full resync moves exactly one bf16 matrix across the host link
+        self._note_resync("full", n, cap * self.state.features * 2, dur, version)
+        return view
+
+    # -- background resync --------------------------------------------------
+
+    def _note_resync(self, kind: str, rows: int, n_bytes: int,
+                     seconds: float, version: int) -> None:
+        m_bytes, m_secs, m_total, _ = _sync_metrics()
+        m_bytes.inc(n_bytes)
+        m_secs.observe(seconds)
+        m_total.inc(kind=kind)
+        self.last_resync = {
+            "kind": kind, "rows": rows, "bytes": n_bytes,
+            "seconds": seconds, "version": version,
+        }
+        tr = get_tracer()
+        if tr.enabled:
+            tr.record_interval(
+                "view.resync", time.monotonic() - seconds,
+                kind=kind, rows=rows, bytes=n_bytes, version=version,
+            )
+
+    def _request_resync(self) -> None:
+        """Wake (starting if needed) the background resync thread. Queries
+        call this on observing version drift and keep serving the old
+        snapshot — the post-update latency cliff moves off the request
+        path entirely."""
+        t = self._resync_thread
+        if t is None or not t.is_alive():
+            with self._sync_lock:
+                t = self._resync_thread
+                if (t is None or not t.is_alive()) and not self._stop.is_set():
+                    t = threading.Thread(
+                        target=self._resync_loop, name="oryx-als-resync",
+                        daemon=True,
+                    )
+                    self._resync_thread = t
+                    t.start()
+        self._resync_evt.set()
+
+    def _views_stale(self) -> bool:
+        v = self.state.y.get_version()
+        dv = self._device_view
+        if dv is not None and dv[2] != v:
+            return True
+        uv = self._unit_view
+        if dv is not None and uv is not None and uv[2] != dv[2]:
+            # a failed unit scatter after the device swap (partial delta
+            # apply) leaves the cosine view behind: it must be rebuilt,
+            # not silently served forever
+            return True
+        pv = self._partition_view
+        return pv is not None and pv[2] != v
+
+    def _resync_loop(self) -> None:
+        while not self._stop.is_set():
+            self._resync_evt.wait(_RESYNC_POLL_S)
+            self._resync_evt.clear()
+            if self._stop.is_set():
+                return
+            try:
+                while not self._stop.is_set() and self._views_stale():
+                    if not self._resync_once():
+                        break  # rate-limited: retry on the next poll tick
+            except Exception:
+                log.exception("background view resync failed")
+                # don't spin on a persistent failure (e.g. device OOM);
+                # queries keep serving the last consistent snapshot
+                time.sleep(0.5)
+
+    def _resync_once(self) -> bool:
+        """Bring every materialized view up to the current store version:
+        dirty-row deltas when the drift is small (mode delta), snapshot
+        rebuilds otherwise. Runs on the resync thread; swaps are atomic
+        tuple stores under _sync_lock, so queries never see a mismatched
+        matrix/ids/version set. Returns False when the only remaining
+        work is a rate-limited LSH re-partition (the caller backs off
+        instead of spinning on the limiter)."""
+        progress = False
+        with self._sync_lock:
+            dv = self._device_view
+            if dv is not None and dv[2] != self.state.y.get_version():
+                if not (self.sync.mode == "delta" and self._try_apply_delta(dv)):
+                    self._build_views_full()
+                progress = True
+            dv, uv = self._device_view, self._unit_view
+            if dv is not None and uv is not None and uv[2] != dv[2]:
+                # unit view diverged from the device view (a unit scatter
+                # failed after the device swap): rebuild it from the
+                # consistent device snapshot — normalization runs on
+                # device, no host re-upload
+                self._build_unit_view(dv[0], dv[1], dv[2], dv[3])
+                progress = True
+            pv = self._partition_view
+            if pv is not None and pv[2] != self.state.y.get_version():
+                if self.sync.mode == "delta" and self._try_partition_delta(pv):
+                    progress = True
+                # full re-partition is O(N.H.F): rate-limit like the old
+                # inline path so a delta-overflow storm can't spin it
+                # back-to-back
+                elif (time.monotonic() - self._partition_built_at
+                        >= _LSH_REFRESH_SEC):
+                    self._build_partition_view()
+                    progress = True
+        return progress
+
+    def _try_apply_delta(self, dv: tuple) -> bool:
+        """Apply a dirty-row delta to the device/host/unit views. Returns
+        False when only a full rebuild can serve (drift overflow, growth
+        past capacity, arena compaction). Call under _sync_lock."""
+        from oryx_tpu.ops.transfer import scatter_rows, scatter_transfer_bytes
+
+        t0 = time.monotonic()
+        y_dev, ids, _version, host_mat = dv
+        n_old = len(ids)
+        capacity = int(host_mat.shape[0])
+        delta = self.state.y.delta_since(
+            dv[2],
+            max_rows=max(1, int(self.sync.max_delta_fraction * max(n_old, 1))),
+        )
+        if delta is None or delta.n > capacity:
+            return False
+        if delta.rows.size == 0:
+            return True  # raced an already-applied version: nothing to do
+        rows, mat_rows = delta.rows, delta.mat
+        ids = _extend_ids(ids, delta)
+        if ids is None:
+            return False
+        # The host f32 mirror and cached norms update the SAME dirty rows
+        # in place — the deliberate snapshot relaxation of this design: a
+        # reader racing the assignment can see a dirty row one version
+        # newer (or, within the numpy row-write itself, a transiently
+        # mixed row) in the advisory f32 re-rank, never a torn
+        # matrix/ids pairing. Norms are written back-to-back with their
+        # vectors, BEFORE the slow device scatters below, so the window
+        # where a cosine host fallback could pair a new vector with its
+        # old cached norm is microseconds, not a device round-trip.
+        uv = self._unit_view
+        if uv is not None and uv[2] != dv[2]:
+            # the unit view diverged from the device view (a prior unit
+            # scatter failed mid-apply): this delta is relative to dv[2],
+            # and applying it to the older uv would skip the rows dirtied
+            # in between — leave it; _resync_once rebuilds it whole from
+            # the fresh device snapshot
+            uv = None
+        host_mat[rows] = mat_rows
+        if uv is not None:
+            norms = np.linalg.norm(mat_rows, axis=1)
+            uv[4][rows] = norms
+        # the scatter is NOT donated: in-flight coalesced dispatches
+        # (batcher _Pending.y) still score the old buffer, and donating
+        # it under them would turn every parked request into a
+        # deleted-array error. The functional form IS the double buffer —
+        # the old view tuple stays fully consistent until the swap below,
+        # at a transient cost of one extra matrix in HBM. Host->device
+        # traffic is the bucket-padded delta rows either way.
+        y_new = scatter_rows(y_dev, rows, mat_rows)
+        self._device_view = (y_new, ids, delta.version, host_mat)
+        n_bytes = scatter_transfer_bytes(rows.size, 2, self.state.features)
+        if uv is not None:
+            unit_rows = mat_rows / np.maximum(norms, 1e-12)[:, None]
+            unit_new = scatter_rows(uv[0], rows, unit_rows)
+            self._unit_view = (unit_new, ids, delta.version, host_mat, uv[4])
+            n_bytes += scatter_transfer_bytes(rows.size, 2, self.state.features)
+        self._note_resync(
+            "delta", int(rows.size), n_bytes,
+            time.monotonic() - t0, delta.version,
+        )
+        return True
+
+    def _try_partition_delta(self, pv: tuple) -> bool:
+        """Reassign only dirty rows between LSH partitions instead of
+        re-partitioning the whole store. Touched partitions get rebuilt
+        contiguous blocks; untouched partitions share their arrays with
+        the previous view. Call under _sync_lock."""
+        ids, parts, _version, pindex = pv
+        n_old = len(ids)
+        delta = self.state.y.delta_since(
+            pv[2],
+            max_rows=max(1, int(self.sync.max_delta_fraction * max(n_old, 1))),
+        )
+        if delta is None:
+            return False
+        if delta.rows.size == 0:
+            return True
+        t0 = time.monotonic()
+        rows, mat_rows = delta.rows, delta.mat
+        ids = _extend_ids(ids, delta)
+        if ids is None:
+            return False
+        new_parts_of_dirty = self._lsh.indices_for(
+            np.ascontiguousarray(mat_rows, dtype=np.float32)
+        )
+        parts = np.concatenate([parts, np.zeros(delta.n - n_old, dtype=parts.dtype)]) \
+            if delta.n > n_old else parts.copy()
+        old_parts_of_dirty = parts[rows]
+        parts[rows] = new_parts_of_dirty
+        touched = set(int(p) for p in old_parts_of_dirty[rows < n_old]) | set(
+            int(p) for p in new_parts_of_dirty
+        )
+        new_rows = list(pindex.rows)
+        new_mats = list(pindex.mats)
+        new_norms = list(pindex.norms)
+        vec_of = {int(r): mat_rows[j] for j, r in enumerate(rows)}
+        dirty_set = set(int(r) for r in rows)
+        for p in touched:
+            old_block_rows = pindex.rows[p]
+            keep = ~np.isin(old_block_rows, rows)
+            kept_rows = old_block_rows[keep]
+            kept_mat = pindex.mats[p][keep]
+            add = np.asarray(
+                sorted(r for r in dirty_set if parts[r] == p), dtype=np.int64
+            )
+            if add.size:
+                add_mat = np.stack([vec_of[int(r)] for r in add])
+                block_rows = np.concatenate([kept_rows, add])
+                block_mat = np.ascontiguousarray(
+                    np.concatenate([kept_mat, add_mat.astype(np.float32)])
+                )
+            else:
+                block_rows, block_mat = kept_rows, np.ascontiguousarray(kept_mat)
+            new_rows[p] = block_rows
+            new_mats[p] = block_mat
+            new_norms[p] = np.linalg.norm(block_mat, axis=1)
+        self._partition_view = (
+            ids, parts, delta.version,
+            _LshPartitions(rows=new_rows, mats=new_mats, norms=new_norms),
+        )
+        # no device traffic: pure host reindex — recorded as a delta
+        # resync with zero sync bytes so view freshness is still visible
+        self._note_resync(
+            "delta", int(rows.size), 0, time.monotonic() - t0, delta.version,
+        )
+        return True
 
     # -- queries -----------------------------------------------------------
 
@@ -325,14 +791,45 @@ class ALSServingModel(ServingModel):
         # a data-dependent k would recompile per exclusion-set size.
         k = min(n, how_many + len(exclude) + 8)
         # host_mat doubles as the wedged-device fallback: the batcher
-        # scores on the host if the accelerator transport hangs
+        # scores on the host if the accelerator transport hangs.
+        # valid_rows: the device matrix is capacity-padded past n (zero
+        # rows scatter-reserved for speed-layer growth); the batcher's
+        # FLOP accounting must not count the padding as scored work.
         fut = TopKBatcher.shared().submit_nowait(
             user_vector, k, y, host_mat=host_mat, cosine=cosine,
             host_norms=host_norms, recall=self.approx_recall,
+            valid_rows=n,
         )
 
         def _post(result):
             vals, idx = result
+            vals, idx = np.asarray(vals), np.asarray(idx)
+            if int(y.shape[0]) > n:
+                # capacity-padding rows score 0.0 (zero vectors) and enter
+                # the candidate set when fewer than k real scores beat 0.
+                # Dropping them keeps an EXACT prefix: every real row a
+                # pad displaced scored <= the pad's 0.0, so the kept rows
+                # are the true top-|kept| — the host rescore is needed
+                # only when the kept set can't fill the request after
+                # exclusions (pads ate into the non-slack candidates),
+                # not on every pad sighting (a per-request O(N.F) host
+                # matmul on mostly-negative queries would cliff exactly
+                # the traffic the device path exists for)
+                keep = idx < n
+                if not keep.all():
+                    vals, idx = vals[keep], idx[keep]
+                    # a rescorer may filter arbitrary candidates, which is
+                    # what the +8 over-fetch slack exists to absorb — with
+                    # one present, dropped pads must not eat that slack
+                    needed = k if rescorer is not None else how_many + len(exclude)
+                    if len(idx) < min(n, needed):
+                        vals, idx = host_topk(
+                            user_vector, k, host_mat[:n], cosine,
+                            host_norms[:n] if host_norms is not None else None,
+                        )
+                        return _trim_pairs(
+                            vals, idx, ids, how_many, exclude, rescorer
+                        )
             # The device scan selects candidates in bf16 (half the HBM
             # traffic of the memory-bound sweep); near-ties inside the
             # candidate set are then re-ranked EXACTLY by one vectorized
@@ -520,6 +1017,7 @@ class ALSServingModelManager(AbstractServingModelManager):
     def __init__(self, config: Config):
         super().__init__(config)
         self.als = ALSConfig.from_config(config)
+        self.sync = SyncConfig.from_config(config)
         self.model: ALSServingModel | None = None
         self._rescorer_provider = _load_rescorer_provider(config)
         configure_post_pool(
@@ -536,12 +1034,20 @@ class ALSServingModelManager(AbstractServingModelManager):
         prev = self.model.state if self.model is not None else None
         state = apply_update_message(prev, key, message, with_known_items=True)
         if state is not None and state is not prev:
+            old = self.model
             self.model = ALSServingModel(
                 state, sample_rate=self.als.sample_rate,
                 approx_recall=self.als.approx_recall,
                 num_cores=(self.als.candidate_partitions or None),
                 lsh_max_bits_differing=self.als.lsh_max_bits_differing,
+                sync=self.sync,
             )
+            if old is not None:
+                old.close()  # stop the replaced model's resync thread
+
+    def close(self) -> None:
+        if self.model is not None:
+            self.model.close()
 
 
 def _load_rescorer_provider(config: Config):

@@ -14,15 +14,45 @@ lazily (version-stamped) — queries are one matmul + top_k over the arena.
 from __future__ import annotations
 
 import threading
+from typing import NamedTuple
 
 import numpy as np
 
 from oryx_tpu.common.locks import AutoReadWriteLock
 
+# Dirty-row log bound: one (version, row) entry per factor write since the
+# oldest still-delta-servable view. Past this the log trims from the front
+# and views older than the trimmed tail fall back to a full resync — the
+# log must stay small next to the arena it describes (65536 entries ≈ 1 MB
+# vs a multi-GB factor matrix).
+DELTA_LOG_CAP = 65536
+
+
+class FactorDelta(NamedTuple):
+    """Rows written since a base version: everything a device-view holder
+    needs to catch up without copying the arena. ``rows`` are arena row
+    indices (sorted, deduplicated), ``mat`` their current vectors, ``ids``
+    their string ids row-aligned with ``rows`` (new rows appear here too —
+    a row only exists because a write logged it, so rows >= the holder's
+    old length extend its id list in index order), ``version`` the store
+    version the delta is consistent with, ``n`` the current row count."""
+
+    rows: np.ndarray  # [d] int64 arena row indices
+    mat: np.ndarray   # [d, K] float32 current vectors
+    ids: list[str]    # [d] string ids, row-aligned
+    version: int
+    n: int
+
 
 class FactorStore:
     """Append/update factor vectors keyed by string id, backed by a growing
-    arena so the whole store is one [N,K] matrix for device scoring."""
+    arena so the whole store is one [N,K] matrix for device scoring.
+
+    Every write also lands in a bounded dirty-row log so view holders can
+    ask for *just the rows that changed* since their version
+    (``delta_since``) instead of re-copying the arena — the TensorFlow
+    device-resident-variable + sparse-scatter pattern (PAPERS: TensorFlow,
+    2016) applied to the serving view."""
 
     def __init__(self, features: int):
         self.features = features
@@ -32,6 +62,37 @@ class FactorStore:
         self._n = 0
         self.version = 0
         self._lock = AutoReadWriteLock()
+        # dirty-row log: append-ordered (version, row) pairs. _delta_floor
+        # is the oldest base version delta_since can still serve; anything
+        # older (log trimmed, arena compacted by retain) must full-resync.
+        self.delta_log_cap = DELTA_LOG_CAP
+        self._dirty_log: list[tuple[int, int]] = []
+        self._delta_floor = 0
+
+    # -- dirty-row bookkeeping (call with the write lock held) --------------
+
+    def _log_rows(self, rows) -> None:
+        n_rows = len(rows)
+        if n_rows >= self.delta_log_cap:
+            # a write bigger than the whole log (bulk model load): every
+            # outstanding view needs a full resync anyway — invalidate
+            # instead of churning through cap-many appends
+            self._dirty_log.clear()
+            self._delta_floor = self.version
+            return
+        v = self.version
+        self._dirty_log.extend((v, int(r)) for r in rows)
+        overflow = len(self._dirty_log) - self.delta_log_cap
+        if overflow > 0:
+            # trimming the front abandons the oldest base versions: views
+            # at or below the last trimmed entry's version can no longer
+            # be served a complete delta
+            self._delta_floor = self._dirty_log[overflow - 1][0]
+            del self._dirty_log[:overflow]
+
+    def _invalidate_deltas(self) -> None:
+        self._dirty_log.clear()
+        self._delta_floor = self.version
 
     def set(self, ident: str, vector: np.ndarray) -> None:
         v = np.asarray(vector, dtype=np.float32)
@@ -50,6 +111,7 @@ class FactorStore:
                 self._n += 1
             self._arena[row] = v
             self.version += 1
+            self._log_rows((row,))
 
     def bulk_set(self, idents: list[str], matrix: np.ndarray) -> None:
         """Set many vectors in one arena write — the model-load fast path
@@ -78,6 +140,7 @@ class FactorStore:
                 rows[j] = row
             self._arena[rows] = m
             self.version += 1
+            self._log_rows(rows)
 
     def get(self, ident: str) -> np.ndarray | None:
         with self._lock.read():
@@ -127,6 +190,53 @@ class FactorStore:
         with self._lock.read():
             return self.version
 
+    def delta_since(
+        self, base_version: int, max_rows: int | None = None
+    ) -> FactorDelta | None:
+        """Rows written after ``base_version``, or None when only a full
+        resync can serve the caller: the base predates the dirty log's
+        floor (log trimmed, or the arena was compacted by ``retain``), or
+        the dirty set exceeds ``max_rows`` (past some fraction of the
+        store a delta costs more than the snapshot it replaces — the
+        caller's max-delta-fraction knob).
+
+        An up-to-date base returns an EMPTY delta, not None — None always
+        means "full resync required"."""
+        with self._lock.read():
+            if base_version < self._delta_floor:
+                return None
+            if base_version >= self.version:
+                return FactorDelta(
+                    np.zeros(0, dtype=np.int64),
+                    np.zeros((0, self.features), dtype=np.float32),
+                    [], self.version, self._n,
+                )
+            # the log is append-ordered by version: binary-search the
+            # first entry past the base instead of scanning the whole log
+            log_ = self._dirty_log
+            lo, hi = 0, len(log_)
+            while lo < hi:
+                mid = (lo + hi) // 2
+                if log_[mid][0] <= base_version:
+                    lo = mid + 1
+                else:
+                    hi = mid
+            rows = np.unique(
+                np.fromiter(
+                    (e[1] for e in log_[lo:]), dtype=np.int64,
+                    count=len(log_) - lo,
+                )
+            )
+            if max_rows is not None and rows.size > max_rows:
+                return None
+            return FactorDelta(
+                rows,
+                self._arena[rows],  # fancy indexing copies
+                [self._rev[int(r)] for r in rows],
+                self.version,
+                self._n,
+            )
+
     def index_of(self, ident: str) -> int | None:
         with self._lock.read():
             return self._ids.get(ident)
@@ -148,6 +258,9 @@ class FactorStore:
             self._rev = new_rev
             self._n = len(pairs)
             self.version += 1
+            # rows MOVED (compaction): old row indices no longer name the
+            # same vectors, so no outstanding delta can be served
+            self._invalidate_deltas()
 
 
 class SolverCache:

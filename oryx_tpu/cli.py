@@ -101,6 +101,20 @@ def _parse_args(argv):
         "oryx.serving.api.loops; 0 = one per CPU core)",
     )
     p.add_argument(
+        "--sync-mode", choices=["delta", "full", "blocking"], default=None,
+        help="serving: how device/host scoring views track live model "
+        "updates (overrides oryx.serving.api.sync.mode; delta = "
+        "dirty-row scatters applied by a background thread, full = "
+        "background snapshot rebuilds, blocking = inline rebuild on the "
+        "next query)",
+    )
+    p.add_argument(
+        "--sync-headroom", type=float, default=None,
+        help="serving: device-matrix row headroom fraction over the "
+        "current store size (overrides "
+        "oryx.serving.api.sync.capacity-headroom)",
+    )
+    p.add_argument(
         "--trace", action="store_true",
         help="enable request/generation span tracing "
         "(oryx.monitoring.tracing.enabled=true); inspect recorded spans "
@@ -448,7 +462,7 @@ def _pod_child_flags(raw_argv: list[str]) -> list[str]:
     value_opts = {
         "--compute", "--local-start", "--local-count", "--coordinator",
         "--conf", "--url", "--paths", "--rate", "--duration", "--workers",
-        "--pmml", "--set", "--loops",
+        "--pmml", "--set", "--loops", "--sync-mode", "--sync-headroom",
     }
     pod_only = {
         "--compute", "--local-start", "--local-count", "--coordinator",
@@ -944,6 +958,12 @@ def main(argv=None) -> int:
     if args.trace:
         # same sugar: tracing propagates to replica/pod children via --set
         args.set.append("oryx.monitoring.tracing.enabled=true")
+    if args.sync_mode is not None:
+        args.set.append(f"oryx.serving.api.sync.mode={args.sync_mode}")
+    if args.sync_headroom is not None:
+        args.set.append(
+            f"oryx.serving.api.sync.capacity-headroom={args.sync_headroom}"
+        )
     config = _build_config(args)
     _apply_platform_env(config)
     seed = config.get("oryx.test.seed", None)

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import logging
 import math
+import weakref
 from dataclasses import dataclass
 from functools import lru_cache, partial
 
@@ -654,23 +655,45 @@ def _row_pad(a: np.ndarray, n: int) -> np.ndarray:
 
 _prepared_lists_cache: dict = {}
 
+# Distinct (data object, list kind, params) entries kept at once. Eviction
+# normally rides weakref.finalize when the data object dies; the cap is
+# the backstop for non-weakrefable data objects (finalize refuses those)
+# and for long-lived processes cycling many live datasets — without it a
+# hyperparameter sweep over fresh InteractionData objects grows the cache
+# (and the multi-GB padded lists inside it) without bound.
+_PREPARED_LISTS_CAP = 16
+
 
 def _cached_lists(tag: str, data, params: tuple, build):
     """Memoize padded/bucketed list construction per InteractionData object
     (and scalar build parameters). The checkpointed trainer re-enters
     train_als once per chunk with the SAME data object; rebuilding the
     lists each chunk would repeat minutes of host work on large builds.
-    Entries die with the data object via weakref.finalize."""
-    import weakref
-
+    Entries die with the data object via weakref.finalize, or with the
+    oldest-entry cap for objects finalize can't track."""
     key = (id(data), tag, params)
     hit = _prepared_lists_cache.get(key)
     if hit is not None:
-        return hit
+        return hit[0]
     out = build()
-    if not any(k[0] == id(data) for k in _prepared_lists_cache):
-        weakref.finalize(data, _purge_prepared, id(data))
-    _prepared_lists_cache[key] = out
+    try:
+        weakref.ref(data)
+        # weakref-able: one finalizer per data object purges all its
+        # entries the moment it is collected
+        if not any(k[0] == id(data) for k in _prepared_lists_cache):
+            weakref.finalize(data, _purge_prepared, id(data))
+        pin = None
+    except TypeError:
+        # data isn't weakref-able (e.g. a slotted/plain-tuple stand-in in
+        # tests): cache anyway — but PIN the object in EVERY entry.
+        # Untracked, id(data) could be reused by a new object at the same
+        # address after this one dies, silently serving another dataset's
+        # lists; per-entry pins survive cap eviction of a sibling entry,
+        # and the cap bounds what the pins can keep alive.
+        pin = data
+    while len(_prepared_lists_cache) >= _PREPARED_LISTS_CAP:
+        _prepared_lists_cache.pop(next(iter(_prepared_lists_cache)))
+    _prepared_lists_cache[key] = (out, pin)
     return out
 
 

@@ -15,6 +15,7 @@ harden.
 
 from __future__ import annotations
 
+import math
 from functools import partial
 
 import numpy as np
@@ -111,6 +112,107 @@ class ChunkedMatrix:
         view) — row-local operations only; anything cross-chunk belongs
         in the merge step of the chunked kernel."""
         return ChunkedMatrix([fn(c) for c in self.chunks])
+
+
+# ---------------------------------------------------------------------------
+# incremental row sync: scatter dirty rows into an existing device matrix
+# instead of re-uploading it. The TensorFlow pattern of device-resident
+# mutable state updated by sparse scatters (PAPERS: TensorFlow, 2016):
+# host->device traffic is sized by the DELTA, not the matrix.
+# ---------------------------------------------------------------------------
+
+# delta row counts pad up this ladder so the jit cache holds a handful of
+# scatter programs, not one per distinct dirty-row count; padding entries
+# carry row index == buf rows and are dropped on device (mode="drop")
+SCATTER_PAD_BUCKETS = (64, 512, 4096, 32768)
+
+
+def _scatter_bucket(d: int) -> int:
+    for b in SCATTER_PAD_BUCKETS:
+        if d <= b:
+            return b
+    return 1 << max(0, (d - 1).bit_length())
+
+
+@jax.jit
+def _scatter(buf, rows, idx):
+    return buf.at[idx].set(rows, mode="drop")
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_donated(buf, rows, idx):
+    return buf.at[idx].set(rows, mode="drop")
+
+
+def scatter_rows(buf, idx: np.ndarray, rows: np.ndarray, *, donate: bool = False):
+    """Write ``rows`` into device matrix ``buf`` at row indices ``idx``,
+    returning the updated committed device array. Only the (bucket-padded)
+    delta rows cross the host->device link; out-of-range pad indices drop
+    on device.
+
+    donate=True updates in place (no transient second buffer in HBM) and
+    INVALIDATES ``buf`` — legal only when the caller holds the sole
+    reference. A serving view must NOT donate: in-flight coalesced
+    dispatches (serving/batcher.py _Pending.y) still read the old buffer,
+    and donating it under them turns every parked request into a
+    deleted-array error. The non-donated form is the double-buffer: old
+    view stays valid until the swap, at a transient cost of one extra
+    matrix in HBM.
+
+    A ChunkedMatrix scatters per chunk (only chunks owning dirty rows are
+    touched; untouched chunks are shared with the old view).
+    """
+    idx = np.asarray(idx, dtype=np.int32)
+    if idx.shape[0] == 0:
+        return buf
+    if isinstance(buf, ChunkedMatrix):
+        order = np.argsort(idx, kind="stable")
+        idx_s, rows_s = idx[order], np.asarray(rows)[order]
+        out, base = [], 0
+        for c in buf.chunks:
+            n_c = int(c.shape[0])
+            lo = np.searchsorted(idx_s, base)
+            hi = np.searchsorted(idx_s, base + n_c)
+            if lo == hi:
+                out.append(c)  # untouched chunk: shared, not copied
+            else:
+                out.append(
+                    scatter_rows(c, idx_s[lo:hi] - base, rows_s[lo:hi], donate=donate)
+                )
+            base += n_c
+        return ChunkedMatrix(out)
+    d = idx.shape[0]
+    b = _scatter_bucket(d)
+    idx_p = np.full(b, buf.shape[0], dtype=np.int32)  # pads drop on device
+    idx_p[:d] = idx
+    rows_p = np.zeros((b,) + tuple(buf.shape[1:]), dtype=buf.dtype)
+    rows_p[:d] = np.asarray(rows, dtype=buf.dtype)
+    fn = _scatter_donated if donate else _scatter
+    return jax.block_until_ready(
+        fn(buf, jnp.asarray(rows_p), jnp.asarray(idx_p))
+    )
+
+
+def scatter_transfer_bytes(d: int, row_itemsize: int, features: int) -> int:
+    """Host->device bytes one scatter_rows call moves for ``d`` dirty rows
+    (bucket padding included — the honest wire figure the
+    oryx_device_sync_bytes metric reports)."""
+    if d == 0:
+        return 0
+    b = _scatter_bucket(d)
+    return b * (features * row_itemsize + np.dtype(np.int32).itemsize)
+
+
+def row_capacity(n: int, headroom: float) -> int:
+    """Device-view row capacity for an ``n``-row store: ``n`` grown by
+    ``headroom`` then rounded up a ~N/8-granular bucket ladder, so
+    speed-layer growth neither reallocates the device matrix nor changes
+    the batcher's compiled dispatch shapes until a bucket boundary.
+    Monotone in ``n``; pure-pow2 rounding would waste up to 2x HBM at
+    20M-row scale, so buckets step geometrically instead."""
+    target = max(64, math.ceil(n * (1.0 + max(0.0, headroom))))
+    unit = 1 << max(6, target.bit_length() - 3)
+    return -(-target // unit) * unit
 
 
 def device_put_maybe_chunked(

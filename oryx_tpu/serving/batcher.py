@@ -148,11 +148,11 @@ def host_topk(
 class _Pending:
     __slots__ = (
         "vec", "k", "y", "future", "host_mat", "cosine", "host_norms",
-        "recall", "t_enq", "trace_parent", "dev_span",
+        "recall", "valid_rows", "t_enq", "trace_parent", "dev_span",
     )
 
     def __init__(self, vec, k, y, future, host_mat=None, cosine=False,
-                 host_norms=None, recall=1.0):
+                 host_norms=None, recall=1.0, valid_rows=None):
         self.vec = vec
         self.k = k
         self.y = y
@@ -161,6 +161,10 @@ class _Pending:
         self.cosine = cosine
         self.host_norms = host_norms
         self.recall = recall
+        # rows of y that hold real data: a capacity-padded serving view
+        # (apps/als/serving.py) scatter-reserves rows past this for
+        # speed-layer growth, and FLOP accounting must not count them
+        self.valid_rows = valid_rows
         # tracing (only populated while tracing is enabled): enqueue time
         # for the queue-wait span, the submitting request's span as
         # parent, and a one-element box holding the in-flight device span
@@ -360,6 +364,7 @@ class TopKBatcher:
         cosine: bool = False,
         host_norms: np.ndarray | None = None,
         recall: float = 1.0,
+        valid_rows: int | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Score vec against device matrix y, returning (values, indices)
         for the top-k rows. Blocks until the coalesced dispatch completes.
@@ -367,11 +372,13 @@ class TopKBatcher:
         host_mat (the row-aligned f32 host copy of y) enables degraded
         host-side scoring when the device transport is wedged; host_norms
         caches its row norms for cosine fallbacks. recall < 1 selects the
-        approximate device kernel (host fallback stays exact).
+        approximate device kernel (host fallback stays exact). valid_rows
+        marks the real-data prefix of a capacity-padded matrix (FLOP
+        accounting only; the caller filters padding indices from results).
         """
         return self.submit_nowait(
             vec, k, y, host_mat=host_mat, cosine=cosine,
-            host_norms=host_norms, recall=recall,
+            host_norms=host_norms, recall=recall, valid_rows=valid_rows,
         ).result()
 
     def submit_nowait(
@@ -383,6 +390,7 @@ class TopKBatcher:
         cosine: bool = False,
         host_norms: np.ndarray | None = None,
         recall: float = 1.0,
+        valid_rows: int | None = None,
     ) -> Future:
         """submit() without the wait: returns the Future of (values,
         indices). Deferred endpoints chain post-processing onto it instead
@@ -391,7 +399,7 @@ class TopKBatcher:
         fut: Future = Future()
         p = _Pending(
             vec, int(k), y, fut, host_mat, cosine, host_norms,
-            float(recall),
+            float(recall), valid_rows,
         )
         if _TRACER.enabled:
             # parent = the submitting request's span (thread-current, set
@@ -536,9 +544,16 @@ class TopKBatcher:
                 y = group[0].y
                 self._last_y = y  # recovery probes re-test against this
                 b = len(group)
-                self.flops_scored += 2.0 * b * y.shape[0] * y.shape[1]
+                # a capacity-padded serving view scores zero rows past
+                # valid_rows — they're HBM-cheap but not useful FLOPs, so
+                # the MFU figure counts only the real-data prefix
+                n_rows = group[0].valid_rows or y.shape[0]
+                self.flops_scored += 2.0 * b * n_rows * y.shape[1]
                 self._note_device(y)
                 padded = _pad_rows(b, self._on_accel)
+                # keyed on the FULL (capacity) shape: the serving view
+                # pads rows up a bucket ladder precisely so store growth
+                # keeps hitting these compiled entries
                 shape_key = (
                     padded, kb, recall, tuple(y.shape),
                     str(getattr(y, "dtype", "")),

@@ -35,8 +35,12 @@ def _how_many(req: Request, default: int = 10) -> tuple[int, int]:
         offset = int(req.q1("offset", "0"))
     except ValueError as e:
         raise OryxServingException(400, f"bad howMany/offset: {e}") from None
-    if how_many <= 0 or offset < 0:
+    # separate checks so the 400 names the parameter that's actually
+    # wrong — a negative offset used to be blamed on howMany
+    if how_many <= 0:
         raise OryxServingException(400, "howMany must be positive")
+    if offset < 0:
+        raise OryxServingException(400, "offset must not be negative")
     return how_many, offset
 
 def _page(pairs, how_many, offset):

@@ -1,0 +1,546 @@
+"""Incremental device-view sync: FactorStore dirty-row deltas, the
+background resync thread's delta/full application, capacity-padded device
+views, and the update-storm serving smoke (queries under a live
+speed-layer write stream must see zero 5xx and delta-sized syncs)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from oryx_tpu.apps.als.serving import ALSServingModel, SyncConfig
+from oryx_tpu.apps.als.state import ALSState, FactorStore
+
+
+def _store(n=20, k=4, seed=0):
+    rng = np.random.default_rng(seed)
+    fs = FactorStore(k)
+    fs.bulk_set(
+        [f"r{j}" for j in range(n)],
+        rng.standard_normal((n, k)).astype(np.float32),
+    )
+    return fs
+
+
+# ---------------------------------------------------------------------------
+# FactorStore delta tracking
+# ---------------------------------------------------------------------------
+
+def test_delta_since_tracks_dirty_rows_and_new_ids():
+    fs = _store()
+    v0 = fs.get_version()
+    fs.set("r3", np.ones(4, dtype=np.float32))
+    fs.set("r7", np.full(4, 2.0, dtype=np.float32))
+    fs.set("brand-new", np.full(4, 3.0, dtype=np.float32))
+    d = fs.delta_since(v0)
+    assert d is not None
+    assert sorted(d.ids) == ["brand-new", "r3", "r7"]
+    assert d.n == 21 and d.version == fs.get_version()
+    # vectors in the delta are the CURRENT rows
+    by_id = dict(zip(d.ids, d.mat))
+    np.testing.assert_array_equal(by_id["r7"], np.full(4, 2.0))
+    # an up-to-date base yields an EMPTY delta, never None
+    empty = fs.delta_since(fs.get_version())
+    assert empty is not None and empty.rows.size == 0
+
+
+def test_delta_since_dedupes_rewrites():
+    fs = _store()
+    v0 = fs.get_version()
+    for j in range(5):
+        fs.set("r1", np.full(4, float(j), dtype=np.float32))
+    d = fs.delta_since(v0)
+    assert d.rows.size == 1 and d.ids == ["r1"]
+    np.testing.assert_array_equal(d.mat[0], np.full(4, 4.0))
+
+
+def test_delta_overflow_falls_back_to_full():
+    fs = _store()
+    fs.delta_log_cap = 8
+    v0 = fs.get_version()
+    for j in range(12):  # > cap distinct rows: trims the log past v0
+        fs.set(f"r{j}", np.zeros(4, dtype=np.float32))
+    assert fs.delta_since(v0) is None
+    # a write bigger than the whole log invalidates in one step
+    fs2 = _store()
+    fs2.delta_log_cap = 8
+    v0 = fs2.get_version()
+    fs2.bulk_set(
+        [f"r{j}" for j in range(12)], np.zeros((12, 4), dtype=np.float32)
+    )
+    assert fs2.delta_since(v0) is None
+    # but a fresh view at the CURRENT version can delta again
+    v1 = fs2.get_version()
+    fs2.set("r0", np.ones(4, dtype=np.float32))
+    assert fs2.delta_since(v1) is not None
+
+
+def test_delta_max_rows_and_retain_invalidate():
+    fs = _store()
+    v0 = fs.get_version()
+    for j in range(6):
+        fs.set(f"r{j}", np.zeros(4, dtype=np.float32))
+    assert fs.delta_since(v0, max_rows=5) is None
+    assert fs.delta_since(v0, max_rows=6) is not None
+    # retain() compacts the arena: rows move, no delta can be served
+    fs.retain({f"r{j}" for j in range(10)})
+    assert fs.delta_since(v0) is None
+
+
+def test_concurrent_writer_vs_snapshot_delta_consistency():
+    """A writer hammering set() while a reader pairs snapshot() with
+    delta_since(): whenever the two land on the same version, replaying
+    the delta onto the snapshot must reproduce the store exactly."""
+    fs = _store(n=30, k=6)
+    stop = threading.Event()
+
+    def writer():
+        j = 0
+        while not stop.is_set():
+            fs.set(f"r{j % 40}", np.full(6, float(j), dtype=np.float32))
+            j += 1
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    matched = 0
+    try:
+        for _ in range(500):
+            mat1, ids1, v1 = fs.snapshot()
+            d = fs.delta_since(v1)
+            if d is None:
+                continue
+            mat2, ids2, v2 = fs.snapshot()
+            if d.version != v2:
+                continue  # writer advanced between the calls: retry
+            # replay the delta onto the older snapshot
+            rebuilt = np.zeros((d.n, 6), dtype=np.float32)
+            rebuilt[: len(ids1)] = mat1
+            rebuilt[d.rows] = d.mat
+            np.testing.assert_array_equal(rebuilt, mat2)
+            new_ids = list(ids1)
+            by_row = dict(zip((int(r) for r in d.rows), d.ids))
+            for r in range(len(ids1), d.n):
+                new_ids.append(by_row[r])
+            assert new_ids == ids2
+            matched += 1
+            if matched >= 5:
+                break
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert matched >= 1, "never caught a (delta, snapshot) version match"
+
+
+def test_scatter_rows_chunked_shares_untouched_chunks_and_donates():
+    import jax.numpy as jnp
+
+    from oryx_tpu.ops.transfer import ChunkedMatrix, scatter_rows
+
+    base = np.arange(24, dtype=np.float32).reshape(12, 2)
+    cm = ChunkedMatrix(
+        [jnp.asarray(base[:5]), jnp.asarray(base[5:9]), jnp.asarray(base[9:])]
+    )
+    idx = np.array([0, 4, 11])  # touches chunks 0 and 2, never 1
+    rows = -np.ones((3, 2), dtype=np.float32)
+    out = scatter_rows(cm, idx, rows)
+    expect = base.copy()
+    expect[idx] = -1.0
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(c) for c in out.chunks]), expect
+    )
+    # the untouched middle chunk is SHARED with the old view, not copied
+    assert out.chunks[1] is cm.chunks[1]
+    # empty delta returns the buffer unchanged
+    assert scatter_rows(cm, np.zeros(0, dtype=np.int64), rows[:0]) is cm
+    # donated form: caller owns the sole reference, update lands in place
+    buf = jnp.asarray(base)
+    out2 = scatter_rows(buf, idx, rows, donate=True)
+    np.testing.assert_array_equal(np.asarray(out2), expect)
+
+
+# ---------------------------------------------------------------------------
+# serving model: delta resync, capacity, device-vs-host equality
+# ---------------------------------------------------------------------------
+
+def _als_model(n=64, k=8, seed=2, **kw):
+    rng = np.random.default_rng(seed)
+    st = ALSState(k, implicit=True)
+    st.y.bulk_set(
+        [f"i{j}" for j in range(n)],
+        rng.standard_normal((n, k)).astype(np.float32),
+    )
+    st.x.bulk_set(["u0"], rng.standard_normal((1, k)).astype(np.float32))
+    st.set_expected(["u0"], [f"i{j}" for j in range(n)])
+    return st, ALSServingModel(st, **kw)
+
+
+def _wait_synced(model, timeout=10.0):
+    q = np.ones(model.state.features, dtype=np.float32)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if (model.served_version() or -1) >= model.state.y.get_version():
+            return True
+        model.top_n(q, 3)  # queries observe drift and request resync
+        time.sleep(0.01)
+    return False
+
+
+def _wait_resync_kind(model, kind, timeout=5.0):
+    """The view swap is visible BEFORE last_resync is recorded (the swap
+    is the latency-critical step; the note trails it), so tests that
+    assert on the kind must wait for the note, not just the version."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        lr = model.last_resync
+        if lr is not None and lr["kind"] == kind:
+            return lr
+        time.sleep(0.01)
+    return model.last_resync
+
+
+def test_background_delta_resync_reaches_queries():
+    st, model = _als_model()
+    q = np.ones(8, dtype=np.float32)
+    model.top_n(q, 5)  # first query builds the (capacity-padded) view
+    cap = int(model._device_view[0].shape[0])
+    assert cap > 64  # headroom reserved for speed-layer growth
+    st.y.set("fresh", (q * 50).astype(np.float32))
+    assert _wait_synced(model)
+    assert _wait_resync_kind(model, "delta")["kind"] == "delta"
+    assert model.top_n(q, 5)[0][0] == "fresh"
+    # the device buffer shape did NOT change: growth landed in reserved
+    # capacity, so the batcher's compiled dispatch shape is stable
+    assert int(model._device_view[0].shape[0]) == cap
+    # and the sync was delta-sized: exactly one minimum scatter bucket
+    # (the padded form of a single dirty row), not a matrix re-upload
+    from oryx_tpu.ops.transfer import scatter_transfer_bytes
+
+    assert model.last_resync["bytes"] == scatter_transfer_bytes(1, 2, 8)
+    model.close()
+
+
+def test_device_and_host_views_row_equal_after_delta_scatter():
+    # fraction raised so the 12-row burst below stays on the delta path
+    # (at the 0.2 default it would correctly fall back to full: 12 > 10)
+    st, model = _als_model(n=50, sync=SyncConfig(max_delta_fraction=0.5))
+    q = np.ones(8, dtype=np.float32)
+    model.top_n(q, 5)
+    model.top_n(q, 5, cosine=True)  # materialize the unit view too
+    rng = np.random.default_rng(7)
+    for j in range(12):  # updates + growth, all within capacity
+        st.y.set(f"i{j}" if j < 8 else f"g{j}",
+                 rng.standard_normal(8).astype(np.float32))
+    assert _wait_synced(model)
+    assert _wait_resync_kind(model, "delta")["kind"] == "delta"
+    y_dev, ids, version, host_mat = model._device_view
+    n = len(ids)
+    dev = np.asarray(y_dev).astype(np.float32)
+    import jax.numpy as jnp
+
+    # every valid row of the device view equals the host mirror rounded
+    # to the device dtype (bf16); capacity padding stays zero
+    np.testing.assert_array_equal(
+        dev[:n], np.asarray(host_mat[:n].astype(jnp.bfloat16), dtype=np.float32)
+    )
+    assert not dev[n:].any()
+    # host mirror rows match the store exactly
+    for j, ident in enumerate(ids):
+        np.testing.assert_array_equal(host_mat[j], st.y.get(ident))
+    # unit view norms cache matches the mirror
+    unit = model._unit_view
+    assert unit is not None and unit[2] == version
+    np.testing.assert_allclose(
+        unit[4][:n], np.linalg.norm(host_mat[:n], axis=1), rtol=1e-6
+    )
+    model.close()
+
+
+def test_unit_view_recovers_after_failed_unit_scatter(monkeypatch):
+    """A unit-view scatter failing AFTER the device-view swap must not
+    strand the cosine view: the resync loop detects the divergence and
+    rebuilds the unit view from the fresh device snapshot (regression:
+    the diverged unit view used to be served forever, and the next delta
+    would stamp it with a version whose rows it never received)."""
+    import oryx_tpu.ops.transfer as transfer
+
+    st, model = _als_model(n=40)
+    q = np.ones(8, dtype=np.float32)
+    model.top_n(q, 5)
+    model.top_n(q, 5, cosine=True)  # materialize the unit view
+    real_scatter = transfer.scatter_rows
+    calls = {"n": 0}
+
+    def flaky(buf, idx, rows, **kw):
+        calls["n"] += 1
+        if calls["n"] == 2:  # 1st = device Y scatter, 2nd = unit scatter
+            raise RuntimeError("injected unit-scatter failure")
+        return real_scatter(buf, idx, rows, **kw)
+
+    monkeypatch.setattr(transfer, "scatter_rows", flaky)
+    st.y.set("fresh", (q * 40).astype(np.float32))
+    # recovery crosses the resync loop's 0.5s failure backoff
+    assert _wait_synced(model, timeout=15.0)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        uv, dv = model._unit_view, model._device_view
+        if uv is not None and uv[2] == dv[2]:
+            break
+        model.top_n(q, 3, cosine=True)
+        time.sleep(0.05)
+    uv, dv = model._unit_view, model._device_view
+    assert uv[2] == dv[2]
+    assert model.top_n(q, 5, cosine=True)[0][0] == "fresh"
+    model.close()
+
+
+def test_capacity_growth_rebucketing_full_resync():
+    st, model = _als_model(n=60, sync=SyncConfig(capacity_headroom=0.05))
+    q = np.ones(8, dtype=np.float32)
+    model.top_n(q, 5)
+    cap = int(model._device_view[0].shape[0])
+    rng = np.random.default_rng(9)
+    for j in range(cap):  # grow past capacity
+        st.y.set(f"grow{j}", rng.standard_normal(8).astype(np.float32))
+    assert _wait_synced(model)
+    new_cap = int(model._device_view[0].shape[0])
+    assert _wait_resync_kind(model, "full")["kind"] == "full"
+    assert new_cap > cap and new_cap >= len(model._device_view[1])
+    model.close()
+
+
+def test_padded_view_correct_when_scores_negative():
+    """Capacity-padding rows score 0.0 and would outrank all-negative real
+    scores — the post-filter + exact host backstop must keep results
+    identical to an unpadded (blocking-mode) model."""
+    rng = np.random.default_rng(3)
+    k = 6
+    st = ALSState(k, implicit=True)
+    # every item's dot with the all-ones query is strictly negative
+    st.y.bulk_set(
+        [f"i{j}" for j in range(10)],
+        -np.abs(rng.standard_normal((10, k))).astype(np.float32),
+    )
+    padded = ALSServingModel(st)
+    plain = ALSServingModel(st, sync=SyncConfig(mode="blocking"))
+    q = np.ones(k, dtype=np.float32)
+    assert int(padded._y_view_full()[0].shape[0]) > 10
+    assert padded.top_n(q, 7) == plain.top_n(q, 7)
+    assert padded.top_n(q, 7, cosine=True) == plain.top_n(q, 7, cosine=True)
+    padded.close()
+    plain.close()
+
+
+def test_padded_view_keeps_overfetch_slack_for_filtering_rescorer():
+    """With a filtering rescorer, dropped capacity pads must not eat the
+    +8 over-fetch slack: the padded model must return the same (full)
+    result set as an unpadded one (regression: the backstop threshold
+    once ignored the slack and returned short counts)."""
+    rng = np.random.default_rng(6)
+    k = 6
+    st = ALSState(k, implicit=True)
+    mat = rng.standard_normal((20, k)).astype(np.float32)
+    mat[12:] = -np.abs(mat[12:])  # 8 rows score negative for q = ones
+    mat[:12] = np.abs(mat[:12])
+    st.y.bulk_set([f"i{j}" for j in range(20)], mat)
+    padded = ALSServingModel(st)
+    plain = ALSServingModel(st, sync=SyncConfig(mode="blocking"))
+    q = np.ones(k, dtype=np.float32)
+    top3 = {i for i, _ in plain.top_n(q, 3)}
+
+    class DropTop:
+        def is_filtered(self, ident):
+            return ident in top3
+
+        def rescore(self, ident, score):
+            return score
+
+    got_padded = padded.top_n(q, 10, rescorer=DropTop())
+    got_plain = plain.top_n(q, 10, rescorer=DropTop())
+    assert len(got_padded) == 10
+    # same items in the same order; scores agree to BLAS reduction-order
+    # noise (the backstop's matrix-vector product vs the re-rank's
+    # gathered-rows product round differently in the last ulp)
+    assert [i for i, _ in got_padded] == [i for i, _ in got_plain]
+    np.testing.assert_allclose(
+        [s for _, s in got_padded], [s for _, s in got_plain], rtol=1e-5
+    )
+    padded.close()
+    plain.close()
+
+
+def test_lsh_partition_delta_reassigns_only_dirty_rows():
+    rng = np.random.default_rng(5)
+    st = ALSState(8, implicit=True)
+    st.y.bulk_set(
+        [f"i{j}" for j in range(400)],
+        rng.standard_normal((400, 8)).astype(np.float32),
+    )
+    model = ALSServingModel(st, sample_rate=0.5, num_cores=4)
+    q = rng.standard_normal(8).astype(np.float32)
+    model.top_n(q, 10)
+    st.y.set("hot", (q * 30).astype(np.float32))
+    deadline = time.monotonic() + 10
+    while (
+        time.monotonic() < deadline
+        and model._partition_view[2] < st.y.get_version()
+    ):
+        model.top_n(q, 10)
+        time.sleep(0.01)
+    assert _wait_resync_kind(model, "delta")["kind"] == "delta"
+    assert model.top_n(q, 10)[0][0] == "hot"
+    # partition index stays a partition: every row in exactly one block,
+    # blocks row-aligned with their matrices and assignments
+    ids, parts, _v, pindex = model._partition_view
+    allrows = np.concatenate(pindex.rows)
+    assert sorted(allrows.tolist()) == list(range(len(ids)))
+    for p, (r, m) in enumerate(zip(pindex.rows, pindex.mats)):
+        assert m.shape[0] == r.size
+        assert (parts[r] == p).all()
+    model.close()
+
+
+# ---------------------------------------------------------------------------
+# update-storm smoke: HTTP queries under a live speed-layer write stream
+# ---------------------------------------------------------------------------
+
+def _scrape(base: str, name: str) -> dict[str, float]:
+    with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+        text = r.read().decode()
+    out = {}
+    for line in text.splitlines():
+        if line.startswith(name) and " " in line:
+            key, val = line.rsplit(" ", 1)
+            out[key[len(name):]] = float(val)
+    return out
+
+
+def test_update_storm_smoke_zero_5xx_monotone_generation_delta_sync():
+    """The acceptance smoke: /recommend under a continuous UP stream must
+    serve zero 5xx, oryx_model_generation must be monotone across MODEL
+    publishes, and at least one kind=delta view resync must happen (with
+    kind=full staying at its initial-load count)."""
+    from oryx_tpu.apps.als.serving import ALSServingModelManager
+    from oryx_tpu.bus.broker import get_broker, topics
+    from oryx_tpu.bus.inproc import InProcBroker
+    from oryx_tpu.common.artifact import ModelArtifact
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.common.freshness import publish_stamp
+    from oryx_tpu.common.metrics import get_registry
+    from oryx_tpu.serving.server import ServingLayer
+
+    InProcBroker.reset_all()
+    rng = np.random.default_rng(11)
+    n, k = 300, 8
+    cfg = load_config(overlay={
+        "oryx.id": "storm",
+        "oryx.input-topic.broker": "mem://storm",
+        "oryx.update-topic.broker": "mem://storm",
+        "oryx.serving.api.port": 0,
+        "oryx.serving.api.read-only": True,
+        "oryx.serving.init-topics": True,
+        "oryx.serving.application-resources": [
+            "oryx_tpu.serving.resources.common",
+            "oryx_tpu.serving.resources.als",
+        ],
+        "oryx.als.hyperparams.features": k,
+    })
+    topics.maybe_create("mem://storm", "OryxUpdate", partitions=1)
+    topics.maybe_create("mem://storm", "OryxInput", partitions=1)
+    broker = get_broker("mem://storm")
+
+    def publish_model(generation: int) -> None:
+        art = ModelArtifact(app="als", tensors={
+            "X": rng.standard_normal((4, k)).astype(np.float32),
+            "Y": rng.standard_normal((n, k)).astype(np.float32),
+        })
+        art.set_extension("features", str(k))
+        art.set_extension("implicit", "true")
+        art.set_extension("XIDs", [f"u{j}" for j in range(4)])
+        art.set_extension("YIDs", [f"i{j}" for j in range(n)])
+        broker.send("OryxUpdate", "MODEL", art.to_string())
+        broker.send("OryxUpdate", "TRACE", json.dumps(
+            {"published_ms": int(time.time() * 1000),
+             "generation": generation}
+        ))
+
+    gen1 = int(time.time() * 1000)
+    publish_model(gen1)
+
+    reg = get_registry()
+    delta_before = reg.counter("oryx_view_resync_total").value(kind="delta")
+
+    manager = ALSServingModelManager(cfg)
+    serving = ServingLayer(cfg, model_manager=manager)
+    serving.start()
+    base = f"http://127.0.0.1:{serving.port}"
+    statuses: list[int] = []
+    try:
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:  # wait for readiness
+            try:
+                with urllib.request.urlopen(f"{base}/ready", timeout=5) as r:
+                    if r.status == 200:
+                        break
+            except Exception:
+                pass
+            time.sleep(0.1)
+
+        full_baseline = reg.counter("oryx_view_resync_total").value(kind="full")
+        gens: list[float] = []
+        stop = threading.Event()
+
+        def writer():
+            j = 0
+            while not stop.is_set():
+                vec = rng.standard_normal(k).astype(np.float32)
+                broker.send(
+                    "OryxUpdate", "UP",
+                    json.dumps(["Y", f"i{j % n}", [float(x) for x in vec]]),
+                )
+                j += 1
+                time.sleep(0.002)
+
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+        t_end = time.monotonic() + 4.0
+        republished = False
+        while time.monotonic() < t_end:
+            try:
+                with urllib.request.urlopen(
+                    f"{base}/recommend/u0?howMany=5", timeout=10
+                ) as r:
+                    statuses.append(r.status)
+            except urllib.error.HTTPError as e:
+                statuses.append(e.code)
+            gens.append(_scrape(base, "oryx_model_generation").get("", 0.0))
+            if not republished and time.monotonic() > t_end - 2.0:
+                publish_model(gen1 + 1000)  # generation must advance
+                republished = True
+        stop.set()
+        wt.join(timeout=5)
+
+        assert statuses and all(s < 500 for s in statuses), statuses[:20]
+        # monotone, non-zero generation that eventually advances
+        gs = [g for g in gens if g]
+        assert gs == sorted(gs)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if _scrape(base, "oryx_model_generation").get("", 0.0) >= gen1 + 1000:
+                break
+            time.sleep(0.1)
+        assert _scrape(base, "oryx_model_generation").get("", 0.0) >= gen1 + 1000
+        # delta-sized syncing actually happened...
+        delta_after = reg.counter("oryx_view_resync_total").value(kind="delta")
+        assert delta_after > delta_before
+        # ...and rides deltas, not repeated full rebuilds: full resyncs
+        # during the storm stay at the (re)load count — one per MODEL
+        # publish that rebuilt a view, nothing per-UP
+        full_after = reg.counter("oryx_view_resync_total").value(kind="full")
+        assert full_after - full_baseline <= 2
+        assert reg.counter("oryx_device_sync_bytes").value() > 0
+    finally:
+        serving.close()
+        InProcBroker.reset_all()
