@@ -792,6 +792,161 @@ def _train_once(
     return row
 
 
+def _bench_generations_body() -> None:
+    """Generation-cadence stage: three consecutive batch generations over
+    a growing history through the REAL BatchLayer + ALSUpdate, measuring
+    what the incremental aggregate snapshot + warm-start path buys over
+    the from-scratch rebuild the paper describes. Generation 1 bootstraps
+    a large history (full rebuild by construction — no snapshot exists);
+    generations 2 and 3 ingest small windows and must run incrementally.
+    Reports gen1_full_seconds, genN_incremental_seconds (gen 3 = steady
+    state, jit-warm), gen_incremental_speedup, warm_start_iters_saved,
+    and warm-vs-cold AUC parity on a held-out probe set (the acceptance
+    bar: speedup >= 3x at AUC within 0.5%, zero kind="full" builds after
+    generation 1)."""
+    import numpy as np
+    import jax
+
+    from oryx_tpu.apps.als.batch import ALSUpdate
+    from oryx_tpu.bus.broker import get_broker, topics
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.common.metrics import get_registry
+    from oryx_tpu.common.rng import RandomManager
+    from oryx_tpu.layers.batch import BatchLayer
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    if on_accel:
+        n_users, n_items, hist_events, win_events = 60_000, 20_000, 3_000_000, 60_000
+        features, iterations = 30, 10
+    else:
+        n_users, n_items, hist_events, win_events = 3_000, 1_500, 200_000, 5_000
+        features, iterations = 20, 10
+
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="oryx-bench-gen-")
+    RandomManager.use_test_seed(11)
+    cfg = load_config(overlay={
+        "oryx.id": "benchgen",
+        "oryx.input-topic.broker": "mem://benchgen",
+        "oryx.update-topic.broker": "mem://benchgen",
+        "oryx.batch.storage.data-dir": f"{tmp}/data",
+        "oryx.batch.storage.model-dir": f"{tmp}/model",
+        "oryx.als.hyperparams.features": features,
+        "oryx.als.hyperparams.iterations": iterations,
+        "oryx.als.hyperparams.alpha": 10.0,
+        "oryx.als.hyperparams.lambda": 0.01,
+        "oryx.ml.eval.test-fraction": 0.1,
+    })
+    topics.maybe_create("mem://benchgen", "OryxInput", partitions=2)
+    topics.maybe_create("mem://benchgen", "OryxUpdate", partitions=1)
+    upd = ALSUpdate(cfg)
+    layer = BatchLayer(cfg, update=upd)
+    layer.ensure_streams()
+    broker = get_broker("mem://benchgen")
+    rng = np.random.default_rng(5)
+    base_ts = 1_700_000_000_000
+
+    def synth(n: int, t0: int) -> list[str]:
+        # Zipf-skewed items, log-normal user activity — the ML-25M-ish
+        # shape the training bench synthesizes, scaled down
+        us = rng.integers(0, n_users, n)
+        its = np.minimum(
+            (rng.pareto(1.2, n) * n_items / 20).astype(np.int64), n_items - 1
+        )
+        return [
+            f"u{u},i{i},{1 + int(v)},{t0 + j}"
+            for j, (u, i, v) in enumerate(zip(us, its, rng.poisson(1.0, n)))
+        ]
+
+    def feed(n: int, t0: int) -> list[str]:
+        lines = synth(n, t0)
+        broker.send_batch("OryxInput", [(None, ln) for ln in lines])
+        return lines
+
+    reg = get_registry()
+    inc = reg.counter("oryx_batch_incremental_total")
+    fed: list[str] = []
+
+    def generation(n_events: int, gen_ts: int) -> float:
+        fed.extend(feed(n_events, gen_ts - n_events * 2))
+        t0 = time.perf_counter()
+        layer.run_generation(timestamp_ms=gen_ts)
+        return time.perf_counter() - t0
+
+    gen1_s = generation(hist_events, base_ts + 1_000_000)
+    gen2_s = generation(win_events, base_ts + 2_000_000)
+    gen3_s = generation(win_events, base_ts + 3_000_000)
+    warm_iters = reg.gauge("oryx_batch_warm_iterations").value()
+    full_total = inc.value(kind="full")
+    delta_total = inc.value(kind="delta")
+    # gen 1 is the one legitimate full build; anything beyond it means a
+    # generation fell back (stale/drift/mismatch) — the acceptance scalar
+    full_after_1 = full_total - 1
+
+    # quality parity: warm-started gen-3 model vs a cold train over the
+    # SAME full history, both scored on one held-out probe window (probe
+    # lines are synthesized only — never sent to the input topic, so no
+    # later generation can train on them)
+    from oryx_tpu.bus.api import KeyMessage
+
+    n_history = len(fed)
+    probe = [KeyMessage(None, ln) for ln in synth(max(2000, win_events // 2),
+                                                  base_ts + 4_000_000)]
+    from oryx_tpu.common.artifact import ModelArtifact
+    from oryx_tpu.common.ioutil import list_generation_dirs
+
+    warm_art = ModelArtifact.read(list_generation_dirs(f"{tmp}/model")[-1])
+    warm_auc = upd.evaluate(warm_art, [], probe)
+    cold_cfg = cfg.overlay({"oryx.batch.storage.incremental.enabled": False})
+    cold_upd = ALSUpdate(cold_cfg)
+    t_cold = time.perf_counter()
+    cold_art = cold_upd.build_model(
+        [KeyMessage(None, ln) for ln in fed],
+        {"features": features, "lambda": 0.01, "alpha": 10.0},
+    )
+    cold_s = time.perf_counter() - t_cold
+    cold_auc = cold_upd.evaluate(cold_art, [], probe)
+    layer.close()
+
+    speedup = gen1_s / gen3_s if gen3_s else None
+    auc_gap = (
+        abs(warm_auc - cold_auc) / abs(cold_auc)
+        if cold_auc and np.isfinite(cold_auc) and np.isfinite(warm_auc)
+        else None
+    )
+    print(
+        f"generation cadence: gen1 full {gen1_s:.1f}s ({hist_events} evts) "
+        f"-> gen3 incremental {gen3_s:.2f}s ({win_events} evts), "
+        f"speedup {speedup:.1f}x, warm {warm_iters:.0f}/{iterations} sweeps, "
+        f"AUC warm {warm_auc:.4f} vs cold {cold_auc:.4f} on {platform}",
+        file=sys.stderr,
+    )
+    print(json.dumps({
+        "metric": "als_generation_cadence"
+        + ("_cpu" if platform == "cpu" else ""),
+        "value": round(speedup, 2) if speedup else None,
+        "unit": "x",
+        "vs_baseline": None,
+        "platform": platform,
+        "history_events": n_history,
+        "window_events": win_events,
+        "gen1_full_seconds": round(gen1_s, 2),
+        "gen2_incremental_seconds": round(gen2_s, 2),
+        "genN_incremental_seconds": round(gen3_s, 2),
+        "gen_incremental_speedup": round(speedup, 2) if speedup else None,
+        "warm_start_iters": int(warm_iters),
+        "warm_start_iters_saved": int(iterations - warm_iters),
+        "incremental_full_after_gen1": int(full_after_1),
+        "incremental_builds": {"full": int(full_total), "delta": int(delta_total)},
+        "warm_auc": round(float(warm_auc), 4),
+        "cold_auc": round(float(cold_auc), 4),
+        "warm_vs_cold_auc_gap": round(auc_gap, 4) if auc_gap is not None else None,
+        "cold_rebuild_seconds": round(cold_s, 2),
+    }))
+
+
 def _bench_update_storm_body() -> None:
     """Update-storm serving scenario: continuous speed-layer row writes
     during the query window. Measures the post-update latency cliff the
@@ -1404,6 +1559,28 @@ def _merge_kmeans_rdf(result: dict, kr: dict) -> None:
             result[q] = kr[q]
 
 
+def _merge_generations(result: dict, row: dict) -> None:
+    """Generation-cadence block: nested scenario plus the headline
+    incremental-vs-full scalars promoted to the compact final line."""
+    result["generation_cadence"] = {
+        key: row[key]
+        for key in (
+            "gen1_full_seconds", "gen2_incremental_seconds",
+            "genN_incremental_seconds", "gen_incremental_speedup",
+            "warm_start_iters", "warm_start_iters_saved",
+            "incremental_full_after_gen1", "incremental_builds",
+            "warm_auc", "cold_auc", "warm_vs_cold_auc_gap",
+            "cold_rebuild_seconds", "history_events", "window_events",
+            "platform",
+        )
+        if key in row
+    }
+    if row.get("gen_incremental_speedup") is not None:
+        result["gen_incremental_speedup"] = row["gen_incremental_speedup"]
+    if row.get("warm_start_iters_saved") is not None:
+        result["warm_start_iters_saved"] = row["warm_start_iters_saved"]
+
+
 def _merge_scaling(result: dict, sc: dict) -> None:
     if sc.get("rows"):
         result["scaling"] = sc["rows"]
@@ -1466,6 +1643,7 @@ _SUITE_STAGES = (
     # covers BOTH builds (the warmup costs tens of seconds)
     ("_bench_train_body", 700, True, _merge_train, False),
     ("_bench_speed_body", 300, False, _merge_speed, False),
+    ("_bench_generations_body", 420, False, _merge_generations, False),
     ("_bench_kmeans_rdf_body", 420, False, _merge_kmeans_rdf, False),
     ("_bench_http_lsh_body", 240, False, _merge_lsh, True),
     ("_bench_update_storm_body", 240, False, _merge_update_storm, False),
@@ -1481,7 +1659,8 @@ _SUITE_STAGES = (
 # the tunnel when killed mid-transfer, and nothing survived).
 _ACCEL_STAGE_ORDER = (
     "_bench_body", "_bench_scale_body", "_bench_http_body",
-    "_bench_update_storm_body", "_bench_train_body", "_bench_speed_body",
+    "_bench_update_storm_body", "_bench_train_body",
+    "_bench_generations_body", "_bench_speed_body",
     "_bench_kmeans_rdf_body", "_bench_http_lsh_body",
 )
 
@@ -1723,6 +1902,7 @@ _SUMMARY_KEYS = (
     "speed_events_per_sec", "kmeans_build_seconds", "rdf_build_seconds",
     "rdf_accuracy", "lsh_qps", "lsh_vs_baseline", "qps_per_core_vs_baseline",
     "update_stall_p99_ms", "update_stall_ratio",
+    "gen_incremental_speedup", "warm_start_iters_saved",
     "speedup_vs_mllib", "partial", "stages_done", "tpu_wait",
 )
 

@@ -152,6 +152,13 @@ class BatchLayerUpdate(ABC):
         update_producer: TopicProducer,
     ) -> None: ...
 
+    def finalize_generation(self, timestamp_ms: int) -> None:
+        """Called by the batch layer AFTER the generation's window is
+        persisted and its offsets committed. Updates that stage durable
+        state during run_update (e.g. the incremental aggregate snapshot)
+        promote it here — state made durable any earlier would double-fold
+        the window if a crash in between re-delivered it."""
+
 
 class SpeedModelManager(ABC):
     """Implemented by the speed tier; config-named via

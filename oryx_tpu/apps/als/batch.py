@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import logging
 import pathlib
+import threading
 import time
 from typing import Any, Sequence
 
@@ -22,9 +23,18 @@ import numpy as np
 from oryx_tpu.bus.api import KeyMessage, TopicProducer
 from oryx_tpu.common.artifact import ModelArtifact
 from oryx_tpu.common.config import Config
+from oryx_tpu.common.metrics import get_registry
+from oryx_tpu.common.tracing import get_tracer
 from oryx_tpu.ml.evaluate import auc_mean_per_user, rmse
 from oryx_tpu.ml.update import MLUpdate
-from oryx_tpu.ops.als import aggregate_interactions, train_als
+from oryx_tpu.ops.als import (
+    AggregateState,
+    agg_state_fingerprint,
+    aggregate_interactions,
+    align_factors,
+    train_als,
+    train_als_warm,
+)
 from oryx_tpu.apps.als.common import (
     ALSConfig,
     parse_events,
@@ -43,6 +53,384 @@ class ALSUpdate(MLUpdate):
 
             mesh = mesh_from_config(config)
         self.mesh = mesh
+        # incremental generations: persistent aggregate snapshot + warm
+        # starts (docs/operations.md "Incremental generations & warm start")
+        self.data_dir = config.get_string("oryx.batch.storage.data-dir", None)
+        self.warm_start = config.get_bool("oryx.batch.train.warm-start", True)
+        self.train_tol = config.get_float("oryx.batch.train.tol", 0.02)
+        self.train_min_iterations = config.get_int(
+            "oryx.batch.train.min-iterations", 2
+        )
+        self.train_check_every = config.get_int("oryx.batch.train.check-every", 2)
+        self.max_drift_fraction = config.get_float(
+            "oryx.batch.storage.incremental.max-drift-fraction", 0.5
+        )
+        self.snapshots_kept = config.get_int(
+            "oryx.batch.storage.incremental.snapshots-kept", 2
+        )
+        self._agg_state: AggregateState | None = None  # in-memory, authoritative
+        self._agg_pending = None  # (users, items, vals, tss) holdout to fold next gen
+        # fold staged by the in-flight generation; adopted (and the staged
+        # snapshot promoted) only in finalize_generation, after the batch
+        # layer has persisted + committed the window — otherwise a crash
+        # between snapshot and persist would re-deliver the window into a
+        # state that already contains it (double-counted strengths)
+        self._staged_state: AggregateState | None = None
+        self._staged_pending = None
+        self._staged_ts: int | None = None
+        self._agg_through_ts: int | None = None  # newest generation folded
+        self._prev_item_ids = None  # last generation's Y alignment table
+        self._prev_y: np.ndarray | None = None
+        reg = get_registry()
+        self._m_agg_rows = reg.gauge(
+            "oryx_batch_aggregate_rows",
+            "Entries in the persistent batch aggregate state (0 until the "
+            "first incremental generation)",
+        )
+        self._m_warm_iters = reg.gauge(
+            "oryx_batch_warm_iterations",
+            "ALS sweeps actually run by the last batch generation "
+            "(convergence early stop; equals the configured iteration "
+            "count on cold starts)",
+        )
+
+    # ---- incremental generations ---------------------------------------
+
+    @property
+    def _with_days(self) -> bool:
+        return self.als.implicit and self.als.decay_factor < 1.0
+
+    @property
+    def _fingerprint(self) -> str:
+        return agg_state_fingerprint(
+            implicit=self.als.implicit, with_days=self._with_days
+        )
+
+    def _parse_to_str(self, data):
+        """parse_events with id arrays normalized to unicode — pending
+        holdout buffers round-trip through npz, which cannot hold object
+        arrays without pickling."""
+        users, items, vals, tss = parse_events(data)
+        return (
+            np.asarray(users, dtype=str),
+            np.asarray(items, dtype=str),
+            vals,
+            tss,
+        )
+
+    def _load_snapshot(self):
+        """Persisted (state, pending) for the current schema, or None when
+        missing/mismatched/stale. Stale = a persisted generation newer
+        than the snapshot's through_ts: that window was never folded
+        (crash between persist and snapshot), so the state lies."""
+        from oryx_tpu.layers.datastore import (
+            latest_generation_ts,
+            load_aggregate_snapshot,
+        )
+
+        if not self.data_dir:
+            return None
+        loaded = load_aggregate_snapshot(self.data_dir, self._fingerprint)
+        if loaded is None:
+            return None
+        through_ts, arrays = loaded
+        newest = latest_generation_ts(self.data_dir)
+        if newest is not None and newest > through_ts:
+            log.info(
+                "aggregate snapshot through %d is older than persisted "
+                "generation %d; full rebuild", through_ts, newest,
+            )
+            return None
+        try:
+            state = AggregateState.from_arrays(arrays)
+            pending = (
+                np.asarray(arrays["pending_users"], dtype=str),
+                np.asarray(arrays["pending_items"], dtype=str),
+                np.asarray(arrays["pending_vals"], dtype=np.float64),
+                np.asarray(arrays["pending_tss"], dtype=np.int64),
+            )
+        except KeyError:
+            return None
+        return state, pending
+
+    def _snapshot_arrays(self, state: AggregateState, pending) -> dict:
+        arrays = state.to_arrays()
+        users, items, vals, tss = pending
+        arrays["pending_users"] = (
+            users if users.size else np.zeros(0, "<U1")
+        )
+        arrays["pending_items"] = (
+            items if items.size else np.zeros(0, "<U1")
+        )
+        arrays["pending_vals"] = vals.astype(np.float64)
+        arrays["pending_tss"] = tss.astype(np.int64)
+        return arrays
+
+    def _persist_snapshot(self, timestamp_ms: int, state, pending) -> None:
+        from oryx_tpu.layers.datastore import save_aggregate_snapshot
+
+        if not self.data_dir:
+            return
+        save_aggregate_snapshot(
+            self.data_dir, timestamp_ms, self._fingerprint,
+            self._snapshot_arrays(state, pending), keep=self.snapshots_kept,
+            staged=True,
+        )
+
+    def incremental_update(
+        self,
+        timestamp_ms: int,
+        new_data,
+        model_dir: str,
+        update_producer: TopicProducer,
+    ) -> bool:
+        """One O(window) generation: merge the new window into the
+        persisted aggregate state, warm-start training from the previous
+        generation's factors, evaluate on the window's temporal holdout,
+        publish, and snapshot — overlapping the snapshot write with the
+        device training scan. Returns False (→ full rebuild) when the
+        snapshot is missing/stale/mismatched, when the window drifts past
+        max-drift-fraction of the state, or when a hyperparameter search
+        is configured (candidates > 1 needs the full path's scoring)."""
+        if self.candidates > 1:
+            return False
+        if (
+            self._agg_state is not None
+            and self._agg_state.fingerprint == self._fingerprint
+            and self._memory_state_fresh()
+        ):
+            state_pending = (self._agg_state, self._agg_pending)
+        else:
+            state_pending = self._load_snapshot()
+        if state_pending is None:
+            return False
+        state, pending = state_pending
+        tr = get_tracer()
+        t_merge = time.monotonic()
+        train_msgs, test_msgs = self.split_train_test(list(new_data))
+        users, items, vals, tss = self._parse_to_str(train_msgs)
+        if pending is not None and len(pending[2]):
+            # the previous generation's holdout is persisted history the
+            # from-scratch path would train on: fold it in now
+            users = np.concatenate([pending[0], users])
+            items = np.concatenate([pending[1], items])
+            vals = np.concatenate([pending[2], vals])
+            tss = np.concatenate([pending[3], tss])
+        window = AggregateState.from_window(
+            users, items, vals, tss,
+            implicit=self.als.implicit, with_days=self._with_days,
+        )
+        if state.entries == 0 and window.entries == 0:
+            log.info("no data at generation %d; skipping model build", timestamp_ms)
+            return True
+        if (
+            state.entries
+            and window.entries > self.max_drift_fraction * state.entries
+        ):
+            log.info(
+                "window touches %d aggregate rows (> %.0f%% of %d): drift "
+                "past oryx.batch.storage.incremental.max-drift-fraction; "
+                "full rebuild", window.entries,
+                100 * self.max_drift_fraction, state.entries,
+            )
+            self._agg_state = None  # re-anchor from history
+            return False
+        merged = state.merge(window)
+        agg = merged.materialize(
+            decay_factor=self.als.decay_factor,
+            zero_threshold=self.als.zero_threshold,
+            now_ms=int(time.time() * 1000),
+            log_strength=self.als.log_strength,
+            epsilon=self.als.epsilon,
+        )
+        tr.record_interval(
+            "batch.merge", t_merge, window_rows=window.entries,
+            aggregate_rows=merged.entries,
+        )
+        if len(agg.values) == 0 or agg.n_users == 0 or agg.n_items == 0:
+            # everything deleted/thresholded away: nothing to train, but
+            # the fold itself must survive
+            log.info("generation %d: empty aggregate after merge", timestamp_ms)
+            self._set_state(merged, self._parse_to_str(test_msgs), timestamp_ms)
+            return True
+
+        hyperparams = {
+            "features": self.als.features,
+            "lambda": self.als.lam,
+            "alpha": self.als.alpha,
+        }
+        features = int(hyperparams["features"])
+        t_warm = time.monotonic()
+        resume_y = None
+        if self.warm_start:
+            if self._prev_y is None:
+                self._load_prev_factors(model_dir)
+            resume_y = align_factors(
+                self._prev_item_ids, self._prev_y, agg.item_ids, features,
+            )
+        tr.record_interval(
+            "batch.warmstart", t_warm,
+            resumed_rows=0 if resume_y is None else len(agg.item_ids),
+        )
+        # snapshot write overlaps the training scan: the device is busy
+        # for the whole solve, the npz write is pure host I/O
+        pending_next = self._parse_to_str(test_msgs)
+        snap_err: list[BaseException] = []
+
+        def _snapshot():
+            try:
+                self._persist_snapshot(timestamp_ms, merged, pending_next)
+            except BaseException as e:  # noqa: BLE001 - surfaced after join
+                snap_err.append(e)
+
+        snap_thread = threading.Thread(
+            target=_snapshot, name="oryx-agg-snapshot", daemon=True
+        )
+        snap_thread.start()
+        try:
+            model, sweeps = train_als_warm(
+                agg,
+                features=features,
+                lam=float(hyperparams["lambda"]),
+                alpha=float(hyperparams["alpha"]),
+                iterations=self.als.iterations,
+                implicit=self.als.implicit,
+                mesh=self._build_mesh(),
+                compute_dtype=self.als.compute_dtype,
+                resume_y=resume_y,
+                tol=self.train_tol if resume_y is not None else 0.0,
+                min_iterations=self.train_min_iterations,
+                check_every=self.train_check_every,
+            )
+        finally:
+            snap_thread.join()
+        if snap_err:
+            raise snap_err[0]
+        self._m_warm_iters.set(sweeps)
+        self._m_agg_rows.set(merged.entries)
+        art = self._artifact_from_model(model, hyperparams, agg)
+
+        score = self.evaluate(art, train_msgs, test_msgs) if test_msgs else float("nan")
+        log.info(
+            "incremental generation %d: %d aggregate rows, %d/%d sweeps "
+            "(warm=%s), eval %s", timestamp_ms, merged.entries, sweeps,
+            self.als.iterations, resume_y is not None, score,
+        )
+        self._set_state(merged, pending_next, timestamp_ms, persisted=True)
+        if (
+            self.threshold is not None
+            and np.isfinite(score)
+            and score < float(self.threshold)
+        ):
+            log.warning(
+                "incremental eval %.6f below threshold %s; not publishing "
+                "model", score, self.threshold,
+            )
+            return True
+
+        from pathlib import Path
+
+        from oryx_tpu.common.ioutil import delete_recursively, mkdirs, strip_scheme
+
+        root = Path(strip_scheme(model_dir))
+        staged = art.write(mkdirs(root / ".incremental") / str(timestamp_ms))
+        self.promote_and_publish(staged, root, timestamp_ms, update_producer)
+        delete_recursively(root / ".incremental")
+        self._prev_item_ids = list(model.item_ids)
+        self._prev_y = model.y
+        return True
+
+    def _memory_state_fresh(self) -> bool:
+        """The in-memory state must pass the SAME newest-persisted-
+        generation check as a loaded snapshot: a generation whose build
+        raised AFTER its window was polled still gets that window
+        persisted and committed by the batch layer — trusting the
+        in-memory state blindly would drop those events from every
+        future aggregate."""
+        from oryx_tpu.layers.datastore import latest_generation_ts
+
+        if not self.data_dir or self._agg_through_ts is None:
+            return False
+        newest = latest_generation_ts(self.data_dir)
+        return newest is None or newest <= self._agg_through_ts
+
+    def _load_prev_factors(self, model_dir: str) -> None:
+        """Restart path: resume warm starts from the newest published
+        model artifact's Y (the in-memory copy dies with the process)."""
+        from oryx_tpu.common.ioutil import list_generation_dirs
+
+        try:
+            gens = list_generation_dirs(model_dir)
+            if not gens:
+                return
+            art = ModelArtifact.read(gens[-1])
+            y = art.tensors.get("Y")
+            ids = art.get_extension_list("YIDs")
+            if y is not None and ids and len(ids) == len(y):
+                self._prev_item_ids = ids
+                self._prev_y = np.asarray(y, dtype=np.float32)
+        except Exception:  # noqa: BLE001 - warm start is best-effort
+            log.warning("could not load previous factors for warm start",
+                        exc_info=True)
+
+    def _set_state(self, state, pending, timestamp_ms: int, persisted=False) -> None:
+        """Stage the folded state. Both the in-memory adoption and the
+        durable snapshot become visible in finalize_generation, once the
+        window itself is persisted and committed."""
+        self._staged_state = state
+        self._staged_pending = pending
+        self._staged_ts = timestamp_ms
+        if not persisted:
+            self._persist_snapshot(timestamp_ms, state, pending)
+
+    def finalize_generation(self, timestamp_ms: int) -> None:
+        from oryx_tpu.layers.datastore import finalize_aggregate_snapshot
+
+        if self._staged_ts != timestamp_ms or self._staged_state is None:
+            return
+        self._agg_state = self._staged_state
+        self._agg_pending = self._staged_pending
+        self._agg_through_ts = timestamp_ms
+        self._staged_state = self._staged_pending = None
+        self._staged_ts = None
+        if self.data_dir:
+            try:
+                finalize_aggregate_snapshot(
+                    self.data_dir, timestamp_ms, keep=self.snapshots_kept
+                )
+            except Exception:  # noqa: BLE001 - next generation rebuilds
+                log.exception("aggregate snapshot finalize failed")
+
+    def after_full_build(self, timestamp_ms, train, test, model) -> None:
+        """Re-anchor the incremental state after a from-scratch build: one
+        extra linear pass over the already-materialized train/test splits,
+        so the NEXT generation runs O(window) again. model is None when
+        the build was withheld by the eval threshold — the aggregates
+        still re-anchor (the window is persisted either way); only the
+        warm-start factors are skipped."""
+        try:
+            users, items, vals, tss = self._parse_to_str(train)
+            state = AggregateState.from_window(
+                users, items, vals, tss,
+                implicit=self.als.implicit, with_days=self._with_days,
+            )
+            pending = self._parse_to_str(test)
+            self._set_state(state, pending, timestamp_ms)
+            self._m_agg_rows.set(state.entries)
+            # cold builds run the full configured sweep count; without
+            # this a fallback generation would keep showing the previous
+            # warm generation's low figure
+            self._m_warm_iters.set(self.als.iterations)
+            if model is not None:
+                try:
+                    self._prev_item_ids = model.get_extension_list("YIDs")
+                    self._prev_y = model.tensors.get("Y")
+                except Exception:  # noqa: BLE001 - warm start is best-effort
+                    self._prev_item_ids = self._prev_y = None
+        except Exception:  # noqa: BLE001 - snapshotting must never fail a
+            # published generation; next generation just rebuilds again
+            log.exception("aggregate snapshot rebuild failed; next "
+                          "generation will run a full rebuild")
 
     def hyperparam_ranges(self) -> dict[str, Any]:
         return {
@@ -129,6 +517,12 @@ class ALSUpdate(MLUpdate):
             )
         else:
             m = train_als(agg, **kwargs)
+        return self._artifact_from_model(m, hyperparams, agg)
+
+    def _artifact_from_model(self, m, hyperparams, agg) -> ModelArtifact:
+        """Model arrays + aggregate -> the publishable skeleton artifact
+        (shared by the from-scratch candidate builds and the incremental
+        warm-start path)."""
         art = ModelArtifact(
             "als",
             extensions={

@@ -103,8 +103,16 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
         self._fetch_pos = dict(positions)
         self._delivered_pos = dict(positions)
 
-    def commit(self) -> None:
-        self._broker.commit_offsets(self._group, self._topic, self._delivered_pos)
+    def commit(self, positions: dict[int, int] | None = None) -> None:
+        """Record delivered positions durably. An explicit `positions`
+        snapshot commits exactly that window edge — the batch layer's
+        ingest-prefetch thread may have delivered records BEYOND the
+        persisted window by commit time, and those must not be committed
+        until their own generation persists them."""
+        self._broker.commit_offsets(
+            self._group, self._topic,
+            self._delivered_pos if positions is None else positions,
+        )
 
     def __next__(self) -> KeyMessage:
         while True:

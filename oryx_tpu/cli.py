@@ -115,6 +115,14 @@ def _parse_args(argv):
         "oryx.serving.api.sync.capacity-headroom)",
     )
     p.add_argument(
+        "--full-rebuild", action="store_true",
+        help="batch: disable incremental generations for this run "
+        "(oryx.batch.storage.incremental.enabled=false) — every "
+        "generation re-aggregates and cold-trains from all persisted "
+        "history, re-anchoring the aggregate snapshot (use after "
+        "suspected snapshot corruption or a semantics change)",
+    )
+    p.add_argument(
         "--trace", action="store_true",
         help="enable request/generation span tracing "
         "(oryx.monitoring.tracing.enabled=true); inspect recorded spans "
@@ -958,6 +966,8 @@ def main(argv=None) -> int:
     if args.trace:
         # same sugar: tracing propagates to replica/pod children via --set
         args.set.append("oryx.monitoring.tracing.enabled=true")
+    if args.full_rebuild:
+        args.set.append("oryx.batch.storage.incremental.enabled=false")
     if args.sync_mode is not None:
         args.set.append(f"oryx.serving.api.sync.mode={args.sync_mode}")
     if args.sync_headroom is not None:

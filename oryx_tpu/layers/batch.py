@@ -24,7 +24,7 @@ from oryx_tpu.common.config import Config
 from oryx_tpu.common.ioutil import delete_older_than, strip_scheme
 from oryx_tpu.common.metrics import GENERATION_BUCKETS, get_registry, maybe_profile
 from oryx_tpu.common.tracing import configure_tracing, get_tracer, swap_current
-from oryx_tpu.layers.datastore import load_all_data, save_generation
+from oryx_tpu.layers.datastore import LazyPastData, save_generation
 from oryx_tpu.layers.watchdog import running_seconds, start_wedge_watchdog
 
 log = logging.getLogger(__name__)
@@ -102,6 +102,25 @@ class BatchLayer:
         self._watchdog: threading.Thread | None = None
         self._consumer: ConsumeDataIterator | None = None
         self.generation_count = 0
+        # ingest/compute pipeline: while a model build holds the device, a
+        # background thread keeps draining the input topic so the NEXT
+        # generation starts with its window already read and decoded.
+        # Disabled for pod members — the pod window is agreed from raw
+        # consumer positions, which prefetch would skew. Commit safety:
+        # run_generation commits the explicit pre-build window edge, so
+        # prefetched records stay uncommitted until THEIR window persists.
+        self.prefetch_enabled = (
+            config.get_bool(
+                "oryx.batch.storage.incremental.prefetch.enabled", True
+            )
+            and not self._pod_member
+        )
+        self.prefetch_max_records = config.get_int(
+            "oryx.batch.storage.incremental.prefetch.max-records", 500_000
+        )
+        self._prefetched: list = []
+        self._prefetch_stop: threading.Event | None = None
+        self._prefetch_thread: threading.Thread | None = None
         configure_tracing(config)
         self._profile_dir = config.get_string("oryx.monitoring.profile-dir", None)
         reg = get_registry()
@@ -225,8 +244,16 @@ class BatchLayer:
         ts, up_to = self._pod_window(ts)
         tr = get_tracer()
         t_ingest = time.monotonic() if tr.enabled else 0.0
-        new_data = self._consumer.poll_available(up_to=up_to)
-        past_data = load_all_data(self.data_dir)
+        prefetched, self._prefetched = self._prefetched, []
+        new_data = prefetched + self._consumer.poll_available(up_to=up_to)
+        # the window edge to commit: positions BEFORE the build, so the
+        # ingest-prefetch thread (running during the build) cannot push
+        # unpersisted records past the committed offsets
+        window_end = self._consumer.positions()
+        # history is handed over LAZILY: an incremental update (persistent
+        # aggregate snapshot, ml/update.py) never reads it at all; the
+        # from-scratch fallback pays the streamed read on first touch
+        past_data = LazyPastData(self.data_dir)
         root = None
         if new_data or past_data:
             # per-generation span tree: ingest -> build -> persist. The
@@ -235,11 +262,12 @@ class BatchLayer:
             # context onto the update topic (common/freshness.py).
             root = tr.start(
                 "batch.generation", start=t_ingest or None, generation=ts,
-                new_records=len(new_data), past_records=len(past_data),
+                new_records=len(new_data),
             )
             if root is not None and t_ingest:
                 tr.record_interval("batch.ingest", t_ingest, parent=root)
             self._gen_started = time.monotonic()
+            self._start_prefetch()
             try:
                 t_build = time.monotonic()
                 prev = swap_current(root) if root is not None else None
@@ -252,6 +280,8 @@ class BatchLayer:
                     if root is not None:
                         swap_current(prev)
                         tr.record_interval("batch.build", t_build, parent=root)
+                        if past_data.known_len() is not None:
+                            root.attrs["past_records"] = past_data.known_len()
             except Exception:
                 # a failed build must not lose the window: persist + commit
                 # still run, and the next generation retries over history
@@ -260,12 +290,16 @@ class BatchLayer:
                 if root is not None:
                     root.attrs["error"] = True
             finally:
+                self._stop_prefetch()
                 self._gen_started = None
         else:
             log.info("generation %d: no data yet", ts)
         t_persist = time.monotonic() if root is not None else 0.0
         save_generation(self.data_dir, ts, new_data)
-        self._consumer.commit()
+        self._consumer.commit(window_end)
+        # window durable + offsets committed: state the update staged
+        # during the build (aggregate snapshot) may now become visible
+        self.update.finalize_generation(ts)
         if root is not None:
             tr.record_interval("batch.persist", t_persist, parent=root)
             tr.finish(root)
@@ -275,6 +309,52 @@ class BatchLayer:
         self._m_generations.inc()
         self._m_records.inc(len(new_data))
         return len(new_data)
+
+    def _start_prefetch(self) -> None:
+        """Ingest/compute overlap: drain the input topic on a background
+        thread while the model build holds the device, so the next
+        generation's window is already read and decoded when its timer
+        fires. Bounded by prefetch-max-records."""
+        if not self.prefetch_enabled:
+            return
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(0.05):
+                if len(self._prefetched) >= self.prefetch_max_records:
+                    continue
+                recs = self._consumer.poll_available()
+                if recs:
+                    self._prefetched.extend(recs)
+
+        self._prefetch_stop = stop
+        self._prefetch_thread = threading.Thread(
+            target=loop, name="oryx-batch-prefetch", daemon=True
+        )
+        self._prefetch_thread.start()
+
+    def _stop_prefetch(self) -> None:
+        # local snapshots: close() and the generation loop's finally can
+        # both land here; the attributes may be None-ed under us
+        stop, thread = self._prefetch_stop, self._prefetch_thread
+        if stop is not None:
+            stop.set()
+        if thread is not None:
+            thread.join(timeout=10)
+            if thread.is_alive():
+                # wait it out, loudly: proceeding would race the zombie's
+                # in-flight poll on the shared consumer — window offsets
+                # could be committed for records that never reach a
+                # persisted window (permanent input loss). poll_available
+                # is non-blocking by design, so this resolves as soon as
+                # the slow drain returns.
+                log.warning(
+                    "prefetch thread still draining after 10s; waiting "
+                    "(a poll this slow usually means storage contention)"
+                )
+                thread.join()
+        self._prefetch_stop = None
+        self._prefetch_thread = None
 
     def start(self) -> None:
         """Spawn the generation-interval loop (BatchLayer.start)."""
@@ -300,6 +380,7 @@ class BatchLayer:
 
     def close(self) -> None:
         self._stop.set()
+        self._stop_prefetch()
         if self._consumer:
             self._consumer.close()
         if self._thread:

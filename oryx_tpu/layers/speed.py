@@ -61,6 +61,11 @@ class SpeedLayer:
         self._m_updates = reg.counter(
             "oryx_speed_updates_total", "Update messages published by the speed layer"
         )
+        self._m_failures = reg.counter(
+            "oryx_speed_failures_total",
+            "Speed micro-batches whose update build raised (window rewound "
+            "for reprocessing; a growing count is a rewind loop)",
+        )
         self._m_duration = reg.histogram(
             "oryx_speed_batch_seconds",
             "Wall-clock per speed micro-batch",
@@ -144,7 +149,10 @@ class SpeedLayer:
                 # rewind to where this window began (NOT the committed
                 # offsets — on a fresh group those fall back to the log end,
                 # which would silently drop the failed window)
+                # a rewind loop would otherwise be invisible in /metrics:
+                # neither batches nor records count on this path
                 log.exception("speed update build failed; window will be reprocessed")
+                self._m_failures.inc()
                 tr.finish(root, error=True)
                 self._input_consumer.seek(window_start)
                 self.batch_count += 1

@@ -28,6 +28,7 @@ from oryx_tpu.common.artifact import ModelArtifact
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.executil import collect_in_parallel
 from oryx_tpu.common.ioutil import atomic_rename, delete_recursively, mkdirs, strip_scheme
+from oryx_tpu.common.metrics import get_registry
 from oryx_tpu.common.rng import RandomManager
 from oryx_tpu.ml.hyperparams import choose_combos
 
@@ -58,6 +59,20 @@ class MLUpdate(BatchLayerUpdate):
         # without ever interleaving two candidates' collectives on one
         # device.
         self._pod = DistributedConfig.from_config(config).enabled
+        # incremental generations: apps that maintain a persistent
+        # aggregate snapshot (incremental_update) make generation N cost
+        # O(new window); the pod path stays on the lockstep full rebuild
+        # (every member must see identical inputs, and per-member
+        # snapshots could diverge after partial failures).
+        self.incremental = config.get_bool(
+            "oryx.batch.storage.incremental.enabled", True
+        )
+        self._m_incremental = get_registry().counter(
+            "oryx_batch_incremental_total",
+            "Batch model builds by kind: delta = merged into the persisted "
+            "aggregate snapshot, full = from-scratch over all history",
+            labeled=True,
+        )
 
     # ---- hooks an app implements -----------------------------------------
 
@@ -100,6 +115,37 @@ class MLUpdate(BatchLayerUpdate):
         """Hook for streaming data too large for the artifact message (ALS
         streams every factor row here, MLUpdate.java:233-236)."""
 
+    def incremental_update(
+        self,
+        timestamp_ms: int,
+        new_data: Sequence[KeyMessage],
+        model_dir: str,
+        update_producer: TopicProducer,
+    ) -> bool:
+        """App hook: attempt an O(new-window) incremental generation
+        against a persisted aggregate snapshot (see apps/als/batch.py).
+        Return True when the generation was fully handled — model built
+        and published, or legitimately withheld (threshold) — with the
+        window folded into the snapshot. Return False to fall back to the
+        from-scratch path over materialized history (snapshot missing,
+        schema-mismatched, stale, or window drift past the configured
+        fraction)."""
+        return False
+
+    def after_full_build(
+        self,
+        timestamp_ms: int,
+        train: Sequence[KeyMessage],
+        test: Sequence[KeyMessage],
+        model: ModelArtifact | None,
+    ) -> None:
+        """App hook, called after a from-scratch build: rebuild and stage
+        the aggregate snapshot so the NEXT generation can run
+        incrementally again (the delta-vs-full discipline: every full
+        rebuild re-anchors the incremental state). model is None when the
+        eval threshold withheld publication — aggregates re-anchor
+        regardless, since the window is persisted regardless."""
+
     def training_mesh(self):
         """The mesh candidate builds run on (apps that shard training set
         self.mesh in __init__); None trains single-device."""
@@ -125,10 +171,19 @@ class MLUpdate(BatchLayerUpdate):
         model_dir: str,
         update_producer: TopicProducer,
     ) -> None:
+        if self.incremental and not self._pod:
+            # the incremental path never touches past_data: when the app's
+            # aggregate snapshot is valid, generation cost is O(window)
+            if self.incremental_update(
+                timestamp_ms, new_data, model_dir, update_producer
+            ):
+                self._m_incremental.inc(kind="delta")
+                return
         data = list(past_data) + list(new_data)
         if not data:
             log.info("no data at generation %d; skipping model build", timestamp_ms)
             return
+        self._m_incremental.inc(kind="full")
         if self._pod:
             # every pod member must draw the SAME random split, the same
             # hyperparam combos, and the same factor-init keys, or the
@@ -248,6 +303,12 @@ class MLUpdate(BatchLayerUpdate):
                 best_score, self.threshold,
             )
             delete_recursively(cand_root)
+            # still re-anchor the aggregate snapshot: the window persists
+            # either way, and skipping this would leave the snapshot
+            # permanently stale — every later generation would repeat the
+            # O(history) full rebuild until eval crossed the threshold
+            if self.incremental and not self._pod:
+                self.after_full_build(timestamp_ms, train, test, None)
             return
 
         if pod_groups is not None:
@@ -258,14 +319,31 @@ class MLUpdate(BatchLayerUpdate):
                 best_i, paths[best_i], cand_root, pod_groups
             )
 
-        final_dir = root / str(timestamp_ms)
-        delete_recursively(final_dir)
-        atomic_rename(paths[best_i], final_dir)
+        model = self.promote_and_publish(
+            paths[best_i], root, timestamp_ms, update_producer
+        )
         delete_recursively(root / ".candidates")
+        if self.incremental and not self._pod:
+            self.after_full_build(timestamp_ms, train, test, model)
 
+    def promote_and_publish(
+        self,
+        staged_dir: Path,
+        model_root: Path,
+        timestamp_ms: int,
+        update_producer: TopicProducer,
+    ) -> ModelArtifact:
+        """Atomically promote a built candidate dir to
+        model_root/<timestamp> and publish it (MODEL/MODEL-REF + extras)
+        — the one publish tail shared by the candidate-search and
+        incremental paths."""
+        final_dir = model_root / str(timestamp_ms)
+        delete_recursively(final_dir)
+        atomic_rename(staged_dir, final_dir)
         model = ModelArtifact.read(final_dir)
         self.publish_model(model, str(final_dir), update_producer)
         self.publish_additional_model_data(model, str(final_dir), update_producer)
+        return model
 
     def _build_one(
         self,

@@ -98,7 +98,13 @@ def aggregate_interactions(
     )
 
     if decay_factor < 1.0 and now_ms is not None:
-        days_old = np.maximum(0, (now_ms - ts) // 86_400_000)
+        # calendar-day ages (now's day-of-epoch minus the event's), not a
+        # rolling 24h difference: an event's decay bucket is then a pure
+        # function of ITS timestamp, so the incremental AggregateState can
+        # store raw per-day sums and apply decay at view time — at any
+        # later generation — and still match this from-scratch path
+        # exactly. (The reference decays by whole days too.)
+        days_old = np.maximum(0, now_ms // _DAY_MS - ts // _DAY_MS)
         values = values * np.power(decay_factor, days_old)
 
     uid_sorted, ui = _factorize_string_ids(users)
@@ -128,6 +134,8 @@ def aggregate_interactions(
     ai = (agg_pair % len(iid_sorted)).astype(np.int32)
     return InteractionData(uid_sorted, iid_sorted, au, ai, agg_val.astype(np.float32))
 
+
+_DAY_MS = 86_400_000
 
 _POW10 = 10 ** np.arange(1, 19, dtype=np.int64)
 
@@ -182,6 +190,308 @@ def _factorize_string_ids(arr: np.ndarray) -> tuple[list[str], np.ndarray]:
         return uniq_strs[lex].tolist(), perm[inv.astype(np.int64)]
     ids, inv = np.unique(arr, return_inverse=True)
     return ids.tolist(), inv.astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# incremental aggregate state: aggregate_interactions, made mergeable
+# ---------------------------------------------------------------------------
+
+AGG_STATE_SCHEMA = 1
+
+
+def _group_sum(u, i, d, v, presorted: bool = False):
+    """Group (user, item[, day]) keys and NaN-propagating-sum their
+    values: the ONE grouping kernel behind AggregateState's from_window,
+    merge, and materialize paths — the stable lexsort keeps earlier
+    entries (history order) first within a group, so partial sums add in
+    the order the equivalence property test pins. d=None groups by
+    (user, item) only. Returns (u_sorted, i_sorted, d_sorted, first_idx,
+    sums) with one sums entry per group, first_idx naming each group's
+    first sorted row."""
+    if d is None:
+        d = np.zeros(len(u), dtype=np.int64)
+    if not presorted:
+        order = np.lexsort((d, i, u))
+        u, i, d, v = u[order], i[order], d[order], v[order]
+    new = np.r_[
+        True, (u[1:] != u[:-1]) | (i[1:] != i[:-1]) | (d[1:] != d[:-1])
+    ]
+    grp = np.cumsum(new) - 1
+    sums = np.zeros(int(grp[-1]) + 1)
+    np.add.at(sums, grp, v)  # NaN (delete marker) propagates into its group
+    return u, i, d, np.nonzero(new)[0], sums
+
+
+def agg_state_fingerprint(*, implicit: bool, with_days: bool) -> str:
+    """Schema fingerprint a persisted snapshot must match to be loadable.
+    zero-threshold / log-strength / the decay FACTOR are view-time
+    parameters (materialize()) and deliberately absent: changing them must
+    not force a full history re-read. Turning decay on/off changes the
+    stored granularity (day buckets) and does."""
+    return f"agg-v{AGG_STATE_SCHEMA}:implicit={implicit}:days={with_days}"
+
+
+@dataclass
+class AggregateState:
+    """Persistent, mergeable form of ``aggregate_interactions``.
+
+    Invariant: ``merge`` over any windowing of a history, then
+    ``materialize``, equals ``aggregate_interactions`` over the
+    concatenated history (bit-identical under exact float arithmetic;
+    within rounding otherwise — the merge reorders sums only).
+
+    - implicit: one entry per (user, item, day bucket) holding the raw
+      NaN-propagating strength sum of that bucket. NaN (the delete
+      marker) is KEPT in the state: any later strength added to a dead
+      pair stays NaN, exactly like the full-history NaN-propagating sum.
+      Decay is day-of-epoch (see aggregate_interactions), so a bucket's
+      weight at any generation is ``sum * decay^(now_day - day)`` — decay
+      never re-ages the stored sums. With decay off the day axis
+      collapses to one bucket.
+    - explicit: one entry per (user, item) holding (last_ts, raw last
+      value); merges keep the newer timestamp, ties going to the newer
+      window — the same winner the from-scratch stable lexsort picks.
+      NaN value = delete, kept for the same resurrection-proofing.
+
+    zero-threshold / positivity / log-strength are applied by
+    ``materialize`` only: a pair below threshold this generation can come
+    back above it later, exactly as a from-scratch re-aggregation would
+    see it. Entries stay sorted by (user, item, day).
+    """
+
+    implicit: bool
+    with_days: bool
+    user_ids: np.ndarray  # [U] unicode, lexicographically sorted
+    item_ids: np.ndarray  # [I] unicode, lexicographically sorted
+    users: np.ndarray     # [M] int64 index into user_ids
+    items: np.ndarray     # [M] int64 index into item_ids
+    days: np.ndarray      # [M] int64 day-of-epoch bucket (0 when unused)
+    vals: np.ndarray      # [M] float64 sums (implicit) / last value (explicit)
+    last_ts: np.ndarray   # [M] int64 (explicit last-wins key; 0 when implicit)
+
+    @property
+    def entries(self) -> int:
+        return len(self.vals)
+
+    @property
+    def fingerprint(self) -> str:
+        return agg_state_fingerprint(
+            implicit=self.implicit, with_days=self.with_days
+        )
+
+    @staticmethod
+    def empty(*, implicit: bool, with_days: bool) -> "AggregateState":
+        z = np.zeros(0, dtype=np.int64)
+        return AggregateState(
+            implicit, with_days,
+            np.zeros(0, dtype="<U1"), np.zeros(0, dtype="<U1"),
+            z.copy(), z.copy(), z.copy(), np.zeros(0, dtype=np.float64),
+            z.copy(),
+        )
+
+    # -- construction --------------------------------------------------
+
+    @staticmethod
+    def from_window(
+        users: np.ndarray,
+        items: np.ndarray,
+        values: np.ndarray,
+        timestamps: np.ndarray | None = None,
+        *,
+        implicit: bool = True,
+        with_days: bool = False,
+    ) -> "AggregateState":
+        """Aggregate ONE window of raw events into state form (the same
+        id factorization and within-window combine rules as
+        aggregate_interactions, minus the view-time transforms)."""
+        users = np.asarray(users)
+        items = np.asarray(items)
+        values = np.asarray(values, dtype=np.float64)
+        n = len(values)
+        ts = (
+            np.asarray(timestamps, dtype=np.int64)
+            if timestamps is not None
+            else np.zeros(n, dtype=np.int64)
+        )
+        if n == 0:
+            return AggregateState.empty(implicit=implicit, with_days=with_days)
+        uid_sorted, ui = _factorize_string_ids(users)
+        iid_sorted, ii = _factorize_string_ids(items)
+        uid_arr = np.asarray(uid_sorted, dtype=str)
+        iid_arr = np.asarray(iid_sorted, dtype=str)
+        ui = ui.astype(np.int64)
+        ii = ii.astype(np.int64)
+        day = (ts // _DAY_MS) if (implicit and with_days) else np.zeros(n, np.int64)
+        if implicit:
+            u_s, i_s, d_s, first, sums = _group_sum(ui, ii, day, values)
+            return AggregateState(
+                implicit, with_days, uid_arr, iid_arr,
+                u_s[first], i_s[first], d_s[first], sums,
+                np.zeros(len(first), dtype=np.int64),
+            )
+        # explicit: last (by timestamp) wins; stable sort breaks ties by
+        # position in the window, like the from-scratch lexsort
+        order = np.lexsort((ts, ii, ui))
+        u_s, i_s, t_s, v_s = ui[order], ii[order], ts[order], values[order]
+        last = np.r_[(u_s[1:] != u_s[:-1]) | (i_s[1:] != i_s[:-1]), True]
+        keep = np.nonzero(last)[0]
+        return AggregateState(
+            implicit, with_days, uid_arr, iid_arr,
+            u_s[keep], i_s[keep], np.zeros(len(keep), dtype=np.int64),
+            v_s[keep], t_s[keep],
+        )
+
+    # -- merge -----------------------------------------------------------
+
+    def merge(self, window: "AggregateState") -> "AggregateState":
+        """Fold a newer window's state into this one: O(state + window),
+        never O(history). ``window`` must be the NEWER side (explicit
+        timestamp ties resolve toward it)."""
+        if (self.implicit, self.with_days) != (window.implicit, window.with_days):
+            raise ValueError("aggregate state schema mismatch")
+        if window.entries == 0 and len(window.user_ids) == 0:
+            return self
+        if self.entries == 0 and len(self.user_ids) == 0:
+            return window
+        uids = np.union1d(self.user_ids, window.user_ids)
+        iids = np.union1d(self.item_ids, window.item_ids)
+        su = np.searchsorted(uids, self.user_ids)[self.users]
+        si = np.searchsorted(iids, self.item_ids)[self.items]
+        wu = np.searchsorted(uids, window.user_ids)[window.users]
+        wi = np.searchsorted(iids, window.item_ids)[window.items]
+        u = np.concatenate([su, wu])
+        i = np.concatenate([si, wi])
+        d = np.concatenate([self.days, window.days])
+        v = np.concatenate([self.vals, window.vals])
+        t = np.concatenate([self.last_ts, window.last_ts])
+        if self.implicit:
+            u_s, i_s, d_s, first, sums = _group_sum(u, i, d, v)
+            return AggregateState(
+                self.implicit, self.with_days, uids, iids,
+                u_s[first], i_s[first], d_s[first], sums,
+                np.zeros(len(first), dtype=np.int64),
+            )
+        # explicit: newest timestamp per pair wins; stable sort puts the
+        # window's entry after the state's on equal ts, so ties go to it
+        order = np.lexsort((t, i, u))
+        u, i, v, t = u[order], i[order], v[order], t[order]
+        last = np.r_[(u[1:] != u[:-1]) | (i[1:] != i[:-1]), True]
+        keep = np.nonzero(last)[0]
+        return AggregateState(
+            self.implicit, self.with_days, uids, iids,
+            u[keep], i[keep], np.zeros(len(keep), dtype=np.int64),
+            v[keep], t[keep],
+        )
+
+    # -- view ------------------------------------------------------------
+
+    def materialize(
+        self,
+        *,
+        decay_factor: float = 1.0,
+        zero_threshold: float = 0.0,
+        now_ms: int | None = None,
+        log_strength: bool = False,
+        epsilon: float = 1.0,
+    ) -> InteractionData:
+        """The view-time half of aggregate_interactions: decay, delete/
+        threshold filters and the log transform, over the merged state."""
+        uid_list = self.user_ids.tolist()
+        iid_list = self.item_ids.tolist()
+        if self.implicit:
+            w = self.vals
+            if self.with_days and decay_factor < 1.0 and now_ms is not None:
+                ages = np.maximum(0, now_ms // _DAY_MS - self.days)
+                w = w * np.power(decay_factor, ages)
+            if self.entries:
+                # entries are already (user, item, day)-sorted: collapsing
+                # the day axis groups by (user, item) in place
+                u_s, i_s, _, first, sums = _group_sum(
+                    self.users, self.items, None, w, presorted=True
+                )
+                pu, pi = u_s[first], i_s[first]
+            else:
+                sums = np.zeros(0)
+                pu = pi = np.zeros(0, dtype=np.int64)
+            keep = ~np.isnan(sums) & (np.abs(sums) > zero_threshold) & (sums > 0)
+            agg_val = sums[keep]
+            pu, pi = pu[keep], pi[keep]
+        else:
+            vals = self.vals
+            if decay_factor < 1.0 and now_ms is not None:
+                ages = np.maximum(0, now_ms // _DAY_MS - self.last_ts // _DAY_MS)
+                vals = vals * np.power(decay_factor, ages)
+            keep = ~np.isnan(vals)
+            agg_val = vals[keep]
+            pu, pi = self.users[keep], self.items[keep]
+        if log_strength:
+            agg_val = np.log1p(np.maximum(agg_val, 0.0) / epsilon)
+        return InteractionData(
+            uid_list, iid_list,
+            pu.astype(np.int32), pi.astype(np.int32),
+            agg_val.astype(np.float32),
+        )
+
+    # -- (de)serialization -------------------------------------------------
+
+    def to_arrays(self) -> dict[str, np.ndarray]:
+        """Compact columnar form for npz persistence (datastore snapshot)."""
+        return {
+            "user_ids": self.user_ids if self.user_ids.size else np.zeros(0, "<U1"),
+            "item_ids": self.item_ids if self.item_ids.size else np.zeros(0, "<U1"),
+            "users": self.users.astype(np.int64),
+            "items": self.items.astype(np.int64),
+            "days": self.days.astype(np.int64),
+            "vals": self.vals.astype(np.float64),
+            "last_ts": self.last_ts.astype(np.int64),
+            "flags": np.asarray([int(self.implicit), int(self.with_days)], np.int64),
+        }
+
+    @staticmethod
+    def from_arrays(arrays) -> "AggregateState":
+        flags = np.asarray(arrays["flags"]).astype(np.int64)
+        return AggregateState(
+            bool(flags[0]), bool(flags[1]),
+            np.asarray(arrays["user_ids"], dtype=str),
+            np.asarray(arrays["item_ids"], dtype=str),
+            np.asarray(arrays["users"], dtype=np.int64),
+            np.asarray(arrays["items"], dtype=np.int64),
+            np.asarray(arrays["days"], dtype=np.int64),
+            np.asarray(arrays["vals"], dtype=np.float64),
+            np.asarray(arrays["last_ts"], dtype=np.int64),
+        )
+
+
+def align_factors(
+    prev_ids, prev_mat: np.ndarray | None, new_ids, features: int,
+    seed_key=None,
+) -> np.ndarray | None:
+    """Map a previous generation's factor rows onto a new id table: ids
+    retained across generations keep their learned rows, new ids get the
+    cold random init (same scale as the trainers'). Returns None when
+    there is nothing usable to resume from (no previous factors, or the
+    feature width changed — a hyperparameter move cold-starts)."""
+    if prev_mat is None or len(np.shape(prev_mat)) != 2:
+        return None
+    prev_mat = np.asarray(prev_mat, dtype=np.float32)
+    if prev_mat.shape[1] != features or prev_mat.shape[0] == 0:
+        return None
+    prev_ids = np.asarray(prev_ids, dtype=str)
+    new_ids = np.asarray(new_ids, dtype=str)
+    order = np.argsort(prev_ids, kind="stable")
+    prev_sorted, prev_rows = prev_ids[order], prev_mat[order]
+    key = seed_key if seed_key is not None else RandomManager.get_key()
+    # np.array (not asarray): jax hands back a read-only host view
+    out = np.array(
+        jax.random.normal(key, (len(new_ids), features), dtype=jnp.float32)
+        * 0.1
+        + 1.0 / math.sqrt(features)
+    )
+    pos = np.searchsorted(prev_sorted, new_ids)
+    pos_c = np.clip(pos, 0, len(prev_sorted) - 1)
+    hit = prev_sorted[pos_c] == new_ids
+    out[hit] = prev_rows[pos_c[hit]]
+    return out
 
 
 def build_padded_lists(
@@ -385,6 +695,7 @@ def train_als(
     compute_dtype: str = "float32",
     resume_y: np.ndarray | None = None,
     timings: dict | None = None,
+    donate_y0: bool = False,
 ) -> ALSModelArrays:
     """Train ALS factor matrices. If a mesh is given, the padded lists and
     factor tables are sharded over its "data" axis and the whole scan runs
@@ -463,7 +774,14 @@ def train_als(
             compute_dtype=compute_dtype,
         )
         if timings is None:
-            x, y = als_train_bucketed_jit(*args, **kwargs)
+            # donation is a no-op (with a warning) on CPU; only take the
+            # donated program where buffer reuse actually exists
+            fn = (
+                als_train_bucketed_jit_donated
+                if donate_y0 and jax.default_backend() != "cpu"
+                else als_train_bucketed_jit
+            )
+            x, y = fn(*args, **kwargs)
         else:
             # AOT lower/compile so the one-time XLA compile is measured
             # apart from the compute it amortizes into
@@ -642,6 +960,84 @@ def train_als_checkpointed(
     return model
 
 
+def train_als_warm(
+    data: InteractionData,
+    features: int = 10,
+    lam: float = 0.001,
+    alpha: float = 1.0,
+    iterations: int = 10,
+    implicit: bool = True,
+    mesh=None,
+    cap: int = 1024,
+    block: int = 1024,
+    seed_key=None,
+    compute_dtype: str = "float32",
+    resume_y: np.ndarray | None = None,
+    tol: float = 0.0,
+    min_iterations: int = 1,
+    check_every: int = 2,
+) -> tuple[ALSModelArrays, int]:
+    """train_als with a convergence-based early stop for warm starts.
+
+    Runs `check_every`-sweep chunks (each re-enters the SAME compiled
+    program — the chunk size, not the total, is the jit-cache key, so
+    steady-state generations never recompile) and stops once the model's
+    PREDICTIONS stop moving: the relative change of x_u·y_i over a fixed
+    deterministic sample of observed interactions drops below `tol`.
+    Predictions, not factor norms — an ALS factor pair keeps drifting
+    along near-degenerate directions (scale/rotation trades between X
+    and Y) long after the scores it produces have settled, so a
+    Frobenius-on-Y test either never fires or needs a uselessly loose
+    threshold. Respects the `min_iterations` floor. A warm resume_y from
+    the previous generation typically converges in a fraction of the
+    cold iteration count; the per-chunk Y carry is donated to the
+    trainer so the chunked loop holds one factor table, not two.
+    Returns (model, sweeps actually run).
+
+    tol <= 0 disables the early stop (one full-length train_als call).
+    """
+    if tol <= 0 or iterations <= max(1, check_every):
+        m = train_als(
+            data, features=features, lam=lam, alpha=alpha,
+            iterations=iterations, implicit=implicit, mesh=mesh, cap=cap,
+            block=block, seed_key=seed_key, compute_dtype=compute_dtype,
+            resume_y=resume_y,
+        )
+        return m, iterations
+    check_every = max(1, check_every)
+    # deterministic stride sample of observed pairs (same idiom as the
+    # checkpoint fingerprint): cheap, stable across chunks, and scored
+    # where the model is actually used
+    nnz = len(data.values)
+    samp = slice(None, None, max(1, nnz // 4096))
+    su, si = data.users[samp], data.items[samp]
+    done = 0
+    prev_y = resume_y
+    prev_pred = None
+    model = None
+    while done < iterations:
+        chunk = min(check_every, iterations - done)
+        model = train_als(
+            data, features=features, lam=lam, alpha=alpha,
+            iterations=chunk, implicit=implicit, mesh=mesh, cap=cap,
+            block=block, seed_key=seed_key, compute_dtype=compute_dtype,
+            resume_y=prev_y, donate_y0=prev_y is not None,
+        )
+        done += chunk
+        pred = (model.x[su] * model.y[si]).sum(axis=1)
+        if prev_pred is not None:
+            denom = float(np.linalg.norm(prev_pred)) or 1.0
+            rel = float(np.linalg.norm(pred - prev_pred)) / denom
+            if done >= min_iterations and rel < tol:
+                log.info(
+                    "ALS early stop at sweep %d/%d (relative prediction "
+                    "change %.2e < tol %.2e)", done, iterations, rel, tol,
+                )
+                break
+        prev_y, prev_pred = model.y, pred
+    return model, done
+
+
 def _row_pad(a: np.ndarray, n: int) -> np.ndarray:
     if a.shape[0] == n:
         return a
@@ -801,13 +1197,7 @@ def _half_step_buckets(
     return x
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "implicit", "iterations", "blocks_u", "blocks_i", "n_u", "compute_dtype"
-    ),
-)
-def als_train_bucketed_jit(
+def _als_train_bucketed(
     u_buckets, i_buckets, y0, lam, alpha,
     *, implicit: bool, iterations: int, blocks_u, blocks_i, n_u: int,
     compute_dtype: str = "float32",
@@ -832,6 +1222,23 @@ def als_train_bucketed_jit(
     x0 = jnp.zeros((n_u, y0.shape[1]), dtype=jnp.float32)
     (x_fin, y_fin), _ = jax.lax.scan(body, (x0, y0), None, length=iterations)
     return x_fin, y_fin
+
+
+_BUCKETED_STATICS = (
+    "implicit", "iterations", "blocks_u", "blocks_i", "n_u", "compute_dtype"
+)
+
+als_train_bucketed_jit = partial(jax.jit, static_argnames=_BUCKETED_STATICS)(
+    _als_train_bucketed
+)
+
+# warm-start variant: the incoming Y carry is DONATED so XLA reuses its
+# HBM buffer for the outgoing factors — the early-stop loop re-enters
+# this program once per convergence check, and without donation every
+# chunk would briefly hold two full item-factor tables
+als_train_bucketed_jit_donated = partial(
+    jax.jit, static_argnames=_BUCKETED_STATICS, donate_argnums=(2,)
+)(_als_train_bucketed)
 
 
 # ---------------------------------------------------------------------------

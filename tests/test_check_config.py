@@ -1,0 +1,68 @@
+"""tools/check_config.py wired as a tier-1 gate: every oryx.* key the
+code reads must be declared in common/reference.conf — new knobs (e.g.
+the oryx.batch.train.* family) cannot silently drift out of the packaged
+defaults."""
+
+from __future__ import annotations
+
+import importlib.util
+import pathlib
+
+
+def _load_tool():
+    root = pathlib.Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "check_config", root / "tools" / "check_config.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_every_config_key_declared(capsys):
+    tool = _load_tool()
+    rc = tool.main()
+    out = capsys.readouterr()
+    assert rc == 0, f"config/reference.conf drift:\n{out.err}"
+
+
+def test_checker_catches_undeclared_key(monkeypatch):
+    """The checker must actually fail on a key missing from the defaults."""
+    tool = _load_tool()
+    real = tool.code_config_keys
+
+    def with_extra():
+        keys = real()
+        keys["oryx.totally.new-knob"] = "somewhere.py"
+        return keys
+
+    monkeypatch.setattr(tool, "code_config_keys", with_extra)
+    assert tool.main() == 1
+
+
+def test_checker_resolves_wrapped_and_fstring_calls(tmp_path, monkeypatch):
+    """Wrapped call sites resolve; f-string compositions are skipped."""
+    tool = _load_tool()
+    src = (
+        'x = config.get_int(\n    "oryx.batch.streaming.generation-interval-sec"\n)\n'
+        'y = config.get(f"oryx.als.{name}", None)\n'
+    )
+    found = tool.ACCESSOR.findall(src)
+    assert found == ["oryx.batch.streaming.generation-interval-sec"]
+
+
+def test_known_keys_present():
+    """Spot-check the new incremental/warm-start keys are both read in
+    code and declared — the exact drift this satellite exists to stop."""
+    tool = _load_tool()
+    code = tool.code_config_keys()
+    ref = tool.reference_config()
+    for key in (
+        "oryx.batch.train.warm-start",
+        "oryx.batch.train.tol",
+        "oryx.batch.train.min-iterations",
+        "oryx.batch.storage.incremental.enabled",
+        "oryx.batch.storage.incremental.max-drift-fraction",
+    ):
+        assert key in code, f"{key} no longer read anywhere"
+        assert ref.has(key), f"{key} missing from reference.conf"

@@ -1,0 +1,685 @@
+"""Incremental batch generations: aggregate-snapshot equivalence,
+warm-start training, fallback discipline, ingest prefetch commit safety,
+and the speed-layer failure counter.
+
+The equivalence tests use dyadic-rational strengths (0.25/0.5/1/2...) and
+decay 0.5 so every float operation is EXACT: the assertion is then
+bit-identity between the incremental merge and a from-scratch
+``aggregate_interactions`` over the concatenated history — semantic
+equivalence proven without float-reordering noise.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from oryx_tpu.bus.api import KeyMessage, TopicProducer
+from oryx_tpu.bus.broker import get_broker, topics
+from oryx_tpu.bus.inproc import InProcBroker
+from oryx_tpu.common.config import load_config
+from oryx_tpu.common.metrics import get_registry
+from oryx_tpu.common.rng import RandomManager
+from oryx_tpu.layers.batch import BatchLayer
+from oryx_tpu.layers.datastore import (
+    LazyPastData,
+    load_aggregate_snapshot,
+    save_aggregate_snapshot,
+    save_generation,
+)
+from oryx_tpu.ops.als import (
+    AggregateState,
+    agg_state_fingerprint,
+    aggregate_interactions,
+    align_factors,
+    train_als,
+    train_als_warm,
+)
+
+_DAY = 86_400_000
+
+
+@pytest.fixture(autouse=True)
+def _fresh_broker():
+    InProcBroker.reset_all()
+    yield
+    InProcBroker.reset_all()
+
+
+# ---- aggregate-snapshot equivalence ---------------------------------------
+
+def _random_windows(seed, k=5, n=60, users=7, items=6, with_deletes=True):
+    """K windows of raw events with dyadic strengths, out-of-order
+    timestamps, and NaN delete markers."""
+    r = np.random.default_rng(seed)
+    windows = []
+    for _ in range(k):
+        u = np.array([f"u{r.integers(0, users)}" for _ in range(n)], dtype=object)
+        i = np.array([f"i{r.integers(0, items)}" for _ in range(n)], dtype=object)
+        p = [0.235, 0.235, 0.235, 0.235, 0.06] if with_deletes else [0.25] * 4 + [0.0]
+        v = r.choice([0.25, 0.5, 1.0, 2.0, np.nan], size=n, p=p)
+        # out-of-order, repeating, multi-day timestamps
+        ts = r.integers(0, 20 * _DAY, size=n)
+        windows.append((u, i, v, ts))
+    return windows
+
+
+def _merge_windows(windows, *, implicit, with_days, reload_at=None, tmp_path=None):
+    """Fold windows through AggregateState, optionally round-tripping the
+    state through a persisted snapshot mid-sequence."""
+    state = AggregateState.empty(implicit=implicit, with_days=with_days)
+    fp = agg_state_fingerprint(implicit=implicit, with_days=with_days)
+    for j, (u, i, v, ts) in enumerate(windows):
+        state = state.merge(
+            AggregateState.from_window(
+                u, i, v, ts, implicit=implicit, with_days=with_days
+            )
+        )
+        if reload_at is not None and j == reload_at:
+            save_aggregate_snapshot(str(tmp_path), 1000 + j, fp, state.to_arrays())
+            loaded = load_aggregate_snapshot(str(tmp_path), fp)
+            assert loaded is not None
+            state = AggregateState.from_arrays(loaded[1])
+    return state
+
+
+@pytest.mark.parametrize("implicit", [True, False])
+@pytest.mark.parametrize("decay", [1.0, 0.5])
+@pytest.mark.parametrize("log_strength", [False, True])
+def test_incremental_merge_bit_identical_to_from_scratch(
+    tmp_path, implicit, decay, log_strength
+):
+    """The tentpole invariant: incremental merge over K windows — decay,
+    deletes, out-of-order timestamps, a mid-sequence snapshot reload —
+    materializes bit-identically to aggregate_interactions over the
+    concatenated history."""
+    with_days = implicit and decay < 1.0
+    windows = _random_windows(seed=42)
+    state = _merge_windows(
+        windows, implicit=implicit, with_days=with_days,
+        reload_at=2, tmp_path=tmp_path,
+    )
+    now_ms = 22 * _DAY + 54321
+    view = dict(
+        decay_factor=decay, zero_threshold=0.1, now_ms=now_ms,
+        log_strength=log_strength, epsilon=0.5,
+    )
+    got = state.materialize(**view)
+    cat = [np.concatenate([w[j] for w in windows]) for j in range(4)]
+    want = aggregate_interactions(
+        cat[0], cat[1], cat[2], cat[3], implicit=implicit, **view
+    )
+    assert got.user_ids == want.user_ids
+    assert got.item_ids == want.item_ids
+    assert np.array_equal(got.users, want.users)
+    assert np.array_equal(got.items, want.items)
+    assert np.array_equal(got.values, want.values)  # bitwise
+
+
+def test_delete_marker_kills_pair_across_windows():
+    """A NaN delete in window 2 must kill strengths from window 1 AND
+    keep the pair dead when window 3 adds more strength — exactly the
+    NaN-propagating full-history sum."""
+    u = np.array(["a"], dtype=object)
+    i = np.array(["x"], dtype=object)
+    mk = lambda v: AggregateState.from_window(
+        u, i, np.array([v]), np.array([0]), implicit=True
+    )
+    state = mk(1.0).merge(mk(np.nan)).merge(mk(2.0))
+    assert len(state.materialize().values) == 0
+    # and the id tables still carry the ids, like the from-scratch path
+    assert state.materialize().user_ids == ["a"]
+
+
+def test_explicit_last_wins_tie_goes_to_newer_window():
+    u = np.array(["a"], dtype=object)
+    i = np.array(["x"], dtype=object)
+    mk = lambda v, ts: AggregateState.from_window(
+        u, i, np.array([v]), np.array([ts]), implicit=False
+    )
+    merged = mk(3.0, 100).merge(mk(5.0, 100))  # same ts: newer window wins
+    assert merged.materialize().values[0] == 5.0
+    # matches from-scratch (later array position wins on a ts tie)
+    ref = aggregate_interactions(
+        np.array(["a", "a"], dtype=object), np.array(["x", "x"], dtype=object),
+        np.array([3.0, 5.0]), np.array([100, 100]), implicit=False,
+    )
+    assert ref.values[0] == 5.0
+
+
+def test_below_threshold_pair_can_come_back():
+    """zero-threshold is a view-time filter: a pair filtered out this
+    generation must reappear when later windows push it back up."""
+    u = np.array(["a"], dtype=object)
+    i = np.array(["x"], dtype=object)
+    mk = lambda v: AggregateState.from_window(
+        u, i, np.array([v]), np.array([0]), implicit=True
+    )
+    state = mk(0.25)
+    assert len(state.materialize(zero_threshold=0.5).values) == 0
+    state = state.merge(mk(1.0))
+    assert state.materialize(zero_threshold=0.5).values[0] == 1.25
+
+
+def test_staged_snapshot_invisible_until_finalized(tmp_path):
+    """The double-fold crash guard: a snapshot staged during a build must
+    not be loadable until the window it folded is persisted+committed
+    (finalize). A crash in between re-delivers the window — merging it
+    into an already-folded snapshot would double-count strengths."""
+    from oryx_tpu.layers.datastore import finalize_aggregate_snapshot
+
+    fp = agg_state_fingerprint(implicit=True, with_days=False)
+    u = np.array(["a"], dtype=object)
+    i = np.array(["x"], dtype=object)
+    s1 = AggregateState.from_window(
+        u, i, np.array([1.0]), np.array([0]), implicit=True
+    )
+    save_aggregate_snapshot(str(tmp_path), 1000, fp, s1.to_arrays())
+    s2 = s1.merge(
+        AggregateState.from_window(
+            u, i, np.array([2.0]), np.array([0]), implicit=True
+        )
+    )
+    save_aggregate_snapshot(str(tmp_path), 2000, fp, s2.to_arrays(), staged=True)
+    # crash before finalize: the loadable state is still generation 1000
+    ts, _ = load_aggregate_snapshot(str(tmp_path), fp)
+    assert ts == 1000
+    assert finalize_aggregate_snapshot(str(tmp_path), 2000) is True
+    ts, arrays = load_aggregate_snapshot(str(tmp_path), fp)
+    assert ts == 2000
+    assert AggregateState.from_arrays(arrays).materialize().values[0] == 3.0
+    # finalizing again is a no-op
+    assert finalize_aggregate_snapshot(str(tmp_path), 2000) is False
+
+
+def test_crashed_generation_does_not_double_fold(tmp_path):
+    """Crash between snapshot stage and window persist: on restart the
+    window re-delivers and must fold exactly once."""
+    from oryx_tpu.apps.als.batch import ALSUpdate
+
+    RandomManager.use_test_seed(12)
+    cfg = _gen_cfg(tmp_path, "g6")
+    broker = get_broker("mem://g6")
+    rng = np.random.default_rng(6)
+    layer = BatchLayer(cfg, update=ALSUpdate(cfg))
+    layer.ensure_streams()
+    _feed(broker, rng, 300, 1000, users=40, items=25)
+    layer.run_generation(timestamp_ms=10_000)
+    layer.close()
+
+    # generation 2 "crashes" mid-build: the update stages its fold but
+    # the batch layer never persists/commits/finalizes the window
+    window = [KeyMessage(None, f"uX,iY,2,{20_000 + j}") for j in range(5)]
+    upd_crash = ALSUpdate(cfg)
+
+    class _Null:
+        def send(self, *a):
+            pass
+
+        def send_batch(self, *a):
+            pass
+
+    assert upd_crash.incremental_update(20_000, window, str(tmp_path / "model"), _Null())
+    # restart: the staged fold is invisible; re-delivering the window
+    # merges it exactly once
+    upd2 = ALSUpdate(cfg)
+    layer2 = BatchLayer(cfg, update=upd2)
+    layer2.ensure_streams()
+    for km in window:
+        broker.send("OryxInput", None, km.message)
+    layer2.run_generation(timestamp_ms=30_000)
+    layer2.close()
+    state = upd2._agg_state
+    mask = (np.asarray(state.user_ids)[state.users] == "uX") & (
+        np.asarray(state.item_ids)[state.items] == "iY"
+    )
+    # 5 events of strength 2, summed once (the generation's 10% temporal
+    # holdout keeps the newest event pending, not dropped)
+    total = float(np.nansum(state.vals[mask]))
+    pend_mask = upd2._agg_pending[0] == "uX"
+    total += float(np.nansum(upd2._agg_pending[2][pend_mask]))
+    assert total == 10.0
+
+
+def test_snapshot_schema_mismatch_rejected(tmp_path):
+    fp = agg_state_fingerprint(implicit=True, with_days=False)
+    state = AggregateState.empty(implicit=True, with_days=False)
+    save_aggregate_snapshot(str(tmp_path), 1, fp, state.to_arrays())
+    assert load_aggregate_snapshot(str(tmp_path), fp) is not None
+    other = agg_state_fingerprint(implicit=False, with_days=False)
+    assert load_aggregate_snapshot(str(tmp_path), other) is None
+
+
+# ---- warm-start training ---------------------------------------------------
+
+def _synth_interactions(seed=1, n=2000, users=60, items=40):
+    r = np.random.default_rng(seed)
+    u = np.array([f"u{r.integers(0, users)}" for _ in range(n)], dtype=object)
+    i = np.array([f"i{r.integers(0, items)}" for _ in range(n)], dtype=object)
+    v = r.uniform(0.5, 3.0, n)
+    return aggregate_interactions(u, i, v, implicit=True)
+
+
+def test_align_factors_retains_rows_and_cold_starts_new():
+    prev = np.arange(12, dtype=np.float32).reshape(4, 3)
+    out = align_factors(["b", "a", "d", "c"], prev, ["a", "c", "e"], 3)
+    assert np.array_equal(out[0], prev[1])  # "a"
+    assert np.array_equal(out[1], prev[3])  # "c"
+    assert out.shape == (3, 3)
+    assert not np.allclose(out[2], 0.0)  # new id: cold init, not zeros
+    # feature-width change cold-starts
+    assert align_factors(["a"], prev, ["a"], 5) is None
+    assert align_factors(None, None, ["a"], 3) is None
+
+
+def test_warm_start_early_stops_and_matches_cold_quality():
+    RandomManager.use_test_seed(7)
+    data = _synth_interactions()
+    cold, it_cold = train_als_warm(
+        data, features=8, lam=0.01, alpha=10.0, iterations=10, tol=0.0
+    )
+    assert it_cold == 10
+    warm, it_warm = train_als_warm(
+        data, features=8, lam=0.01, alpha=10.0, iterations=10,
+        resume_y=cold.y, tol=0.05, min_iterations=2, check_every=2,
+    )
+    assert it_warm < 10  # converged predictions stop the sweep loop
+    # warm-started predictions agree with the cold model's
+    p_cold = cold.x @ cold.y.T
+    p_warm = warm.x @ warm.y.T
+    denom = np.linalg.norm(p_cold) or 1.0
+    assert np.linalg.norm(p_warm - p_cold) / denom < 0.2
+
+
+def test_warm_tol_zero_disables_early_stop():
+    data = _synth_interactions(seed=2, n=500)
+    m, it = train_als_warm(
+        data, features=4, iterations=6, tol=0.0, resume_y=None
+    )
+    assert it == 6 and m.x.shape[1] == 4
+
+
+# ---- the wired incremental generation loop ---------------------------------
+
+def _gen_cfg(tmp_path, name, **extra):
+    overlay = {
+        "oryx.id": name,
+        "oryx.input-topic.broker": f"mem://{name}",
+        "oryx.update-topic.broker": f"mem://{name}",
+        "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+        "oryx.als.hyperparams.features": 5,
+        "oryx.als.hyperparams.iterations": 4,
+        "oryx.ml.eval.test-fraction": 0.1,
+    }
+    overlay.update(extra)
+    cfg = load_config(overlay=overlay)
+    topics.maybe_create(f"mem://{name}", "OryxInput", 2)
+    topics.maybe_create(f"mem://{name}", "OryxUpdate", 1)
+    return cfg
+
+
+def _feed(broker, rng, n, base_ts, users=25, items=15):
+    for j in range(n):
+        u, i = rng.integers(0, users), rng.integers(0, items)
+        broker.send(
+            "OryxInput", None,
+            f"u{u},i{i},{1 + int(rng.poisson(1))},{base_ts + j}",
+        )
+
+
+def _counts():
+    c = get_registry().counter("oryx_batch_incremental_total")
+    return c.value(kind="full"), c.value(kind="delta")
+
+
+def test_generation_cycle_full_then_deltas(tmp_path):
+    from oryx_tpu.apps.als.batch import ALSUpdate
+
+    RandomManager.use_test_seed(3)
+    cfg = _gen_cfg(tmp_path, "g1")
+    upd = ALSUpdate(cfg)
+    layer = BatchLayer(cfg, update=upd)
+    layer.ensure_streams()
+    broker = get_broker("mem://g1")
+    rng = np.random.default_rng(0)
+    f0, d0 = _counts()
+
+    # windows stay well under max-drift-fraction of the aggregate
+    _feed(broker, rng, 500, 1000, users=40, items=25)
+    layer.run_generation(timestamp_ms=10_000)
+    _feed(broker, rng, 50, 20_000, users=40, items=25)
+    layer.run_generation(timestamp_ms=30_000)
+    _feed(broker, rng, 50, 40_000, users=40, items=25)
+    layer.run_generation(timestamp_ms=50_000)
+    f1, d1 = _counts()
+    assert (f1 - f0, d1 - d0) == (1, 2)  # full only at generation 1
+
+    # a model was published for every generation
+    recs = broker.read("OryxUpdate", 0, 0, 100_000)
+    assert sum(1 for _, k, _m in recs if k in ("MODEL", "MODEL-REF")) == 3
+    assert get_registry().gauge("oryx_batch_aggregate_rows").value() > 0
+
+    # incremental generations never read persisted history
+    calls = []
+    import oryx_tpu.layers.datastore as ds
+
+    real = ds.load_all_data
+    ds.load_all_data = lambda *a, **k: (calls.append(1), real(*a, **k))[1]
+    try:
+        _feed(broker, rng, 50, 60_000, users=40, items=25)
+        layer.run_generation(timestamp_ms=70_000)
+    finally:
+        ds.load_all_data = real
+    assert calls == []
+    f2, d2 = _counts()
+    assert (f2 - f0, d2 - d0) == (1, 3)
+    layer.close()
+
+
+def test_restart_resumes_incrementally_from_snapshot(tmp_path):
+    from oryx_tpu.apps.als.batch import ALSUpdate
+
+    RandomManager.use_test_seed(5)
+    cfg = _gen_cfg(tmp_path, "g2")
+    broker = get_broker("mem://g2")
+    rng = np.random.default_rng(1)
+    f0, d0 = _counts()
+    layer1 = BatchLayer(cfg, update=ALSUpdate(cfg))
+    layer1.ensure_streams()
+    _feed(broker, rng, 200, 1000)
+    layer1.run_generation(timestamp_ms=10_000)
+    layer1.close()
+    # fresh process: state reloads from the persisted snapshot
+    layer2 = BatchLayer(cfg, update=ALSUpdate(cfg))
+    _feed(broker, rng, 60, 20_000)
+    layer2.run_generation(timestamp_ms=30_000)
+    layer2.close()
+    f1, d1 = _counts()
+    assert (f1 - f0, d1 - d0) == (1, 1)
+
+
+def test_stale_snapshot_forces_full_rebuild(tmp_path):
+    """A persisted generation NEWER than the snapshot (crash between
+    window persist and snapshot write) invalidates the state."""
+    from oryx_tpu.apps.als.batch import ALSUpdate
+
+    RandomManager.use_test_seed(6)
+    cfg = _gen_cfg(tmp_path, "g3")
+    broker = get_broker("mem://g3")
+    rng = np.random.default_rng(2)
+    f0, d0 = _counts()
+    layer = BatchLayer(cfg, update=ALSUpdate(cfg))
+    layer.ensure_streams()
+    _feed(broker, rng, 200, 1000)
+    layer.run_generation(timestamp_ms=10_000)
+    # simulate the crash: a window persisted with no snapshot fold
+    save_generation(
+        str(tmp_path / "data"), 20_000, [KeyMessage(None, "u1,i1,1,19000")]
+    )
+    layer2 = BatchLayer(cfg, update=ALSUpdate(cfg))
+    _feed(broker, rng, 60, 30_000)
+    layer2.run_generation(timestamp_ms=40_000)
+    f1, d1 = _counts()
+    assert f1 - f0 == 2 and d1 - d0 == 0  # the stale state was rejected
+    # ...and the full rebuild re-anchored: the next one is a delta
+    _feed(broker, rng, 60, 50_000)
+    layer2.run_generation(timestamp_ms=60_000)
+    f2, d2 = _counts()
+    assert f2 - f0 == 2 and d2 - d0 == 1
+    layer.close()
+    layer2.close()
+
+
+def test_drift_past_fraction_forces_full_rebuild(tmp_path):
+    from oryx_tpu.apps.als.batch import ALSUpdate
+
+    RandomManager.use_test_seed(8)
+    cfg = _gen_cfg(
+        tmp_path, "g4",
+        **{"oryx.batch.storage.incremental.max-drift-fraction": 0.05},
+    )
+    broker = get_broker("mem://g4")
+    rng = np.random.default_rng(3)
+    f0, d0 = _counts()
+    layer = BatchLayer(cfg, update=ALSUpdate(cfg))
+    layer.ensure_streams()
+    _feed(broker, rng, 150, 1000)
+    layer.run_generation(timestamp_ms=10_000)
+    # a window as big as history: far beyond 5% drift
+    _feed(broker, rng, 150, 20_000)
+    layer.run_generation(timestamp_ms=30_000)
+    f1, d1 = _counts()
+    assert f1 - f0 == 2 and d1 - d0 == 0
+    layer.close()
+
+
+def test_failed_build_window_not_lost_from_memory_state(tmp_path, monkeypatch):
+    """A generation whose training raises AFTER its window was polled
+    still gets that window persisted by the batch layer. The next
+    generation must NOT trust the in-memory state (which never folded
+    it) — it must fall back to a full rebuild that re-reads the window."""
+    import oryx_tpu.apps.als.batch as als_batch
+    from oryx_tpu.apps.als.batch import ALSUpdate
+
+    RandomManager.use_test_seed(13)
+    cfg = _gen_cfg(tmp_path, "g8")
+    broker = get_broker("mem://g8")
+    rng = np.random.default_rng(7)
+    f0, d0 = _counts()
+    upd = ALSUpdate(cfg)
+    layer = BatchLayer(cfg, update=upd)
+    layer.ensure_streams()
+    _feed(broker, rng, 400, 1000, users=40, items=25)
+    layer.run_generation(timestamp_ms=10_000)
+
+    real = als_batch.train_als_warm
+    boom = {"armed": True}
+
+    def flaky(*a, **k):
+        if boom.pop("armed", False):
+            raise RuntimeError("transient device failure")
+        return real(*a, **k)
+
+    monkeypatch.setattr(als_batch, "train_als_warm", flaky)
+    # generation 2: the marker event's build fails mid-incremental; the
+    # window persists and commits anyway (batch-layer contract)
+    broker.send("OryxInput", None, "uLOST,iLOST,4,20000")
+    layer.run_generation(timestamp_ms=30_000)
+    # generation 3: in-memory state must be declared stale -> full rebuild
+    _feed(broker, rng, 40, 40_000, users=40, items=25)
+    layer.run_generation(timestamp_ms=50_000)
+    f1, d1 = _counts()
+    assert f1 - f0 == 2 and d1 - d0 == 0
+    # and the re-read history includes the failed generation's event
+    state = upd._agg_state
+    mask = (np.asarray(state.user_ids)[state.users] == "uLOST") & (
+        np.asarray(state.item_ids)[state.items] == "iLOST"
+    )
+    total = float(np.nansum(state.vals[mask]))
+    pend = upd._agg_pending
+    total += float(np.nansum(pend[2][pend[0] == "uLOST"]))
+    assert total == 4.0
+    layer.close()
+
+
+def test_threshold_withheld_build_still_reanchors_snapshot(tmp_path):
+    """An unpublishable (below-threshold) full build must still re-anchor
+    the aggregate snapshot — otherwise every following generation repeats
+    the O(history) rebuild until eval crosses the threshold."""
+    from oryx_tpu.apps.als.batch import ALSUpdate
+
+    RandomManager.use_test_seed(10)
+    cfg = _gen_cfg(
+        tmp_path, "g7", **{"oryx.ml.eval.threshold": 2.0}  # AUC can't reach
+    )
+    broker = get_broker("mem://g7")
+    rng = np.random.default_rng(5)
+    f0, d0 = _counts()
+    layer = BatchLayer(cfg, update=ALSUpdate(cfg))
+    layer.ensure_streams()
+    _feed(broker, rng, 400, 1000, users=40, items=25)
+    layer.run_generation(timestamp_ms=10_000)
+    _feed(broker, rng, 40, 20_000, users=40, items=25)
+    layer.run_generation(timestamp_ms=30_000)
+    f1, d1 = _counts()
+    assert (f1 - f0, d1 - d0) == (1, 1)  # gen 2 went incremental
+    # and nothing was published either generation
+    recs = broker.read("OryxUpdate", 0, 0, 100_000)
+    assert not any(k in ("MODEL", "MODEL-REF") for _, k, _m in recs)
+    layer.close()
+
+
+def test_incremental_disabled_by_config(tmp_path):
+    from oryx_tpu.apps.als.batch import ALSUpdate
+
+    RandomManager.use_test_seed(9)
+    cfg = _gen_cfg(
+        tmp_path, "g5",
+        **{"oryx.batch.storage.incremental.enabled": False},
+    )
+    broker = get_broker("mem://g5")
+    rng = np.random.default_rng(4)
+    f0, d0 = _counts()
+    layer = BatchLayer(cfg, update=ALSUpdate(cfg))
+    layer.ensure_streams()
+    _feed(broker, rng, 100, 1000)
+    layer.run_generation(timestamp_ms=10_000)
+    _feed(broker, rng, 50, 20_000)
+    layer.run_generation(timestamp_ms=30_000)
+    f1, d1 = _counts()
+    assert d1 - d0 == 0 and f1 - f0 == 2
+    layer.close()
+
+
+def test_full_rebuild_cli_flag(capsys):
+    from oryx_tpu.cli import main as cli_main
+
+    assert cli_main(["config", "--full-rebuild"]) == 0
+    out = capsys.readouterr().out
+    assert "oryx.batch.storage.incremental.enabled=false" in out
+
+
+# ---- ingest prefetch: overlap without losing commit safety -----------------
+
+class _GatedUpdate:
+    """BatchLayerUpdate whose build blocks until released, so the test
+    can interleave ingest with an in-flight generation."""
+
+    def __init__(self):
+        self.started = threading.Event()
+        self.release = threading.Event()
+        self.calls = []
+
+    def run_update(self, ts, new_data, past_data, model_dir, producer):
+        self.calls.append([km.message for km in new_data])
+        self.started.set()
+        assert self.release.wait(10)
+
+
+def test_prefetch_drains_during_build_and_survives_crash(tmp_path):
+    from oryx_tpu.api import BatchLayerUpdate
+
+    class Gated(_GatedUpdate, BatchLayerUpdate):
+        pass
+
+    cfg = _gen_cfg(tmp_path, "pf")
+    broker = get_broker("mem://pf")
+    upd = Gated()
+    layer = BatchLayer(cfg, update=upd)
+    layer.ensure_streams()
+    broker.send("OryxInput", None, "w1-a")
+    broker.send("OryxInput", None, "w1-b")
+    t = threading.Thread(
+        target=layer.run_generation, kwargs={"timestamp_ms": 10_000}
+    )
+    t.start()
+    assert upd.started.wait(10)
+    # records arriving DURING the build: the prefetch thread drains them
+    broker.send("OryxInput", None, "w2-a")
+    broker.send("OryxInput", None, "w2-b")
+    deadline = time.time() + 5
+    while len(layer._prefetched) < 2 and time.time() < deadline:
+        time.sleep(0.02)
+    assert len(layer._prefetched) == 2
+    upd.release.set()
+    t.join(timeout=10)
+    assert sorted(upd.calls[0]) == ["w1-a", "w1-b"]
+
+    # crash before the next generation: a NEW layer (same group) must
+    # re-see the prefetched-but-unpersisted records — the explicit
+    # window-edge commit must not have covered them
+    layer.close()
+    upd2 = Gated()
+    upd2.release.set()
+    layer2 = BatchLayer(cfg, update=upd2)
+    layer2.run_generation(timestamp_ms=20_000)
+    assert sorted(upd2.calls[0]) == ["w2-a", "w2-b"]
+    layer2.close()
+
+
+def test_prefetched_records_feed_next_window_without_crash(tmp_path):
+    from oryx_tpu.api import BatchLayerUpdate
+
+    class Gated(_GatedUpdate, BatchLayerUpdate):
+        pass
+
+    cfg = _gen_cfg(tmp_path, "pf2")
+    broker = get_broker("mem://pf2")
+    upd = Gated()
+    layer = BatchLayer(cfg, update=upd)
+    layer.ensure_streams()
+    broker.send("OryxInput", None, "a")
+    t = threading.Thread(
+        target=layer.run_generation, kwargs={"timestamp_ms": 10_000}
+    )
+    t.start()
+    assert upd.started.wait(10)
+    broker.send("OryxInput", None, "b")
+    deadline = time.time() + 5
+    while not layer._prefetched and time.time() < deadline:
+        time.sleep(0.02)
+    upd.release.set()
+    t.join(timeout=10)
+    layer.run_generation(timestamp_ms=20_000)
+    assert upd.calls[0] == ["a"] and upd.calls[1] == ["b"]
+    # both windows persisted exactly once
+    persisted = LazyPastData(str(tmp_path / "data"))
+    assert sorted(km.message for km in persisted) == ["a", "b"]
+    layer.close()
+
+
+# ---- speed-layer failure counter -------------------------------------------
+
+def test_speed_failure_counter_increments_on_rewind(tmp_path):
+    from oryx_tpu.api import AbstractSpeedModelManager
+    from oryx_tpu.layers.speed import SpeedLayer
+
+    class FailOnce(AbstractSpeedModelManager):
+        def __init__(self):
+            self.fail_next = True
+
+        def consume_key_message(self, key, message):
+            pass
+
+        def build_updates(self, new_data):
+            if self.fail_next:
+                self.fail_next = False
+                raise RuntimeError("transient")
+            return []
+
+    cfg = _gen_cfg(tmp_path, "spd")
+    broker = get_broker("mem://spd")
+    c = get_registry().counter("oryx_speed_failures_total")
+    before = c.value()
+    layer = SpeedLayer(cfg, manager=FailOnce())
+    layer.ensure_streams()
+    broker.send("OryxInput", None, "evt")
+    layer.run_batch()  # fails inside, rewinds
+    assert c.value() == before + 1
+    layer.run_batch()  # reprocessed fine
+    assert c.value() == before + 1
+    layer.close()

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Static config-key consistency check (wired as a tier-1 test).
+
+Every ``oryx.*`` key the code reads through a ``Config`` accessor
+(``get``/``get_string``/``get_int``/``get_float``/``get_bool``/
+``get_list``/``get_config``/``has``) must be declared in
+``common/reference.conf`` — the contract the reference enforced by
+layering every read over packaged defaults. Without this, a new
+``oryx.batch.train.*``-style knob can silently drift: read in code,
+undocumented in the defaults, invisible to ``cmd_config`` and operators.
+
+Keys composed with f-string interpolation (``f"oryx.als.{k}"``) cannot be
+resolved statically and are skipped; fully dynamic reads should go
+through such a composition on purpose.
+
+Exit status 0 = consistent; 1 = drift (each problem printed on stderr).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = ROOT / "oryx_tpu"
+REFERENCE = PACKAGE / "common" / "reference.conf"
+
+# A Config accessor taking a literal oryx.* key as its first argument.
+# \s* spans newlines, so wrapped call sites resolve too. Keys containing
+# "{" are f-string compositions and excluded by the character class.
+ACCESSOR = re.compile(
+    r"\.(?:get|get_string|get_int|get_float|get_bool|get_list|get_config|has)"
+    r"\(\s*[bru]?[\"'](oryx\.[A-Za-z0-9_.\-]+)[\"']"
+)
+
+
+def code_config_keys() -> dict[str, str]:
+    """key -> first file reading it, for every literal oryx.* accessor."""
+    keys: dict[str, str] = {}
+    for py in sorted(PACKAGE.rglob("*.py")):
+        text = py.read_text(encoding="utf-8")
+        for m in ACCESSOR.finditer(text):
+            keys.setdefault(m.group(1), str(py.relative_to(ROOT)))
+    return keys
+
+
+def reference_config():
+    from oryx_tpu.common.config import parse_config
+
+    return parse_config(REFERENCE.read_text(encoding="utf-8"))
+
+
+def main() -> int:
+    problems: list[str] = []
+    if not REFERENCE.exists():
+        print(f"missing {REFERENCE.relative_to(ROOT)}", file=sys.stderr)
+        return 1
+    sys.path.insert(0, str(ROOT))
+    ref = reference_config()
+    code = code_config_keys()
+    for key in sorted(code):
+        if not ref.has(key):
+            problems.append(
+                f"{key} ({code[key]}): read in code but not declared in "
+                "common/reference.conf"
+            )
+    for p in problems:
+        print(p, file=sys.stderr)
+    if not problems:
+        print(f"ok: {len(code)} config keys all declared in reference.conf")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
