@@ -159,6 +159,24 @@ class BatchLayerUpdate(ABC):
         promote it here — state made durable any earlier would double-fold
         the window if a crash in between re-delivered it."""
 
+    def validate_record(self, km: KeyMessage) -> bool:
+        """Cheap deserialize check, called once per record per generation
+        BEFORE the window persists. Return False for a record that can
+        never parse: the layer diverts it to the dead-letter store
+        (common/quarantine.py) instead of letting it poison persisted
+        history, where every later from-scratch rebuild would re-read it
+        forever. Apps override with a parse-only check; the default
+        accepts everything (the layer skips the sweep entirely when
+        neither this nor validate_records is overridden)."""
+        return True
+
+    def validate_records(self, records: Sequence[KeyMessage]) -> Sequence[bool]:
+        """Batch form of validate_record — override when a whole-window
+        check is cheaper than per-record Python calls (the ALS apps run
+        ONE native parse over the window and only Python-check the lines
+        it flags). Default loops validate_record."""
+        return [self.validate_record(km) for km in records]
+
 
 class SpeedModelManager(ABC):
     """Implemented by the speed tier; config-named via
@@ -171,6 +189,20 @@ class SpeedModelManager(ABC):
     @abstractmethod
     def build_updates(self, new_data: Sequence[KeyMessage]) -> Iterable[tuple[str, str]]:
         """Turn one micro-batch of input into (key, message) updates."""
+
+    def validate_record(self, km: KeyMessage) -> bool:
+        """Cheap deserialize check (see BatchLayerUpdate.validate_record):
+        False diverts the record to the dead-letter store before
+        build_updates ever sees it. Records that PARSE but break the
+        fold-in are isolated separately by the speed layer's bisect pass
+        after bounded window retries."""
+        return True
+
+    def validate_records(self, records: Sequence[KeyMessage]) -> Sequence[bool]:
+        """Batch form of validate_record (see
+        BatchLayerUpdate.validate_records). Default loops the per-record
+        hook."""
+        return [self.validate_record(km) for km in records]
 
     def close(self) -> None:
         pass

@@ -39,6 +39,8 @@ from oryx_tpu.apps.als.common import (
     ALSConfig,
     parse_events,
     batch_update_messages,
+    valid_event_line,
+    valid_event_lines,
 )
 
 log = logging.getLogger(__name__)
@@ -99,6 +101,18 @@ class ALSUpdate(MLUpdate):
     @property
     def _with_days(self) -> bool:
         return self.als.implicit and self.als.decay_factor < 1.0
+
+    def validate_record(self, km) -> bool:
+        """Deserialize check for the batch layer's quarantine sweep: a
+        line parse_events would reject diverts to the dead-letter store
+        instead of entering persisted history (where every from-scratch
+        rebuild would re-read it forever)."""
+        return valid_event_line(km.message)
+
+    def validate_records(self, records):
+        """Batch sweep: one native parse per window (see
+        valid_event_lines) instead of a Python parse per record."""
+        return valid_event_lines(km.message for km in records)
 
     @property
     def _fingerprint(self) -> str:

@@ -106,6 +106,53 @@ def _native_loader():
         return None
 
 
+def valid_event_line(line: str) -> bool:
+    """True when parse_events would accept this line — the cheap
+    deserialize check behind the layers' validate_record hook. Kept in
+    lockstep with the per-line rules in parse_events below so quarantine
+    decisions can never disagree with what the build would actually
+    ingest (pinned by tests/test_chaos.py)."""
+    try:
+        tok = parse_input_line(line)
+        if len(tok) < 2 or not tok[0] or not tok[1]:
+            return False
+        if len(tok) > 2 and tok[2] != "":
+            float(tok[2])
+        if len(tok) > 3 and tok[3] != "":
+            int(float(tok[3]))
+    except (ValueError, IndexError, TypeError):
+        return False
+    return True
+
+
+def valid_event_lines(lines) -> list[bool]:
+    """Batch valid_event_line: ONE native parse call covers the common
+    all-canonical-CSV window, and only the lines the C parser flags pay
+    the per-line Python check — native ok=False means "not verbatim
+    C-parseable" (JSON-array lines land there too), NOT invalid, so
+    those are re-checked rather than rejected. A line-count mismatch
+    (blank messages, embedded newlines) falls the whole batch back to
+    Python, mirroring parse_events' own fallback discipline. Keeps the
+    quarantine sweep off the per-record Python path the native loader
+    exists to avoid. Deliberate cost: the sweep is one extra native
+    parse per window on top of the build's own parse_events call —
+    threading one parse's results through both would couple the
+    validate hook to parse internals for a C call that is cheap by
+    construction."""
+    lines = list(lines)
+    native = _native_loader()
+    if native is not None and lines:
+        try:
+            ok = native.parse_interactions(
+                ("\n".join(lines)).encode("utf-8")
+            )[4]
+        except Exception:
+            ok = None
+        if ok is not None and len(ok) == len(lines):
+            return [bool(o) or valid_event_line(l) for o, l in zip(ok, lines)]
+    return [valid_event_line(l) for l in lines]
+
+
 def parse_events(data) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """KeyMessages -> (users, items, values, timestamps) arrays. Bad lines
     are skipped. Empty/absent strength = 1.0; empty-string with a 'delete'

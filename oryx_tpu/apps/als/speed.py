@@ -25,6 +25,8 @@ from oryx_tpu.apps.als.common import (
     ALSConfig,
     batch_update_messages,
     parse_events,
+    valid_event_line,
+    valid_event_lines,
 )
 from oryx_tpu.apps.als.state import ALSState, apply_update_message
 
@@ -45,6 +47,17 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
         self.state = apply_update_message(
             self.state, key, message, with_known_items=False
         )
+
+    def validate_record(self, km) -> bool:
+        """Deserialize check for the speed layer's quarantine sweep:
+        malformed lines are diverted to the dead-letter store (and
+        counted) instead of being silently skipped by parse_events."""
+        return valid_event_line(km.message)
+
+    def validate_records(self, records):
+        """Batch sweep: one native parse per window (see
+        valid_event_lines) instead of a Python parse per record."""
+        return valid_event_lines(km.message for km in records)
 
     # -- micro-batch -> updates --------------------------------------------
 

@@ -11,6 +11,9 @@ from __future__ import annotations
 import threading
 from typing import Iterator, NamedTuple, TYPE_CHECKING
 
+from oryx_tpu.common import faults
+from oryx_tpu.common.retry import retry_call
+
 if TYPE_CHECKING:
     from oryx_tpu.bus.broker import Broker
 
@@ -23,7 +26,14 @@ class KeyMessage(NamedTuple):
 class TopicProducer:
     """Producer bound to one topic; partitions by key hash like the
     reference's TopicProducerImpl (framework/oryx-lambda
-    .../lambda/TopicProducerImpl.java)."""
+    .../lambda/TopicProducerImpl.java).
+
+    Sends run under the shared bounded-retry contract (common/retry.py,
+    site "bus.produce"): transient broker I/O failures are absorbed with
+    backoff instead of failing the whole generation/micro-batch, and
+    exhaustion propagates loudly. The fault harness injects here
+    (faults.fire inside the retried closure, so chaos tests exercise the
+    SAME recovery path a real flaky disk would take)."""
 
     def __init__(self, broker: "Broker", topic: str):
         self._broker = broker
@@ -34,12 +44,44 @@ class TopicProducer:
         return self._topic
 
     def send(self, key: str | None, message: str) -> None:
-        self._broker.send(self._topic, key, message)
+        def _do() -> None:
+            faults.fire("bus.produce")
+            self._broker.send(self._topic, key, message)
+
+        retry_call("bus.produce", _do)
 
     def send_batch(self, records) -> None:
         """Batch append of (key, message) pairs — one lock round-trip per
-        partition on file brokers; used for factor-row floods."""
-        self._broker.send_batch(self._topic, records)
+        partition on file brokers; used for factor-row floods.
+
+        The retry unit is ONE PARTITION, not the whole batch: retrying a
+        whole multi-partition batch after a partial failure would
+        re-append the partitions that already succeeded — duplicate
+        records in persisted history. The file/mem brokers make the
+        per-partition append exact (a single write rolled back on
+        failure); kafka:// keeps Kafka's native at-least-once — an
+        ambiguous failure (batch appended, response lost) can still
+        duplicate within that one partition, exactly as any
+        non-idempotent Kafka producer can. Grouping here uses the same
+        partition_for the brokers use, so placement is unchanged."""
+        from oryx_tpu.bus.broker import partition_for
+
+        records = list(records)
+        if not records:
+            return
+        n_parts = self._broker.num_partitions(self._topic)
+        by_part: dict[int, list] = {}
+        for key, message in records:
+            by_part.setdefault(partition_for(key, n_parts), []).append(
+                (key, message)
+            )
+        for p, recs in by_part.items():
+
+            def _do(p=p, recs=recs) -> None:
+                faults.fire("bus.produce")
+                self._broker.send_batch(self._topic, recs, partition=p)
+
+            retry_call("bus.produce", _do)
 
     def close(self) -> None:
         pass
@@ -108,11 +150,29 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
         snapshot commits exactly that window edge — the batch layer's
         ingest-prefetch thread may have delivered records BEYOND the
         persisted window by commit time, and those must not be committed
-        until their own generation persists them."""
-        self._broker.commit_offsets(
-            self._group, self._topic,
-            self._delivered_pos if positions is None else positions,
-        )
+        until their own generation persists them. Retried (site
+        "bus.commit"): a transiently unwritable offset store must not
+        fail a generation whose window is already persisted."""
+        offsets = self._delivered_pos if positions is None else positions
+
+        def _do() -> None:
+            faults.fire("bus.commit")
+            self._broker.commit_offsets(self._group, self._topic, offsets)
+
+        retry_call("bus.commit", _do)
+
+    def _read(self, partition: int, pos: int, n: int):
+        """One broker read under the bounded-retry contract (site
+        "bus.consume"): transient I/O is absorbed here; a persistent or
+        deterministic failure (e.g. a corrupt wire frame,
+        bus/kafkawire.WireDecodeError) propagates to fail that one
+        consume with the original clear error."""
+
+        def _do():
+            faults.fire("bus.consume")
+            return self._broker.read(self._topic, partition, pos, n)
+
+        return retry_call("bus.consume", _do)
 
     def __next__(self) -> KeyMessage:
         while True:
@@ -130,7 +190,7 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
                 if self._closed.is_set():
                     raise StopIteration
                 for p, pos in list(self._fetch_pos.items()):
-                    recs = self._broker.read(self._topic, p, pos, self._max_poll)
+                    recs = self._read(p, pos, self._max_poll)
                     if recs:
                         self._fetch_pos[p] = recs[-1][0] + 1
                         self._buffer.extend((p, o, KeyMessage(k, m)) for o, k, m in recs)
@@ -176,7 +236,7 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
                 n = self._max_poll
                 if limit is not None:
                     n = min(n, limit - self._fetch_pos[p])
-                recs = self._broker.read(self._topic, p, self._fetch_pos[p], n)
+                recs = self._read(p, self._fetch_pos[p], n)
                 if limit is not None:
                     # offsets may be sparse (compacted kafka logs): drop
                     # anything the window excludes and pin the position

@@ -46,6 +46,7 @@ from oryx_tpu.bus.kafkawire import (
     ERR_UNKNOWN_TOPIC_OR_PARTITION,
     ERROR_NAMES,
     Reader,
+    WireDecodeError,
     Writer,
     decode_record_batches,
     encode_record_batch,
@@ -432,8 +433,17 @@ class KafkaBroker(Broker):
             raise KafkaError(err, "fetch")
         if not records_bytes:
             return []
+        try:
+            decoded = decode_record_batches(records_bytes)
+        except WireDecodeError as e:
+            # fail THIS consume with full context; the connection itself is
+            # healthy (the response frame arrived complete), so later
+            # fetches proceed — no desync, no reconnect storm
+            raise WireDecodeError(
+                f"{topic}/p{partition} fetch at offset {offset}: {e}"
+            ) from e
         out = []
-        for abs_off, key, value in decode_record_batches(records_bytes):
+        for abs_off, key, value in decoded:
             if abs_off < offset:
                 continue  # batch containing our offset may start earlier
             if len(out) >= max_records:

@@ -19,6 +19,18 @@ libraries (bus/compress.py ctypes bindings).
 from __future__ import annotations
 
 import struct
+import zlib
+
+
+class WireDecodeError(ValueError):
+    """A record batch that claims to be complete (its length prefix is
+    fully present) decodes to garbage — truncated mid-frame, bit-flipped,
+    or otherwise internally inconsistent. Distinct from the tolerated
+    *trailing partial* batch a broker may legitimately return at the end
+    of a fetch: that one is silently re-fetched, this one must fail the
+    consume loudly with context, because retrying the same bytes can
+    never succeed and guessing at record boundaries would desync every
+    later offset in the stream."""
 
 
 # ---------------------------------------------------------------------------
@@ -247,6 +259,10 @@ class Reader:
             if not b & 0x80:
                 break
             shift += 7
+            if shift > 63:
+                # corrupt data can present an endless continuation-bit
+                # run; a real zigzag-64 never needs more than 10 bytes
+                raise WireDecodeError("varint exceeds 64 bits")
         return (z >> 1) ^ -(z & 1)  # un-zigzag
 
 
@@ -396,58 +412,92 @@ def decode_record_batches(
         base_offset = r.i64()
         batch_len = r.i32()
         if batch_len < 0 or r.remaining() < batch_len:
-            break  # partial trailing batch
+            break  # partial trailing batch (re-fetched from the same offset)
         batch = Reader(r.raw(batch_len))
-        batch.i32()  # partitionLeaderEpoch
-        magic = batch.i8()
-        if magic != 2:
-            raise ValueError(f"unsupported record batch magic {magic}")
-        batch.u32()  # crc (not re-verified on read)
-        attributes = batch.i16()
-        batch.i32()  # lastOffsetDelta
-        batch.i64()  # baseTimestamp
-        batch.i64()  # maxTimestamp
-        batch.i64()  # producerId
-        batch.i16()  # producerEpoch
-        batch.i32()  # baseSequence
-        n_records = batch.i32()
-        payload = batch.raw(batch.remaining())
-        codec = attributes & 0x07
-        if codec == 1:  # gzip
-            import gzip as _gzip
+        try:
+            out.extend(_decode_one_batch(batch, base_offset))
+        except WireDecodeError:
+            raise
+        # OSError/zlib.error cover corrupt COMPRESSED payloads
+        # (gzip.BadGzipFile is an OSError; mid-stream gzip corruption is
+        # zlib.error): no real I/O happens in the decode, so OSError here
+        # can only mean bad bytes — and it must not escape as a
+        # "transient" error the consume retry would pointlessly replay
+        except (
+            EOFError, ValueError, struct.error, MemoryError, OSError,
+            zlib.error,
+        ) as e:
+            # the length prefix promised a complete batch but the bytes
+            # inside don't parse: a mid-frame cut or corruption. Fail THIS
+            # consume with the offset context — never guess at boundaries
+            # and keep scanning, which would desync every later offset.
+            raise WireDecodeError(
+                f"corrupt record batch at base offset {base_offset} "
+                f"(len {batch_len}): {type(e).__name__}: {e}"
+            ) from e
+    return out
 
-            payload = _gzip.decompress(payload)
-        elif codec == 2:  # snappy (raw or xerial-framed)
-            payload = snappy_decompress(payload)
-        elif codec == 3:  # lz4 frame
-            from oryx_tpu.bus.compress import lz4f_decompress
 
-            payload = lz4f_decompress(payload)
-        elif codec == 4:  # zstd
-            from oryx_tpu.bus.compress import zstd_decompress
+def _decode_one_batch(
+    batch: Reader, base_offset: int
+) -> list[tuple[int, bytes | None, bytes | None]]:
+    """Decode one complete-length record batch body (v2)."""
+    batch.i32()  # partitionLeaderEpoch
+    magic = batch.i8()
+    if magic != 2:
+        raise ValueError(f"unsupported record batch magic {magic}")
+    batch.u32()  # crc (not re-verified on read)
+    attributes = batch.i16()
+    batch.i32()  # lastOffsetDelta
+    batch.i64()  # baseTimestamp
+    batch.i64()  # maxTimestamp
+    batch.i64()  # producerId
+    batch.i16()  # producerEpoch
+    batch.i32()  # baseSequence
+    n_records = batch.i32()
+    payload = batch.raw(batch.remaining())
+    codec = attributes & 0x07
+    if codec == 1:  # gzip
+        import gzip as _gzip
 
-            payload = zstd_decompress(payload)
-        elif codec != 0:
-            raise ValueError(f"unsupported compression codec {codec}")
-        pr = Reader(payload)
-        for _ in range(n_records):
-            length = pr.varint()
-            rec = Reader(pr.raw(length))
-            rec.i8()  # attributes
-            rec.varint()  # timestampDelta
-            offset_delta = rec.varint()
-            klen = rec.varint()
-            key = rec.raw(klen) if klen >= 0 else None
-            vlen = rec.varint()
-            value = rec.raw(vlen) if vlen >= 0 else None
-            n_headers = rec.varint()
-            for _ in range(n_headers):
-                hklen = rec.varint()
-                rec.raw(max(0, hklen))
-                hvlen = rec.varint()
-                if hvlen > 0:
-                    rec.raw(hvlen)
-            out.append((base_offset + offset_delta, key, value))
+        payload = _gzip.decompress(payload)
+    elif codec == 2:  # snappy (raw or xerial-framed)
+        payload = snappy_decompress(payload)
+    elif codec == 3:  # lz4 frame
+        from oryx_tpu.bus.compress import lz4f_decompress
+
+        payload = lz4f_decompress(payload)
+    elif codec == 4:  # zstd
+        from oryx_tpu.bus.compress import zstd_decompress
+
+        payload = zstd_decompress(payload)
+    elif codec != 0:
+        raise ValueError(f"unsupported compression codec {codec}")
+    out: list[tuple[int, bytes | None, bytes | None]] = []
+    pr = Reader(payload)
+    for _ in range(n_records):
+        length = pr.varint()
+        if length < 0 or length > pr.remaining():
+            raise ValueError(
+                f"record length {length} exceeds remaining payload "
+                f"{pr.remaining()}"
+            )
+        rec = Reader(pr.raw(length))
+        rec.i8()  # attributes
+        rec.varint()  # timestampDelta
+        offset_delta = rec.varint()
+        klen = rec.varint()
+        key = rec.raw(klen) if klen >= 0 else None
+        vlen = rec.varint()
+        value = rec.raw(vlen) if vlen >= 0 else None
+        n_headers = rec.varint()
+        for _ in range(n_headers):
+            hklen = rec.varint()
+            rec.raw(max(0, hklen))
+            hvlen = rec.varint()
+            if hvlen > 0:
+                rec.raw(hvlen)
+        out.append((base_offset + offset_delta, key, value))
     return out
 
 

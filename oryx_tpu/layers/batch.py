@@ -19,10 +19,14 @@ import weakref
 from oryx_tpu.api import BatchLayerUpdate
 from oryx_tpu.bus.api import ConsumeDataIterator, TopicProducer
 from oryx_tpu.bus.broker import get_broker
+from oryx_tpu.common import faults
 from oryx_tpu.common.classutil import load_instance_of
 from oryx_tpu.common.config import Config
+from oryx_tpu.common.faults import configure_faults
 from oryx_tpu.common.ioutil import delete_older_than, strip_scheme
 from oryx_tpu.common.metrics import GENERATION_BUCKETS, get_registry, maybe_profile
+from oryx_tpu.common.quarantine import Quarantine
+from oryx_tpu.common.retry import configure_retry
 from oryx_tpu.common.tracing import configure_tracing, get_tracer, swap_current
 from oryx_tpu.layers.datastore import LazyPastData, save_generation
 from oryx_tpu.layers.watchdog import running_seconds, start_wedge_watchdog
@@ -122,6 +126,24 @@ class BatchLayer:
         self._prefetch_stop: threading.Event | None = None
         self._prefetch_thread: threading.Thread | None = None
         configure_tracing(config)
+        configure_retry(config)
+        configure_faults(config)
+        # deserialize-poison containment: a record that can never parse
+        # must not enter persisted history, where every later from-scratch
+        # rebuild would re-read it forever. When the update overrides
+        # validate_record, each window is swept once before persisting and
+        # rejects divert to the dead-letter store (common/quarantine.py).
+        self._quarantine = Quarantine(
+            config.get_string(
+                "oryx.monitoring.quarantine.dir", "/tmp/oryx_tpu/quarantine"
+            ),
+            "batch",
+        )
+        ucls = type(self.update)
+        self._validates = (
+            ucls.validate_record is not BatchLayerUpdate.validate_record
+            or ucls.validate_records is not BatchLayerUpdate.validate_records
+        )
         self._profile_dir = config.get_string("oryx.monitoring.profile-dir", None)
         reg = get_registry()
         self._m_generations = reg.counter(
@@ -250,6 +272,8 @@ class BatchLayer:
         # ingest-prefetch thread (running during the build) cannot push
         # unpersisted records past the committed offsets
         window_end = self._consumer.positions()
+        if new_data and self._validates:
+            new_data = self._divert_invalid(new_data)
         # history is handed over LAZILY: an incremental update (persistent
         # aggregate snapshot, ml/update.py) never reads it at all; the
         # from-scratch fallback pays the streamed read on first touch
@@ -273,6 +297,7 @@ class BatchLayer:
                 prev = swap_current(root) if root is not None else None
                 try:
                     with self._m_duration.time(), maybe_profile(self._profile_dir, "batch-gen"):
+                        faults.fire("batch.build")
                         self.update.run_update(
                             ts, new_data, past_data, self.model_dir, self._producer
                         )
@@ -309,6 +334,31 @@ class BatchLayer:
         self._m_generations.inc()
         self._m_records.inc(len(new_data))
         return len(new_data)
+
+    def _divert_invalid(self, records: list) -> list:
+        """Deserialize-poison sweep, once per window before it persists:
+        records the update's validate_record rejects go to the dead-letter
+        store; the rest proceed into the build and persisted history. An
+        unwritable quarantine dir re-queues the WHOLE window in front of
+        the next generation (nothing may be dropped silently) and
+        propagates — offsets stay uncommitted. Divert-before-commit is
+        deliberate at-least-once: a crash between the divert and the
+        offset commit re-diverts the bad records on redelivery
+        (duplicate dead letters); the reverse order would LOSE them
+        outright when a crash lands between commit and divert."""
+        good, bad = [], []
+        for km, ok in zip(records, self.update.validate_records(records)):
+            (good if ok else bad).append(km)
+        if bad:
+            try:
+                self._quarantine.divert(bad, reason="validate_record rejected")
+            except Exception:
+                # mutate in place, never rebind: the prefetch thread
+                # extends this same list object, and a rebind would strand
+                # anything it appended between the copy and the swap
+                self._prefetched[:0] = records
+                raise
+        return good
 
     def _start_prefetch(self) -> None:
         """Ingest/compute overlap: drain the input topic on a background

@@ -28,6 +28,8 @@ import numpy as np
 
 from oryx_tpu.bus.api import KeyMessage
 from oryx_tpu.bus.filelog import _PartitionIndex, encode_record, _maybe_native
+from oryx_tpu.common import faults
+from oryx_tpu.common.retry import retry_call
 from oryx_tpu.common.ioutil import (
     delete_recursively,
     list_generation_dirs,
@@ -48,18 +50,44 @@ _SNAPSHOT_DIR = ".agg-snapshot"
 
 def save_generation(data_dir: str, timestamp_ms: int, records: Sequence[KeyMessage]) -> Path | None:
     """Persist one generation's window; empty windows write nothing
-    (SaveToHDFSFunction skips empty RDDs)."""
+    (SaveToHDFSFunction skips empty RDDs). The append runs under the
+    bounded-retry contract (site "datastore.save"): losing a window to a
+    transient disk hiccup is permanent input loss (the offsets commit
+    right after), so this path absorbs what it can and fails loudly past
+    the deadline — the caller then leaves offsets uncommitted and the
+    window is re-delivered."""
     if not records:
         return None
     d = mkdirs(Path(strip_scheme(data_dir)) / f"oryx-{timestamp_ms}")
     path = d / _DATA_FILE
     blob = b"".join(encode_record(km.key, km.message) for km in records)
     native = _maybe_native()
-    if native is not None:
-        native.append_batch(str(path), blob)
-    else:
-        with open(path, "ab") as f:
-            f.write(blob)
+
+    def _do() -> None:
+        faults.fire("datastore.save_window")
+        if native is not None:
+            native.append_batch(str(path), blob)
+        else:
+            # single unbuffered append: a crash mid-write leaves a torn
+            # TAIL, which the record scanner stops at (filelog
+            # _PartitionIndex) — never a mid-log hole. A retried attempt
+            # after a torn write would double-append, so roll back to the
+            # pre-append size first.
+            pre = path.stat().st_size if path.exists() else 0
+            fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+            try:
+                try:
+                    wrote = os.write(fd, blob)
+                except OSError:
+                    os.ftruncate(fd, pre)
+                    raise
+                if wrote != len(blob):
+                    os.ftruncate(fd, pre)
+                    raise OSError(f"short append to {path}")
+            finally:
+                os.close(fd)
+
+    retry_call("datastore.save", _do)
     return d
 
 
@@ -171,14 +199,17 @@ def save_aggregate_snapshot(
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.npz")
     os.close(fd)
     try:
+        faults.fire("datastore.snapshot_write")
         np.savez(
             tmp,
             fingerprint=np.asarray(fingerprint),
             through_ts=np.asarray(timestamp_ms, dtype=np.int64),
             **arrays,
         )
-        # np.savez appends .npz to paths without the suffix; ours has it
-        os.replace(tmp, path)
+        # np.savez appends .npz to paths without the suffix; ours has it.
+        # Retried (site "datastore.rename"): the tmp is complete, so only
+        # the cheap rename replays.
+        retry_call("datastore.rename", os.replace, tmp, path)
     except BaseException:
         Path(tmp).unlink(missing_ok=True)
         raise
@@ -198,7 +229,16 @@ def finalize_aggregate_snapshot(
     if not staged.exists():
         return False
     final = d / f"agg-{timestamp_ms}.npz"
-    os.replace(staged, final)
+
+    def _do() -> None:
+        faults.fire("datastore.snapshot_rename")
+        os.replace(staged, final)
+
+    # a crash here (between the staged write and this promote) is SAFE by
+    # construction: load ignores .staged files, so the next generation
+    # sees a stale-or-missing snapshot and falls back to the from-scratch
+    # rebuild that re-anchors it (pinned by tests/test_datastore_crash.py)
+    retry_call("datastore.rename", _do)
     _prune_snapshots(data_dir, keep, final)
     return True
 

@@ -19,9 +19,13 @@ import weakref
 from oryx_tpu.api import SpeedModelManager
 from oryx_tpu.bus.api import ConsumeDataIterator, TopicProducer
 from oryx_tpu.bus.broker import get_broker
+from oryx_tpu.common import faults
 from oryx_tpu.common.classutil import load_instance_of
 from oryx_tpu.common.config import Config
+from oryx_tpu.common.faults import configure_faults
 from oryx_tpu.common.metrics import MICROBATCH_BUCKETS, get_registry
+from oryx_tpu.common.quarantine import Quarantine
+from oryx_tpu.common.retry import configure_retry
 from oryx_tpu.common.tracing import configure_tracing, get_tracer
 from oryx_tpu.layers.watchdog import running_seconds, start_wedge_watchdog
 
@@ -51,6 +55,32 @@ class SpeedLayer:
         self._update_consumer: ConsumeDataIterator | None = None
         self.batch_count = 0
         configure_tracing(config)
+        configure_retry(config)
+        configure_faults(config)
+        # poison containment: a window whose build keeps failing rewinds
+        # at most max-attempts times, then the layer bisects it to isolate
+        # the records that deterministically break the build and diverts
+        # them to the dead-letter store — the stream moves forward instead
+        # of rewind-looping forever (the loop oryx_speed_failures_total
+        # made visible). Deserialize-poison (records the app's cheap
+        # validate_record rejects) is diverted before the build even runs.
+        self.quarantine_max_attempts = config.get_int(
+            "oryx.monitoring.quarantine.max-attempts", 2
+        )
+        self._quarantine = Quarantine(
+            config.get_string(
+                "oryx.monitoring.quarantine.dir", "/tmp/oryx_tpu/quarantine"
+            ),
+            "speed",
+        )
+        # sweep records only when the manager actually overrides a hook
+        mcls = type(self.manager)
+        self._validates = (
+            mcls.validate_record is not SpeedModelManager.validate_record
+            or mcls.validate_records is not SpeedModelManager.validate_records
+        )
+        self._window_attempts = 0
+        self._failed_window: dict | None = None
         reg = get_registry()
         self._m_batches = reg.counter(
             "oryx_speed_batches_total", "Completed speed micro-batches"
@@ -122,6 +152,16 @@ class SpeedLayer:
         t_ingest = time.monotonic() if tr.enabled else 0.0
         window_start = self._input_consumer.positions()
         batch = self._input_consumer.poll_available()
+        # deserialize-poison sweep: records the manager's validate hooks
+        # reject are held aside and diverted on the COMMIT path below —
+        # diverting before the build would write a fresh dead-letter copy
+        # on every rewind attempt of a failing window
+        bad: list = []
+        if batch and self._validates:
+            good, bad = [], []
+            for km, ok in zip(batch, self.manager.validate_records(batch)):
+                (good if ok else bad).append(km)
+            batch = good
         if batch:
             # per-generation span tree: ingest -> build -> publish, so a
             # slow micro-batch shows WHERE the interval went (tf.data-style
@@ -135,6 +175,7 @@ class SpeedLayer:
             try:
                 t_build = time.monotonic()
                 with self._m_duration.time():
+                    faults.fire("speed.build")
                     updates = list(self.manager.build_updates(batch))
                 if root is not None:
                     tr.record_interval("speed.build", t_build, parent=root)
@@ -144,8 +185,10 @@ class SpeedLayer:
                 if root is not None:
                     tr.record_interval("speed.publish", t_pub, parent=root)
                 self._m_updates.inc(len(updates))
+                self._window_attempts = 0
+                self._failed_window = None
                 tr.finish(root, updates=len(updates))
-            except Exception:
+            except Exception as e:
                 # rewind to where this window began (NOT the committed
                 # offsets — on a fresh group those fall back to the log end,
                 # which would silently drop the failed window)
@@ -154,16 +197,118 @@ class SpeedLayer:
                 log.exception("speed update build failed; window will be reprocessed")
                 self._m_failures.inc()
                 tr.finish(root, error=True)
-                self._input_consumer.seek(window_start)
-                self.batch_count += 1
-                return len(batch)
+                if self._failed_window == window_start:
+                    self._window_attempts += 1
+                else:
+                    self._window_attempts = 1
+                    self._failed_window = dict(window_start)
+                if self._window_attempts <= self.quarantine_max_attempts:
+                    self._input_consumer.seek(window_start)
+                    self.batch_count += 1
+                    return len(batch)
+                # bounded retries exhausted: the failure is deterministic
+                # for this window. Bisect it to isolate the poison records,
+                # divert them to the dead-letter store, publish what the
+                # surviving records build, and move the stream forward.
+                if not self._contain_poison(batch, window_start, e):
+                    self.batch_count += 1
+                    return len(batch)
             finally:
                 self._batch_started = None
+        if bad:
+            # divert exactly once, on the path that commits past the
+            # window. An unwritable quarantine dir rewinds the window and
+            # propagates — quarantine must never silently drop data.
+            try:
+                self._quarantine.divert(bad, reason="validate_record rejected")
+            except Exception:
+                self._input_consumer.seek(window_start)
+                raise
         self._input_consumer.commit()
         self.batch_count += 1
         self._m_batches.inc()
         self._m_records.inc(len(batch))
         return len(batch)
+
+    def _contain_poison(self, batch, window_start, error: Exception) -> bool:
+        """Last-resort containment for a window that failed its bounded
+        retries: isolate and quarantine the poison records. Returns True
+        when the stream may move past the window (caller then commits);
+        False rewinds once more (isolation itself failed, e.g. the
+        quarantine dir is unwritable — losing the dead letter would be
+        silent data loss, so the window keeps its place in the stream)."""
+        try:
+            updates, poison = self._isolate_poison(batch)
+            if len(poison) == len(batch) > 1:
+                # EVERY record of a multi-record window "poison" is far
+                # more likely an environmental outage (device down, OOM,
+                # dead dependency) than N simultaneous poison records —
+                # bulk-diverting live traffic would convert a transient
+                # outage into silent data diversion. Keep rewinding (the
+                # failure counter stays loud) and re-isolate once the
+                # next attempt sees anything succeed. Single-record
+                # windows still quarantine: blast radius one record,
+                # and a bisect cannot distinguish further anyway.
+                log.error(
+                    "all %d records of the window fail in isolation — "
+                    "treating as an environmental failure, not poison; "
+                    "window will be reprocessed", len(batch),
+                )
+                self._input_consumer.seek(window_start)
+                return False
+            # publish BEFORE diverting: a publish failure rewinds the
+            # window, and a dead letter already written would then be
+            # re-written by the next bisect (duplicate quarantine entries
+            # that replay re-ingests twice). The reverse risk — divert
+            # failing after a successful publish — re-publishes updates
+            # on the retry, which update-topic consumers must already
+            # tolerate (they replay the topic from earliest on restart).
+            if updates:
+                self._producer.send_batch(updates)
+            if poison:
+                self._quarantine.divert(
+                    poison, reason=f"speed build_updates raised: {error!r}"
+                )
+            self._m_updates.inc(len(updates))
+        except Exception:
+            log.exception(
+                "poison isolation failed; window will be reprocessed"
+            )
+            self._input_consumer.seek(window_start)
+            return False
+        log.error(
+            "window of %d record(s) contained after %d failed attempts: "
+            "%d quarantined, %d update(s) published from the survivors",
+            len(batch), self._window_attempts, len(poison), len(updates),
+        )
+        self._window_attempts = 0
+        self._failed_window = None
+        return True
+
+    def _isolate_poison(self, batch):
+        """Bisect the failed window down to the records whose singleton
+        build still raises — O(P log N) builds for P poison records.
+        Updates from the passing chunks are combined; chunk-boundary
+        aggregation may differ slightly from the full-window build
+        (honest degraded mode: the alternative was an infinite rewind)."""
+        updates: list = []
+        poison: list = []
+
+        def walk(chunk) -> None:
+            try:
+                built = list(self.manager.build_updates(chunk))
+            except Exception:
+                if len(chunk) == 1:
+                    poison.append(chunk[0])
+                    return
+                mid = len(chunk) // 2
+                walk(chunk[:mid])
+                walk(chunk[mid:])
+                return
+            updates.extend(built)
+
+        walk(list(batch))
+        return updates, poison
 
     def start(self) -> None:
         self.ensure_streams()

@@ -339,7 +339,13 @@ class MLUpdate(BatchLayerUpdate):
         incremental paths."""
         final_dir = model_root / str(timestamp_ms)
         delete_recursively(final_dir)
-        atomic_rename(staged_dir, final_dir)
+        # bounded retry (common/retry.py): the built candidate is complete
+        # on disk, so only the cheap promote rename replays on a transient
+        # filesystem error — losing a finished multi-hour build to one
+        # EIO here would be the worst trade in the system
+        from oryx_tpu.common.retry import retry_call
+
+        retry_call("datastore.rename", atomic_rename, staged_dir, final_dir)
         model = ModelArtifact.read(final_dir)
         self.publish_model(model, str(final_dir), update_producer)
         self.publish_additional_model_data(model, str(final_dir), update_producer)

@@ -12,12 +12,13 @@ from __future__ import annotations
 
 import json
 import re
+import threading
 import time
 import weakref
 from concurrent.futures import Future
 
 from oryx_tpu.serving.futureutil import try_set_exception, try_set_result
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from oryx_tpu.api import ServingModelManager
@@ -98,12 +99,35 @@ def deferred_map(future: "Future", fn: Callable[[Any], Any]) -> Deferred:
 
 
 class OryxServingException(Exception):
-    """HTTP-status-carrying error (reference OryxServingException)."""
+    """HTTP-status-carrying error (reference OryxServingException).
+    ``headers`` ride the response (e.g. Retry-After on a load shed)."""
 
-    def __init__(self, status: int, message: str = ""):
+    def __init__(
+        self,
+        status: int,
+        message: str = "",
+        headers: tuple[tuple[str, str], ...] = (),
+    ):
         super().__init__(message)
         self.status = status
         self.message = message
+        self.headers = headers
+
+
+class ShedLoad(OryxServingException):
+    """Deliberate 503 under saturation: the serving tier refuses work it
+    cannot queue honestly (batcher backlog past its bound) instead of
+    letting latency grow without limit. Carries Retry-After so
+    well-behaved clients back off. The shed DECISION site (not this
+    constructor) increments `oryx_serving_shed_total`, so the chaos
+    suite can tell a deliberate shed from a real 5xx without merely-
+    constructed instances skewing the count."""
+
+    def __init__(self, message: str = "overloaded", retry_after_sec: int = 1):
+        super().__init__(
+            503, message,
+            headers=(("Retry-After", str(int(retry_after_sec))),),
+        )
 
 
 @dataclass
@@ -118,6 +142,11 @@ class Request:
     # when tracing is enabled; dispatch installs it as the thread-current
     # span so batcher/bus instrumentation parents to it
     trace: Any = None
+    # extra RESPONSE headers accumulated during dispatch (Retry-After on
+    # sheds, Warning on stale-model responses); frontends read this after
+    # the response renders. A side channel rather than a wider render
+    # tuple so the (status, body, content_type) contract stays stable.
+    response_headers: list = field(default_factory=list)
 
     def q1(self, name: str, default: str | None = None) -> str | None:
         vals = self.query.get(name)
@@ -172,6 +201,13 @@ class ServingApp:
         self.model_manager = model_manager
         self.input_producer = input_producer
         self.min_fraction = config.get_float("oryx.serving.min-model-load-fraction", 0.8)
+        # degraded-mode bound: a served model whose publish stamp is older
+        # than this gets a Warning: 110 header on every model-backed
+        # response and flips /healthz readiness (null = no bound). The
+        # model still serves — stale answers beat no answers — but probes
+        # and clients can SEE the degradation.
+        raw_stale = config.get("oryx.serving.api.max-staleness-sec", None)
+        self.max_staleness_sec = float(raw_stale) if raw_stale is not None else None
         # mount point (reference: Tomcat context path, ServingLayer.java);
         # "" = root. Requests outside the prefix 404 before routing.
         raw_ctx = (config.get_string("oryx.serving.api.context-path", "/") or "/").strip("/")
@@ -223,6 +259,26 @@ class ServingApp:
         from oryx_tpu.common.freshness import model_freshness
 
         model_freshness()
+        # adopt the config's retry policy / fault plan (the serving
+        # process's bus producer+consumer run under them too) and
+        # pre-register the robustness metric families — dashboards need
+        # the zero baseline from process start, not a series that pops
+        # into existence on the first retry/shed/quarantine event
+        from oryx_tpu.common import quarantine, retry
+        from oryx_tpu.common.faults import configure_faults, get_injector
+        from oryx_tpu.layers import watchdog
+
+        retry.configure_retry(config)
+        configure_faults(config)
+        retry.ensure_metrics()
+        quarantine.ensure_metrics()
+        get_injector().ensure_metrics()
+        watchdog.ensure_metrics()
+        reg.counter(
+            "oryx_serving_shed_total",
+            "Requests deliberately shed with 503 + Retry-After because a "
+            "serving queue was saturated",
+        )
         self._load_resources()
 
     def _load_resources(self) -> None:
@@ -288,11 +344,57 @@ class ServingApp:
 
     def get_serving_model(self):
         """The loaded model, or 503 until fraction-loaded crosses the
-        threshold (AbstractOryxResource.java:75-95)."""
+        threshold (AbstractOryxResource.java:75-95). A model past the
+        configured staleness bound still serves, but the response carries
+        a ``Warning: 110`` header (RFC 7234 "response is stale") so
+        clients and probes can see degraded mode."""
         model = self.model_manager.get_model()
         if model is None or model.fraction_loaded() < self.min_fraction:
             raise OryxServingException(503, "model not yet available")
+        staleness = self.model_staleness()
+        if staleness is not None:
+            req = getattr(_current_request, "req", None)
+            if req is not None:
+                req.response_headers.append((
+                    "Warning",
+                    f'110 - "stale model: {staleness:.0f}s past publish, '
+                    f'bound {self.max_staleness_sec:.0f}s"',
+                ))
         return model
+
+    def model_staleness(self) -> float | None:
+        """Seconds the served model is past its publish stamp IF that
+        exceeds the configured bound, else None (no bound, no stamp yet,
+        or fresh). Based on the update-topic publish stamps
+        (common/freshness.py), so it measures the pipeline end to end —
+        a dead batch layer shows up here even though serving is healthy."""
+        if self.max_staleness_sec is None:
+            return None
+        from oryx_tpu.common.freshness import model_freshness
+
+        f = model_freshness()
+        if f.published_ms is None:
+            return None  # never stamped: unknown, not provably stale
+        age = max(0.0, time.time() * 1000.0 - f.published_ms) / 1000.0
+        return age if age > self.max_staleness_sec else None
+
+    def degraded_reasons(self) -> list[str]:
+        """Why this serving process is degraded right now (empty = fully
+        healthy). The /healthz readiness surface: model past its
+        staleness bound, top-k serving failed over to host scoring, or a
+        co-resident layer's wedge watchdog tripped."""
+        reasons: list[str] = []
+        if self.model_staleness() is not None:
+            reasons.append("model-stale")
+        from oryx_tpu.serving.batcher import TopKBatcher
+
+        b = TopKBatcher._shared  # peek; never construct on a probe path
+        if b is not None and b._device_down.is_set():
+            reasons.append("device-down")
+        from oryx_tpu.layers.watchdog import wedged_layers
+
+        reasons.extend(f"wedged:{name}" for name in wedged_layers())
+        return reasons
 
     def send_input(self, line: str) -> None:
         """POST a raw input line to the input topic, keyed by its hash
@@ -357,6 +459,17 @@ class ServingApp:
         self._m_requests.inc(method=method, status=str(status))
 
     def _dispatch(self, req: Request):
+        # thread-current request for the duration of the handler call:
+        # helpers without a req in their signature (get_serving_model's
+        # stale-model Warning) attach response headers through it
+        prev_req = getattr(_current_request, "req", None)
+        _current_request.req = req
+        try:
+            return self._dispatch_routed(req)
+        finally:
+            _current_request.req = prev_req
+
+    def _dispatch_routed(self, req: Request):
         if self.context_path:
             if req.path == self.context_path:
                 req.path = "/"
@@ -411,6 +524,9 @@ class ServingApp:
 
 
 _KNOWN_METHODS = frozenset({"GET", "HEAD", "POST", "PUT", "DELETE", "PATCH", "OPTIONS"})
+
+# the request being dispatched on this thread (see ServingApp._dispatch)
+_current_request = threading.local()
 
 
 def _load_fraction(app_ref) -> float:
@@ -480,6 +596,8 @@ def _render_exception(e: BaseException, req: Request) -> tuple[int, bytes, str]:
     """The ONE error-rendering boundary, shared by sync dispatch and
     deferred completion so status/format behavior cannot drift."""
     if isinstance(e, OryxServingException):
+        if e.headers:
+            req.response_headers.extend(e.headers)
         return _render_error(e.status, e.message, req)
     return _render_error(500, f"{type(e).__name__}: {e}", req)
 

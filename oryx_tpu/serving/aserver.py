@@ -587,7 +587,10 @@ class AsyncHTTPServer:
             if dspan is not None:
                 tr.finish(dspan, status=status)
                 span.attrs["status"] = status
-            return status, payload, ctype, ()
+            # headers accumulated during dispatch (Retry-After on sheds,
+            # Warning on stale-model responses) — read AFTER any Deferred
+            # completed, so chained handlers' headers are included too
+            return status, payload, ctype, tuple(req.response_headers)
         finally:
             if own_span:
                 tr.finish(span)

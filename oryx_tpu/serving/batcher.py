@@ -44,6 +44,7 @@ import threading
 import time
 from concurrent.futures import Future
 
+from oryx_tpu.common import faults
 from oryx_tpu.common.tracing import current_span, get_tracer
 from oryx_tpu.serving.futureutil import try_set_exception, try_set_result
 
@@ -66,6 +67,13 @@ from oryx_tpu.ops.als import PALLAS_TOPK_MAX_K
 K_BUCKETS = (16, PALLAS_TOPK_MAX_K, 128, 1024)
 
 MAX_BATCH = 4096  # rows per device dispatch (the bench-measured knee)
+
+# Queue-depth bound before the batcher sheds load (503 + Retry-After via
+# serving/app.ShedLoad) instead of queueing without limit. At the default
+# the backlog is ~2 full dispatches deep — past that, every queued request
+# only adds latency for everyone behind it, and an honest refusal lets
+# the client retry against a replica that has capacity.
+MAX_QUEUE = 8192
 
 # A dispatch stuck this long is a wedged transport, not a slow kernel —
 # EXCEPT while a never-before-dispatched shape may be cold-compiling:
@@ -237,17 +245,31 @@ class TopKBatcher:
                 cls._shared = TopKBatcher()
         return cls._shared
 
+    def configure(self, config) -> None:
+        """Adopt the serving config's shed knobs (ServingLayer.start);
+        0 / negative max-queue disables shedding."""
+        self.max_queue = config.get_int(
+            "oryx.serving.api.shed.max-queue", MAX_QUEUE
+        )
+        self.retry_after_sec = config.get_int(
+            "oryx.serving.api.shed.retry-after-sec", 1
+        )
+
     def __init__(
         self,
         max_batch: int = MAX_BATCH,
         device_timeout: float = DEVICE_TIMEOUT,
         probe_interval: float = PROBE_INTERVAL,
         compile_timeout: float = COMPILE_TIMEOUT,
+        max_queue: int = MAX_QUEUE,
+        retry_after_sec: int = 1,
     ):
         self.max_batch = max_batch
         self.device_timeout = device_timeout
         self.probe_interval = probe_interval
         self.compile_timeout = compile_timeout
+        self.max_queue = max_queue
+        self.retry_after_sec = retry_after_sec
         # dispatch shapes that have completed at least once: their XLA
         # compiles are done, so the wedge watchdog needs no compile grace
         self._compiled_shapes: set[tuple] = set()
@@ -317,6 +339,10 @@ class TopKBatcher:
             ("oryx_topk_device_down",
              "1 while top-k serving is on the degraded host path",
              lambda: 1.0 if self._device_down.is_set() else 0.0),
+            ("oryx_topk_queue_depth",
+             "requests waiting for a device dispatch right now; at "
+             "oryx.serving.api.shed.max-queue new submits shed with 503",
+             lambda: float(len(self._queue))),
             ("oryx_topk_flops_total",
              "analytic FLOPs dispatched to device top-k scoring "
              "(rate over oryx_device_peak_flops = serving MFU)",
@@ -410,6 +436,19 @@ class TopKBatcher:
         with self._cond:
             if self._closed:
                 raise RuntimeError("batcher is closed")
+            if self.max_queue > 0 and len(self._queue) >= self.max_queue:
+                # saturation: refuse honestly instead of queueing without
+                # bound. Raised under the lock so the depth check and the
+                # refusal are one decision; the exception renders as
+                # 503 + Retry-After at the app boundary.
+                from oryx_tpu.common.metrics import get_registry
+                from oryx_tpu.serving.app import ShedLoad
+
+                get_registry().counter("oryx_serving_shed_total").inc()
+                raise ShedLoad(
+                    f"top-k queue saturated ({len(self._queue)} deep)",
+                    retry_after_sec=self.retry_after_sec,
+                )
             # the down-check must happen under the lock: a check-then-queue
             # race against the watchdog's failover would park this request
             # on a wedged device with nothing left to fail it over
@@ -541,6 +580,7 @@ class TopKBatcher:
             # one target matrix must not fail requests scoring another
             shape_key = None
             try:
+                faults.fire("serving.device")
                 y = group[0].y
                 self._last_y = y  # recovery probes re-test against this
                 b = len(group)
@@ -599,14 +639,28 @@ class TopKBatcher:
                 if shape_key is not None:
                     with self._cond:
                         self._compiling.pop(shape_key, None)
-                # the watchdog's drain may be host-resolving these same
-                # futures concurrently — a lost race must not propagate
-                for p in group:
-                    span = p.take_dev_span()
-                    if span is not None:
-                        _TRACER.finish(span, error=type(e).__name__)
-                    try_set_exception(p.future, e)
+                self._fail_group_over(group, e)
         return launched
+
+    def _fail_group_over(self, group: list[_Pending], e: Exception) -> None:
+        """A device dispatch/transfer ERROR (not a wedge — the watchdog
+        owns those): serve the group exactly on the host instead of
+        failing it. Requests without a host matrix get the error; the
+        watchdog's concurrent drain may be host-resolving these same
+        futures, and resolve_on_host/try_set absorb the lost race."""
+        n = 0
+        for p in group:
+            if p.host_mat is not None:
+                if p.resolve_on_host(e):
+                    n += 1
+            else:
+                span = p.take_dev_span()
+                if span is not None:
+                    _TRACER.finish(span, error=type(e).__name__)
+                try_set_exception(p.future, e)
+        if n:
+            with self._lock:
+                self.host_fallbacks += n
 
     def _resolve(
         self, item: tuple[list[_Pending], int, object, object, tuple]
@@ -637,11 +691,9 @@ class TopKBatcher:
             log.exception("batcher group resolve failed (k=%d)", kb)
             with self._cond:
                 self._compiling.pop(shape_key, None)
-            for p in group:
-                span = p.take_dev_span()
-                if span is not None:
-                    _TRACER.finish(span, error=type(e).__name__)
-                try_set_exception(p.future, e)
+            # a device->host transfer ERROR degrades to host scoring like
+            # a dispatch error does (wedges — hangs — stay the watchdog's)
+            self._fail_group_over(group, e)
 
     # -- watchdog: wedged-transport failover -------------------------------
 

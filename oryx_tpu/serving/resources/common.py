@@ -91,14 +91,21 @@ def register(app: ServingApp) -> None:
 
     @app.route("GET", "/healthz", nonblocking=True)
     def healthz(a: ServingApp, req: Request):
-        """Liveness (vs /ready readiness): 200 whenever the frontend can
-        dispatch at all — even with no model loaded — reporting uptime,
-        event-loop fan-out, and the generation id of the model being
-        served (from the update topic's publish stamps)."""
+        """Health probe reporting uptime, event-loop fan-out, and the
+        generation id of the model being served (from the update topic's
+        publish stamps). GET doubles as the DEGRADED-readiness surface:
+        503 + reasons when the served model is past its staleness bound
+        (oryx.serving.api.max-staleness-sec), top-k scoring has failed
+        over to the host path, or a co-resident layer's wedge watchdog
+        tripped — conditions a log line can't route to a load balancer.
+        HEAD stays pure liveness (200 whenever the frontend dispatches),
+        so probes choose their semantics by method."""
         from oryx_tpu.common.freshness import model_freshness
 
-        return 200, {
-            "status": "up",
+        degraded = a.degraded_reasons()
+        return (503 if degraded else 200), {
+            "status": "degraded" if degraded else "up",
+            "degraded": degraded,
             "uptime_seconds": round(time.monotonic() - a.started_at, 3),
             "loops": a.loop_count,
             "model_generation": model_freshness().generation,

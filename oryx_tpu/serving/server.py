@@ -143,6 +143,12 @@ class ServingLayer:
         self._listener.start()
 
         self.app = ServingApp(self.config, self.model_manager, input_producer)
+        # saturation shedding knobs for the process-wide top-k batcher
+        # (oryx.serving.api.shed.*): past max-queue, submits 503 with
+        # Retry-After instead of queueing without bound
+        from oryx_tpu.serving.batcher import TopKBatcher
+
+        TopKBatcher.shared().configure(self.config)
         auth = make_authenticator(self.config)
         frontend = self.config.get_string("oryx.serving.api.server", "async")
         cert = self.config.get_string("oryx.serving.api.ssl-cert-file", None)
@@ -340,6 +346,10 @@ def _make_handler(app: ServingApp, auth: Authenticator | None):
                 tr.log_if_slow(span, log)
             self.send_response(status)
             self.send_header("Content-Type", ctype)
+            # headers accumulated during dispatch (Retry-After on sheds,
+            # Warning on stale-model responses)
+            for k, v in req.response_headers:
+                self.send_header(k, v)
             # compress sizable responses for clients that accept it (the
             # reference gzips csv/json via its Tomcat connector)
             accept_enc = self.headers.get("Accept-Encoding", "")

@@ -51,6 +51,45 @@ def test_checker_resolves_wrapped_and_fstring_calls(tmp_path, monkeypatch):
     assert found == ["oryx.batch.streaming.generation-interval-sec"]
 
 
+def test_checker_catches_dead_robustness_knob(monkeypatch):
+    """The reverse check: a key declared under a strict robustness block
+    (faults/retry/quarantine/shed) that nothing reads must fail — a dead
+    knob misleads operators about what recovery is configured."""
+    tool = _load_tool()
+    real = tool.code_config_keys
+
+    def without_one():
+        keys = real()
+        keys.pop("oryx.monitoring.retry.attempts")
+        return keys
+
+    monkeypatch.setattr(tool, "code_config_keys", without_one)
+    assert tool.main() == 1
+
+
+def test_robustness_keys_present():
+    """Spot-check the failure-containment knobs are both read in code and
+    declared — the coverage this PR's satellite extends the checker to."""
+    tool = _load_tool()
+    code = tool.code_config_keys()
+    ref = tool.reference_config()
+    for key in (
+        "oryx.monitoring.faults.enabled",
+        "oryx.monitoring.faults.plan",
+        "oryx.monitoring.faults.seed",
+        "oryx.monitoring.retry.attempts",
+        "oryx.monitoring.retry.base-ms",
+        "oryx.monitoring.retry.deadline-ms",
+        "oryx.monitoring.quarantine.dir",
+        "oryx.monitoring.quarantine.max-attempts",
+        "oryx.serving.api.shed.max-queue",
+        "oryx.serving.api.shed.retry-after-sec",
+        "oryx.serving.api.max-staleness-sec",
+    ):
+        assert key in code, f"{key} no longer read anywhere"
+        assert ref.has(key), f"{key} missing from reference.conf"
+
+
 def test_known_keys_present():
     """Spot-check the new incremental/warm-start keys are both read in
     code and declared — the exact drift this satellite exists to stop."""

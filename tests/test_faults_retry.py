@@ -1,0 +1,241 @@
+"""Unit tests for the robustness substrate: the fault-injection harness
+(common/faults.py), the bounded-retry policy (common/retry.py), and the
+dead-letter quarantine (common/quarantine.py)."""
+
+import time
+
+import pytest
+
+from oryx_tpu.bus.api import KeyMessage
+from oryx_tpu.common.config import load_config
+from oryx_tpu.common.faults import (
+    FaultSpec,
+    InjectedFault,
+    configure_faults,
+    get_injector,
+)
+from oryx_tpu.common.metrics import get_registry
+from oryx_tpu.common.quarantine import (
+    Quarantine,
+    load_quarantined,
+    quarantine_files,
+)
+from oryx_tpu.common.retry import RetryPolicy, retry_call
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    get_injector().disarm()
+    yield
+    get_injector().disarm()
+
+
+# ---- fault harness --------------------------------------------------------
+
+def test_fire_noop_when_disarmed():
+    get_injector().fire("bus.produce")  # nothing armed: no-op
+
+
+def test_error_fault_fires_exactly_count_times():
+    inj = get_injector()
+    spec = inj.arm("site.a", kind="error", count=2)
+    with pytest.raises(InjectedFault):
+        inj.fire("site.a")
+    with pytest.raises(InjectedFault):
+        inj.fire("site.a")
+    inj.fire("site.a")  # exhausted: clean pass
+    assert spec.fired == 2
+
+
+def test_injected_fault_is_oserror():
+    # retry wrappers classify injected faults as the transient I/O they
+    # simulate — the whole point of chaos exercising the REAL retry path
+    assert issubclass(InjectedFault, OSError)
+
+
+def test_after_skips_clean_passes_first():
+    inj = get_injector()
+    inj.arm("site.b", kind="error", count=1, after=2)
+    inj.fire("site.b")
+    inj.fire("site.b")
+    with pytest.raises(InjectedFault):
+        inj.fire("site.b")
+
+
+def test_latency_fault_sleeps():
+    inj = get_injector()
+    inj.arm("site.c", kind="latency", count=1, latency_s=0.05)
+    t0 = time.monotonic()
+    inj.fire("site.c")
+    assert time.monotonic() - t0 >= 0.05
+    t0 = time.monotonic()
+    inj.fire("site.c")  # count exhausted: no sleep
+    assert time.monotonic() - t0 < 0.05
+
+
+def test_probabilistic_fault_is_seeded_deterministic():
+    def run(seed: int) -> list[bool]:
+        inj = get_injector()
+        inj.disarm()
+        inj._seed = seed
+        inj._rng = None
+        inj.arm("site.p", kind="error", count=-1, probability=0.5)
+        out = []
+        for _ in range(32):
+            try:
+                inj.fire("site.p")
+                out.append(False)
+            except InjectedFault:
+                out.append(True)
+        inj.disarm()
+        return out
+
+    a, b = run(7), run(7)
+    assert a == b  # same seed, same sequence
+    assert any(a) and not all(a)  # actually probabilistic
+
+
+def test_bad_kind_rejected():
+    with pytest.raises(ValueError):
+        FaultSpec(site="x", kind="explode")
+
+
+def test_configure_from_config_plan():
+    cfg = load_config(overlay={
+        "oryx.monitoring.faults.enabled": True,
+        "oryx.monitoring.faults.plan": [
+            {"site": "bus.produce", "kind": "error", "count": 3},
+        ],
+    })
+    configure_faults(cfg)
+    spec = get_injector().spec("bus.produce")
+    assert spec is not None and spec.count == 3 and spec.kind == "error"
+    # a disabled config disarms everything armed before it
+    configure_faults(load_config())
+    assert get_injector().spec("bus.produce") is None
+    assert not get_injector().enabled
+
+
+def test_injection_metric_counts():
+    inj = get_injector()
+    c = get_registry().counter("oryx_fault_injections_total")
+    before = c.value(site="site.m", kind="error")
+    inj.arm("site.m", kind="error", count=1)
+    with pytest.raises(InjectedFault):
+        inj.fire("site.m")
+    assert c.value(site="site.m", kind="error") == before + 1
+
+
+# ---- retry ----------------------------------------------------------------
+
+FAST = RetryPolicy(attempts=4, base_s=0.001, max_s=0.002, deadline_s=5.0)
+
+
+def test_retry_recovers_after_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    c = get_registry().counter("oryx_retry_total")
+    r0 = c.value(site="t.recover", outcome="retry")
+    s0 = c.value(site="t.recover", outcome="recovered")
+    assert retry_call("t.recover", flaky, policy=FAST) == "ok"
+    assert len(calls) == 3
+    assert c.value(site="t.recover", outcome="retry") == r0 + 2
+    assert c.value(site="t.recover", outcome="recovered") == s0 + 1
+
+
+def test_retry_exhausts_and_propagates_last_error():
+    def always():
+        raise OSError("forever")
+
+    c = get_registry().counter("oryx_retry_total")
+    e0 = c.value(site="t.exhaust", outcome="exhausted")
+    with pytest.raises(OSError, match="forever"):
+        retry_call("t.exhaust", always, policy=FAST)
+    assert c.value(site="t.exhaust", outcome="exhausted") == e0 + 1
+
+
+def test_retry_does_not_retry_deterministic_errors():
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise ValueError("deterministic")
+
+    with pytest.raises(ValueError):
+        retry_call("t.det", bad, policy=FAST)
+    assert len(calls) == 1  # no retry for non-transient classes
+
+
+def test_retry_deadline_bounds_total_time():
+    tight = RetryPolicy(attempts=100, base_s=0.05, max_s=0.05, deadline_s=0.1)
+
+    def always():
+        raise OSError("x")
+
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        retry_call("t.deadline", always, policy=tight)
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_policy_from_config():
+    cfg = load_config(overlay={
+        "oryx.monitoring.retry.attempts": 7,
+        "oryx.monitoring.retry.base-ms": 10,
+    })
+    p = RetryPolicy.from_config(cfg)
+    assert p.attempts == 7 and p.base_s == 0.01
+    assert p.max_s == 2.0  # packaged default
+
+
+def test_backoff_grows_and_caps():
+    p = RetryPolicy(attempts=10, base_s=0.01, max_s=0.04, jitter=0.0)
+    assert p.backoff_s(1) == pytest.approx(0.01)
+    assert p.backoff_s(2) == pytest.approx(0.02)
+    assert p.backoff_s(5) == pytest.approx(0.04)  # capped
+
+
+# ---- quarantine -----------------------------------------------------------
+
+def test_quarantine_divert_and_replay_roundtrip(tmp_path):
+    q = Quarantine(str(tmp_path), "speed")
+    recs = [KeyMessage("k1", "u1,i1,5"), KeyMessage(None, "poison{{{")]
+    c = get_registry().counter("oryx_quarantined_records_total")
+    before = c.value(layer="speed")
+    path = q.divert(recs, reason="test")
+    assert path is not None and path.exists()
+    assert c.value(layer="speed") == before + 2
+    # replayable, byte for byte, keys preserved
+    back = load_quarantined(path)
+    assert back == recs
+    assert quarantine_files(str(tmp_path), "speed") == [path]
+    assert quarantine_files(str(tmp_path)) == [path]
+
+
+def test_quarantine_empty_divert_is_noop(tmp_path):
+    q = Quarantine(str(tmp_path), "batch")
+    assert q.divert([], reason="none") is None
+    assert quarantine_files(str(tmp_path)) == []
+
+
+def test_quarantine_no_partial_files_on_crash(tmp_path, monkeypatch):
+    """A crash mid-divert must not leave a half-readable dead letter:
+    the tmp file is renamed only after a full fsync'd write."""
+    import oryx_tpu.common.quarantine as qmod
+
+    q = Quarantine(str(tmp_path), "speed")
+
+    def boom(src, dst):
+        raise OSError("crash before rename")
+
+    monkeypatch.setattr(qmod.os, "replace", boom)
+    with pytest.raises(OSError):
+        q.divert([KeyMessage(None, "x,y,1")], reason="r")
+    # nothing readable landed
+    assert quarantine_files(str(tmp_path)) == []

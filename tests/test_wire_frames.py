@@ -1,0 +1,147 @@
+"""Kafka wire-format robustness: truncated/partial record batches.
+
+The satellite contract (ISSUE 5): a frame cut mid-batch must fail that
+ONE consume with a clear, offset-bearing error — never desync the stream
+by guessing at record boundaries, and never be confused with the
+legitimately-tolerated *trailing partial* batch a broker returns at the
+end of a fetch response.
+"""
+
+import pytest
+
+from oryx_tpu.bus.kafkawire import (
+    Reader,
+    WireDecodeError,
+    decode_record_batches,
+    encode_record_batch,
+)
+
+
+def _batch(n=3, base_ts=1000):
+    return encode_record_batch(
+        [(f"k{i}".encode(), f"value-{i}".encode()) for i in range(n)], base_ts
+    )
+
+
+def test_roundtrip_baseline():
+    out = decode_record_batches(_batch())
+    assert [(o, k) for o, k, _ in out] == [(0, b"k0"), (1, b"k1"), (2, b"k2")]
+
+
+def test_trailing_partial_batch_is_tolerated():
+    """A batch cut by the fetch-size boundary (outer length promises more
+    bytes than remain) is silently dropped: the next fetch re-reads from
+    the same offset, so nothing is lost and nothing errors."""
+    good, partial = _batch(2), _batch(3)
+    data = good + partial[: len(partial) // 2]
+    out = decode_record_batches(data)
+    assert len(out) == 2  # the complete batch only
+
+
+def test_mid_frame_cut_inside_complete_batch_raises_clear_error():
+    """The regression: a batch whose length prefix is intact but whose
+    record bytes were cut (tail zero-filled by a torn write) must raise
+    WireDecodeError with offset context, not a bare EOFError or silent
+    garbage records."""
+    raw = bytearray(_batch(3))
+    # zero the last third of the records section; outer framing intact
+    cut = len(raw) - len(raw) // 3
+    for i in range(cut, len(raw)):
+        raw[i] = 0
+    with pytest.raises(WireDecodeError, match="base offset 0"):
+        decode_record_batches(bytes(raw))
+
+
+def test_corrupt_batch_after_good_batch_names_its_offset():
+    good = _batch(2, base_ts=1)
+    bad = bytearray(_batch(2, base_ts=2))
+    # second batch starts at absolute offset 0 too (encode_record_batch
+    # writes baseOffset 0); corrupt ITS records region
+    for i in range(len(bad) - 8, len(bad)):
+        bad[i] = 0xFF
+    with pytest.raises(WireDecodeError):
+        decode_record_batches(good + bytes(bad))
+
+
+def test_record_length_beyond_payload_rejected():
+    raw = bytearray(_batch(1))
+    # inflate the record-count field so the decoder expects a second
+    # record that does not exist
+    # layout: baseOffset(8) len(4) leaderEpoch(4) magic(1) crc(4)
+    #         attrs(2) lastOffsetDelta(4) ts(8+8) pid(8) epoch(2) seq(4)
+    #         recordCount(4)
+    count_at = 8 + 4 + 4 + 1 + 4 + 2 + 4 + 16 + 8 + 2 + 4
+    raw[count_at:count_at + 4] = (99).to_bytes(4, "big")
+    with pytest.raises(WireDecodeError):
+        decode_record_batches(bytes(raw))
+
+
+def test_corrupt_gzip_payload_maps_to_wire_decode_error():
+    """Regression (review): a claimed-complete batch whose COMPRESSED
+    payload is corrupt must raise WireDecodeError like any other corrupt
+    frame — gzip.BadGzipFile is an OSError, and letting it escape would
+    make the consume retry replay deterministically-bad bytes."""
+    raw = bytearray(_batch(2))
+    # set attributes codec bits to gzip(1); the payload is NOT gzip
+    attrs_at = 8 + 4 + 4 + 1 + 4  # baseOffset len leaderEpoch magic crc
+    raw[attrs_at:attrs_at + 2] = (1).to_bytes(2, "big")
+    with pytest.raises(WireDecodeError, match="base offset 0"):
+        decode_record_batches(bytes(raw))
+
+
+def test_truncated_gzip_stream_maps_to_wire_decode_error():
+    import gzip
+
+    payload = gzip.compress(b"x" * 256)[: 40]  # truncated mid-stream
+    raw = bytearray(_batch(1))
+    attrs_at = 8 + 4 + 4 + 1 + 4
+    raw[attrs_at:attrs_at + 2] = (1).to_bytes(2, "big")
+    # splice the truncated gzip bytes in as the records payload
+    head = bytes(raw[: attrs_at + 2 + 4 + 16 + 8 + 2 + 4 + 4])
+    body = head[12:] + payload  # after baseOffset+len framing
+    framed = raw[:8] + len(body).to_bytes(4, "big") + body
+    with pytest.raises(WireDecodeError):
+        decode_record_batches(bytes(framed))
+
+
+def test_unbounded_varint_rejected():
+    r = Reader(b"\xff" * 16)
+    with pytest.raises(WireDecodeError, match="varint"):
+        r.varint()
+
+
+def test_consume_fails_once_then_stream_recovers():
+    """Layer-level contract: a broker read that hits a corrupt frame
+    fails THAT consume with the decode error (no retry — deterministic),
+    and the next read against healthy bytes proceeds normally."""
+    from oryx_tpu.bus.api import ConsumeDataIterator
+
+    class FlakyBroker:
+        def __init__(self):
+            self.reads = 0
+
+        def num_partitions(self, topic):
+            return 1
+
+        def end_offsets(self, topic):
+            return [0]
+
+        def get_offsets(self, group, topic):
+            return {}
+
+        def commit_offsets(self, group, topic, offsets):
+            pass
+
+        def read(self, topic, p, off, n):
+            self.reads += 1
+            if self.reads == 1:
+                raise WireDecodeError("corrupt record batch at base offset 5")
+            return [(off, None, "fine")] if off == 0 else []
+
+    broker = FlakyBroker()
+    it = ConsumeDataIterator(broker, "t", start="earliest")
+    with pytest.raises(WireDecodeError):
+        it.poll_available()
+    got = it.poll_available()
+    assert [km.message for km in got] == ["fine"]
+    it.close()

@@ -1,0 +1,297 @@
+#!/usr/bin/env python
+"""Chaos driver: script exact failure sequences against a live-in-process
+lambda slice and verify the containment contracts hold.
+
+Each scenario arms the deterministic fault harness
+(oryx_tpu/common/faults.py) at a named injection point, drives the
+affected tier end-to-end on an in-process broker and temp dirs, and
+checks the acceptance property — no lost committed records, quarantined
+records replayable, degraded mode instead of failure. The same sites can
+be armed against a REAL deployment through config
+(``oryx.monitoring.faults.enabled`` + ``plan``; see
+docs/operations.md "Failure handling & chaos testing").
+
+    python tools/chaos.py --list
+    python tools/chaos.py bus-produce-flake poison-record
+    python tools/chaos.py all
+    python tools/chaos.py replay-quarantine /tmp/oryx_tpu/quarantine/speed/dl-*.jsonl
+
+Exit status 0 = every scenario's contract held; 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SCENARIOS: dict[str, tuple[str, "callable"]] = {}
+
+
+def scenario(name: str, doc: str):
+    def deco(fn):
+        SCENARIOS[name] = (doc, fn)
+        return fn
+
+    return deco
+
+
+def _slice(tmp: str, name: str):
+    """A speed-tier slice on an in-process broker: (config, layer, broker,
+    input topic)."""
+    from oryx_tpu.bus.broker import get_broker, topics
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.layers.speed import SpeedLayer
+    from oryx_tpu.api import AbstractSpeedModelManager
+
+    class Echo(AbstractSpeedModelManager):
+        def consume_key_message(self, key, message):
+            pass
+
+        def build_updates(self, new_data):
+            for km in new_data:
+                if km.message == "poison":
+                    raise ValueError("poison record broke the build")
+            return [("UP", km.message) for km in new_data]
+
+    cfg = load_config(overlay={
+        "oryx.id": name,
+        "oryx.input-topic.broker": f"mem://{name}",
+        "oryx.update-topic.broker": f"mem://{name}",
+        "oryx.monitoring.quarantine.dir": os.path.join(tmp, "quarantine"),
+        "oryx.monitoring.quarantine.max-attempts": 1,
+        "oryx.monitoring.retry.base-ms": 5,
+    })
+    in_topic = cfg.get_string("oryx.input-topic.message.topic")
+    up_topic = cfg.get_string("oryx.update-topic.message.topic")
+    topics.maybe_create(f"mem://{name}", in_topic, 2)
+    topics.maybe_create(f"mem://{name}", up_topic, 1)
+    layer = SpeedLayer(cfg, manager=Echo())
+    layer.ensure_streams()
+    return cfg, layer, get_broker(f"mem://{name}"), in_topic
+
+
+def _updates(broker, topic: str) -> list[str]:
+    out = []
+    for p in range(broker.num_partitions(topic)):
+        out.extend(m for _, _, m in broker.read(topic, p, 0, 100_000))
+    return sorted(out)
+
+
+@scenario("bus-produce-flake",
+          "two injected bus.produce failures mid-micro-batch; the retry "
+          "must absorb them with zero record loss")
+def bus_produce_flake(tmp: str) -> list[str]:
+    from oryx_tpu.common.faults import get_injector
+
+    cfg, layer, broker, in_topic = _slice(tmp, "chaos-cli-bus")
+    for i in range(5):
+        broker.send(in_topic, None, f"rec-{i}")
+    get_injector().arm("bus.produce", kind="error", count=2)
+    layer.run_batch()
+    got = _updates(broker, cfg.get_string("oryx.update-topic.message.topic"))
+    problems = []
+    if got != [f"rec-{i}" for i in range(5)]:
+        problems.append(f"updates lost or duplicated: {got}")
+    if layer._m_failures.value() != 0:
+        problems.append("rewind path fired despite retry")
+    layer.close()
+    return problems
+
+
+@scenario("poison-record",
+          "a record that deterministically breaks the build; after bounded "
+          "retries it must be quarantined (replayable) and the stream must "
+          "converge")
+def poison_record(tmp: str) -> list[str]:
+    from oryx_tpu.common.quarantine import load_quarantined, quarantine_files
+
+    cfg, layer, broker, in_topic = _slice(tmp, "chaos-cli-poison")
+    for m in ("good-a", "poison", "good-b"):
+        broker.send(in_topic, m, m)
+    layer.run_batch()  # attempt 1: rewinds
+    layer.run_batch()  # attempt 2: isolates + quarantines + commits
+    problems = []
+    files = quarantine_files(os.path.join(tmp, "quarantine"), "speed")
+    if len(files) != 1:
+        problems.append(f"expected 1 dead-letter file, found {len(files)}")
+    else:
+        dead = [km.message for km in load_quarantined(files[0])]
+        if dead != ["poison"]:
+            problems.append(f"dead letter holds {dead}, want ['poison']")
+    got = _updates(broker, cfg.get_string("oryx.update-topic.message.topic"))
+    if got != ["good-a", "good-b"]:
+        problems.append(f"survivor updates wrong: {got}")
+    broker.send(in_topic, None, "good-c")
+    if layer.run_batch() != 1:
+        problems.append("stream did not converge after quarantine")
+    layer.close()
+    return problems
+
+
+@scenario("snapshot-rename-crash",
+          "hard-kill (os._exit) injected between the staged aggregate-"
+          "snapshot write and its finalize rename, in a child process; "
+          "the parent's reload must see no snapshot and fall back clean")
+def snapshot_rename_crash(tmp: str) -> list[str]:
+    import subprocess
+
+    data_dir = os.path.join(tmp, "data")
+    code = f"""
+import sys; sys.path.insert(0, {ROOT!r})
+import numpy as np
+from oryx_tpu.common.faults import get_injector
+from oryx_tpu.layers.datastore import (
+    finalize_aggregate_snapshot, save_aggregate_snapshot)
+save_aggregate_snapshot({data_dir!r}, 1000, "fp", {{"v": np.arange(3)}}, staged=True)
+get_injector().arm("datastore.snapshot_rename", kind="crash", count=1)
+finalize_aggregate_snapshot({data_dir!r}, 1000)
+print("UNREACHABLE")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=120,
+    )
+    problems = []
+    if proc.returncode != 137:
+        problems.append(f"child exited {proc.returncode}, want 137 (killed)")
+    from oryx_tpu.layers.datastore import (
+        finalize_aggregate_snapshot,
+        load_aggregate_snapshot,
+    )
+
+    if load_aggregate_snapshot(data_dir, "fp") is not None:
+        problems.append("half-promoted snapshot became loadable")
+    # recovery: the staged file survived; a later finalize promotes it
+    if not finalize_aggregate_snapshot(data_dir, 1000):
+        problems.append("staged snapshot lost by the crash")
+    elif load_aggregate_snapshot(data_dir, "fp") is None:
+        problems.append("snapshot unreadable after recovery finalize")
+    return problems
+
+
+@scenario("device-transfer-error",
+          "injected device dispatch error on the serving batcher; the "
+          "request must be served exactly from the host matrix, no 5xx")
+def device_transfer_error(tmp: str) -> list[str]:
+    import numpy as np
+
+    from oryx_tpu.common.faults import get_injector
+    from oryx_tpu.serving.batcher import TopKBatcher, host_topk
+
+    host = np.asarray(
+        [[1.0, 0.0], [0.0, 1.0], [0.5, 0.5], [2.0, 1.0]], dtype=np.float32
+    )
+    import jax.numpy as jnp
+
+    y = jnp.asarray(host)
+    vec = np.asarray([1.0, 2.0], dtype=np.float32)
+    b = TopKBatcher()
+    problems = []
+    try:
+        get_injector().arm("serving.device", kind="error", count=1)
+        vals, idx = b.submit(vec, 2, y, host_mat=host)
+        evals, eidx = host_topk(vec, 2, host)
+        if list(idx) != list(eidx):
+            problems.append(f"degraded result wrong: {list(idx)} != {list(eidx)}")
+        if b.host_fallbacks != 1:
+            problems.append(f"host_fallbacks={b.host_fallbacks}, want 1")
+        vals2, idx2 = b.submit(vec, 2, y, host_mat=host)
+        if list(idx2) != list(eidx):
+            problems.append("device path did not resume after the error")
+    finally:
+        b.close()
+    return problems
+
+
+@scenario("batcher-overload",
+          "top-k queue at its bound; the next submit must shed with a "
+          "deliberate 503 + Retry-After instead of queueing")
+def batcher_overload(tmp: str) -> list[str]:
+    import numpy as np
+
+    from oryx_tpu.serving.app import ShedLoad
+    from oryx_tpu.serving.batcher import TopKBatcher
+
+    b = TopKBatcher(max_queue=1)
+    b._ensure_thread = lambda: None  # freeze the dispatcher
+    b._ensure_watchdog = lambda: None
+    problems = []
+    y = np.zeros((4, 2), dtype=np.float32)
+    try:
+        b.submit_nowait(np.zeros(2), 1, y)
+        try:
+            b.submit_nowait(np.zeros(2), 1, y)
+            problems.append("saturated submit was queued, not shed")
+        except ShedLoad as e:
+            if ("Retry-After", "1") not in e.headers:
+                problems.append(f"shed lacks Retry-After: {e.headers}")
+    finally:
+        b._closed = True
+    return problems
+
+
+def replay_quarantine(paths: list[str]) -> int:
+    """Print a dead-letter file's records as raw input lines, ready to
+    pipe into `curl --data-binary @- .../ingest` once the poison cause is
+    fixed."""
+    from oryx_tpu.common.quarantine import load_quarantined
+
+    n = 0
+    for p in paths:
+        for km in load_quarantined(p):
+            sys.stdout.write(km.message + "\n")
+            n += 1
+    print(f"# {n} record(s) from {len(paths)} dead-letter file(s)", file=sys.stderr)
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("what", nargs="*", help="scenario names, 'all', or "
+                    "'replay-quarantine <files...>'")
+    ap.add_argument("--list", action="store_true", help="list scenarios")
+    args = ap.parse_args()
+    if args.list or not args.what:
+        for name, (doc, _) in SCENARIOS.items():
+            print(f"{name:24s} {doc}")
+        print(f"{'replay-quarantine':24s} print a dead-letter file's records "
+              "as re-ingestable input lines")
+        return 0
+    if args.what[0] == "replay-quarantine":
+        return replay_quarantine(args.what[1:])
+    names = list(SCENARIOS) if args.what == ["all"] else args.what
+    failed = 0
+    from oryx_tpu.bus.inproc import InProcBroker
+    from oryx_tpu.common.faults import get_injector
+
+    for name in names:
+        if name not in SCENARIOS:
+            print(f"unknown scenario: {name}", file=sys.stderr)
+            return 1
+        doc, fn = SCENARIOS[name]
+        get_injector().disarm()
+        InProcBroker.reset_all()
+        with tempfile.TemporaryDirectory(prefix=f"oryx-chaos-{name}-") as tmp:
+            try:
+                problems = fn(tmp)
+            except Exception as e:  # noqa: BLE001 - report, keep going
+                problems = [f"scenario raised {type(e).__name__}: {e}"]
+        get_injector().disarm()
+        if problems:
+            failed += 1
+            print(f"FAIL {name}")
+            for p in problems:
+                print(f"     {p}")
+        else:
+            print(f"PASS {name}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,13 @@ Keys composed with f-string interpolation (``f"oryx.als.{k}"``) cannot be
 resolved statically and are skipped; fully dynamic reads should go
 through such a composition on purpose.
 
+The robustness blocks (``oryx.monitoring.faults`` / ``retry`` /
+``quarantine`` and ``oryx.serving.api.shed``) are additionally checked in
+REVERSE: every key declared there must be read somewhere in code. These
+knobs gate failure-handling behavior — a declared-but-never-read retry or
+quarantine key would let an operator believe a recovery path is
+configured when nothing consumes it.
+
 Exit status 0 = consistent; 1 = drift (each problem printed on stderr).
 """
 
@@ -51,6 +58,15 @@ def reference_config():
     return parse_config(REFERENCE.read_text(encoding="utf-8"))
 
 
+# Blocks whose declared keys must each be READ by code (reverse check).
+STRICT_BLOCKS = (
+    "oryx.monitoring.faults",
+    "oryx.monitoring.retry",
+    "oryx.monitoring.quarantine",
+    "oryx.serving.api.shed",
+)
+
+
 def main() -> int:
     problems: list[str] = []
     if not REFERENCE.exists():
@@ -65,6 +81,15 @@ def main() -> int:
                 f"{key} ({code[key]}): read in code but not declared in "
                 "common/reference.conf"
             )
+    flat = ref.flatten()
+    for block in STRICT_BLOCKS:
+        for key in sorted(k for k in flat if k.startswith(block + ".")):
+            if key not in code:
+                problems.append(
+                    f"{key}: declared in common/reference.conf but never "
+                    "read by any Config accessor — a dead robustness knob "
+                    "misleads operators about what recovery is configured"
+                )
     for p in problems:
         print(p, file=sys.stderr)
     if not problems:
