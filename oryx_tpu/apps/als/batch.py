@@ -83,6 +83,13 @@ class ALSUpdate(MLUpdate):
         self._agg_through_ts: int | None = None  # newest generation folded
         self._prev_item_ids = None  # last generation's Y alignment table
         self._prev_y: np.ndarray | None = None
+        # the batch process's train-scan dispatches feed the live perf
+        # accounting (oryx_device_mfu{kind="train"} and friends) — adopt
+        # the configured window/peak and register the families so a
+        # co-resident serving /metrics page carries them from start
+        from oryx_tpu.common.perfstats import configure_perfstats
+
+        configure_perfstats(config)
         reg = get_registry()
         self._m_agg_rows = reg.gauge(
             "oryx_batch_aggregate_rows",

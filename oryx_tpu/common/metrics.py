@@ -35,6 +35,27 @@ MICROBATCH_BUCKETS = (
 )
 
 
+def linear_buckets(start: float, width: float, count: int) -> tuple[float, ...]:
+    """`count` bucket upper bounds starting at `start`, `width` apart —
+    the right shape for bounded ratios (occupancy) and queue depths,
+    where log spacing would waste resolution at the interesting end."""
+    if count < 1:
+        raise ValueError("count must be >= 1")
+    return tuple(start + width * i for i in range(count))
+
+
+def exponential_buckets(
+    start: float, factor: float, count: int
+) -> tuple[float, ...]:
+    """`count` bucket upper bounds: start, start*factor, ... — the right
+    shape for latencies and byte counts spanning orders of magnitude."""
+    if count < 1:
+        raise ValueError("count must be >= 1")
+    if start <= 0 or factor <= 1:
+        raise ValueError("start must be > 0 and factor > 1")
+    return tuple(start * factor**i for i in range(count))
+
+
 class GaugeSeriesGone(Exception):
     """Raised by a bound gauge/counter callable to permanently remove its
     series (e.g. the object it reports on was garbage-collected). Any
@@ -127,10 +148,23 @@ class Counter:
             return float(fn())  # outside the lock: callables may be slow
         return v
 
-    def render(self) -> list[str]:
+    def render(self, openmetrics: bool = False) -> list[str]:
+        # OpenMetrics counter contract: the METRIC FAMILY name carries no
+        # _total suffix — samples are `<family>_total` — so the HELP/TYPE
+        # lines must strip it or a strict parser (prometheus_client's
+        # openmetrics decoder) rejects the whole page as a name clash.
+        # Legacy counters that predate the suffix contract expose as
+        # `unknown` under negotiation (their samples can't legally be
+        # counter samples). Classic text keeps the full name everywhere.
+        family, kind = self.name, "counter"
+        if openmetrics:
+            if self.name.endswith("_total"):
+                family = self.name[: -len("_total")]
+            else:
+                kind = "unknown"
         lines = [
-            f"# HELP {self.name} {_escape_help(self.help)}",
-            f"# TYPE {self.name} counter",
+            f"# HELP {family} {_escape_help(self.help)}",
+            f"# TYPE {family} {kind}",
         ]
         with self._lock:
             keys = sorted(set(self._values) | set(self._fns))
@@ -200,7 +234,7 @@ class Gauge:
             return float(fn())
         return v
 
-    def render(self) -> list[str]:
+    def render(self, openmetrics: bool = False) -> list[str]:
         lines = [
             f"# HELP {self.name} {_escape_help(self.help)}",
             f"# TYPE {self.name} gauge",
@@ -236,7 +270,17 @@ class Gauge:
 
 class Histogram:
     """Cumulative-bucket histogram (Prometheus semantics: each bucket counts
-    observations <= its upper bound, +Inf bucket == count)."""
+    observations <= its upper bound, +Inf bucket == count).
+
+    Bucket boundaries are per-metric (see ``linear_buckets`` /
+    ``exponential_buckets``): queue depths and occupancy ratios need
+    linear spacing, latencies need exponential — one global scheme fits
+    neither. Observations may carry a trace-id exemplar: the bucket the
+    value lands in remembers the most recent (trace_id, value, wall-time)
+    sample, rendered in OpenMetrics exemplar syntax so a "p99 got worse"
+    bucket resolves to an actual traced request in /debug/traces.
+    Exemplars only exist while tracing supplies ids, so the exposition
+    stays plain Prometheus text when tracing is off."""
 
     kind = "histogram"
 
@@ -247,17 +291,28 @@ class Histogram:
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
         self._totals: dict[tuple, int] = {}
+        # label-key -> {bucket index (len(buckets) = +Inf): (trace_id,
+        # value, unix ts)} — newest observation wins per bucket
+        self._exemplars: dict[tuple, dict[int, tuple[str, float, float]]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float, **labels: str) -> None:
+    def observe(
+        self, value: float, trace_id: str | None = None, **labels: str
+    ) -> None:
         key = _label_key(labels)
         with self._lock:
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            idx = len(self.buckets)  # +Inf
             for i, ub in enumerate(self.buckets):
                 if value <= ub:
                     counts[i] += 1
+                    idx = min(idx, i)
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+            if trace_id:
+                self._exemplars.setdefault(key, {})[idx] = (
+                    str(trace_id), value, time.time()
+                )
 
     @contextmanager
     def time(self, **labels: str) -> Iterator[None]:
@@ -275,7 +330,31 @@ class Histogram:
         with self._lock:
             return self._sums.get(_label_key(labels), 0.0)
 
-    def render(self) -> list[str]:
+    def bucket_counts(self, **labels: str) -> list[tuple[float, int]]:
+        """Cumulative (upper_bound, count) pairs including +Inf,
+        snapshotted under the lock — an unlocked read can race an
+        in-flight observe and see a bucket list mid-update (the same
+        torn-read class PR 2 fixed for Counter.value)."""
+        key = _label_key(labels)
+        with self._lock:
+            counts = list(self._counts.get(key, [0] * len(self.buckets)))
+            total = self._totals.get(key, 0)
+        out = [(ub, counts[i]) for i, ub in enumerate(self.buckets)]
+        out.append((float("inf"), total))
+        return out
+
+    def exemplar(self, bucket_index: int, **labels: str):
+        """(trace_id, value, unix_ts) recorded for the bucket at
+        ``bucket_index`` (len(buckets) = the +Inf bucket), or None."""
+        with self._lock:
+            return self._exemplars.get(_label_key(labels), {}).get(bucket_index)
+
+    def render(self, openmetrics: bool = False) -> list[str]:
+        """Exemplars render ONLY under openmetrics=True: the classic
+        text exposition (text/plain; version=0.0.4) has no exemplar
+        syntax, and a legacy parser hits the trailing `# {...}` and fails
+        the whole scrape — exemplars are legal solely under
+        application/openmetrics-text content negotiation."""
         lines = [
             f"# HELP {self.name} {_escape_help(self.help)}",
             f"# TYPE {self.name} histogram",
@@ -285,12 +364,32 @@ class Histogram:
             counts = {k: list(v) for k, v in self._counts.items()}
             sums = dict(self._sums)
             totals = dict(self._totals)
+            exemplars = (
+                {k: dict(v) for k, v in self._exemplars.items()}
+                if openmetrics else {}
+            )
+
+        def _ex(key: tuple, idx: int) -> str:
+            ex = exemplars.get(key, {}).get(idx)
+            if ex is None:
+                return ""
+            tid, val, ts = ex
+            return (
+                f' # {{trace_id="{_escape(tid)}"}} {_fmt_value(val)} {ts:.3f}'
+            )
+
         for key in items:
             for i, ub in enumerate(self.buckets):
                 bkey = key + (("le", _fmt_value(ub)),)
-                lines.append(f"{self.name}_bucket{_fmt_labels(bkey)} {counts[key][i]}")
+                lines.append(
+                    f"{self.name}_bucket{_fmt_labels(bkey)} "
+                    f"{counts[key][i]}{_ex(key, i)}"
+                )
             inf_key = key + (("le", "+Inf"),)
-            lines.append(f"{self.name}_bucket{_fmt_labels(inf_key)} {totals[key]}")
+            lines.append(
+                f"{self.name}_bucket{_fmt_labels(inf_key)} "
+                f"{totals[key]}{_ex(key, len(self.buckets))}"
+            )
             lines.append(f"{self.name}_sum{_fmt_labels(key)} {_fmt_value(sums[key])}")
             lines.append(f"{self.name}_count{_fmt_labels(key)} {totals[key]}")
         return lines
@@ -324,16 +423,37 @@ class MetricsRegistry:
         return self._get_or_create(Gauge, name, help, labeled=labeled)
 
     def histogram(
-        self, name: str, help: str = "", buckets: tuple[float, ...] = DEFAULT_BUCKETS
+        self, name: str, help: str = "", buckets: tuple[float, ...] | None = None
     ) -> Histogram:
-        return self._get_or_create(Histogram, name, help, buckets=buckets)
+        """buckets=None adopts DEFAULT_BUCKETS on first registration and
+        accepts whatever an existing metric was registered with.
+        Explicitly-passed buckets that disagree with an existing metric's
+        raise — two call sites silently observing into different bucket
+        schemes under one name would corrupt every quantile read."""
+        h = self._get_or_create(
+            Histogram, name, help,
+            buckets=DEFAULT_BUCKETS if buckets is None else buckets,
+        )
+        if buckets is not None and h.buckets != tuple(sorted(buckets)):
+            raise ValueError(
+                f"metric {name} already registered with buckets "
+                f"{h.buckets}, conflicting with {tuple(sorted(buckets))}"
+            )
+        return h
 
-    def render_prometheus(self) -> str:
+    def render_prometheus(self, openmetrics: bool = False) -> str:
+        """Text exposition. openmetrics=True renders the OpenMetrics
+        dialect — exemplars on histogram buckets, non-`_total` counters
+        as `unknown`, terminating `# EOF` — for scrapers that negotiated
+        `application/openmetrics-text`; the default stays classic
+        Prometheus text, which has no exemplar syntax."""
         with self._lock:
             metrics = [self._metrics[k] for k in sorted(self._metrics)]
         lines: list[str] = []
         for m in metrics:
-            lines.extend(m.render())
+            lines.extend(m.render(openmetrics=openmetrics))
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
     def clear(self) -> None:

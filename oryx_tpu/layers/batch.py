@@ -128,6 +128,11 @@ class BatchLayer:
         configure_tracing(config)
         configure_retry(config)
         configure_faults(config)
+        # runtime perf accounting: the train-scan dispatches of this
+        # layer's builds report into oryx_device_mfu{kind="train"} etc.
+        from oryx_tpu.common.perfstats import configure_perfstats
+
+        configure_perfstats(config)
         # deserialize-poison containment: a record that can never parse
         # must not enter persisted history, where every later from-scratch
         # rebuild would re-read it forever. When the update overrides

@@ -57,6 +57,11 @@ class SpeedLayer:
         configure_tracing(config)
         configure_retry(config)
         configure_faults(config)
+        # runtime perf accounting (device-dispatch cost records from any
+        # fold-in/train work this process runs) adopts the same config
+        from oryx_tpu.common.perfstats import configure_perfstats
+
+        configure_perfstats(config)
         # poison containment: a window whose build keeps failing rewinds
         # at most max-attempts times, then the layer bisects it to isolate
         # the records that deterministically break the build and diverts

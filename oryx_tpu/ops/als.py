@@ -681,6 +681,44 @@ def _finish_model(x, y, n_u: int, n_i: int, data) -> ALSModelArrays:
     return ALSModelArrays(x, y, data.user_ids, data.item_ids)
 
 
+def _record_train_dispatch(
+    args, train_flops, train_s, n_u, n_i, n_u_pad, n_i_pad, features,
+    compute_dtype,
+) -> None:
+    """Report one train-scan execution's cost (FLOPs, approximate bytes
+    uploaded + factor tables back, wall-clock, row-padding occupancy) to
+    the runtime perf accounting — the train-side twin of the serving
+    batcher's per-dispatch records. Never lets accounting break training."""
+    try:
+        from oryx_tpu.common.perfstats import get_perfstats
+        from oryx_tpu.ops.flops import device_peak_flops
+
+        dtype = (
+            "bfloat16" if str(compute_dtype).startswith("bf") else "float32"
+        )
+        ps = get_perfstats()
+        # the backend is live here (the scan just ran), so resolving the
+        # chip peak is safe — ensure_peak caches the one resolution
+        ps.ensure_peak("train", lambda: device_peak_flops(dtype))
+        bytes_moved = float(
+            sum(
+                getattr(a, "nbytes", 0)
+                for bucket in args[0] + args[1]
+                for a in bucket
+            )
+            + getattr(args[2], "nbytes", 0)
+            + (n_u_pad + n_i_pad) * features * 4
+        )
+        ps.record_dispatch(
+            "train",
+            flops=train_flops, bytes_moved=bytes_moved, wall_s=train_s,
+            rows=n_u + n_i, padded_rows=n_u_pad + n_i_pad,
+            valid_rows=n_u + n_i, capacity_rows=n_u_pad + n_i_pad,
+        )
+    except Exception:  # pragma: no cover - accounting must not break builds
+        pass
+
+
 def train_als(
     data: InteractionData,
     features: int = 10,
@@ -773,6 +811,21 @@ def train_als(
             blocks_u=tuple(blocks_u), blocks_i=tuple(blocks_i), n_u=n_u_pad,
             compute_dtype=compute_dtype,
         )
+        # analytic FLOPs of the whole build (dominant einsum terms only —
+        # ops/flops.py): benchmarks divide by train_s and the chip peak
+        # for an honest MFU figure, and the runtime perf accounting
+        # (common/perfstats.py) records the same number per scan call
+        from oryx_tpu.ops.flops import als_halfstep_flops
+
+        flops_half_u = sum(
+            als_halfstep_flops(b[1].shape[0], b[1].shape[1], features, 0)
+            for b in u_buckets
+        ) + 2.0 * n_i_pad * features * features
+        flops_half_i = sum(
+            als_halfstep_flops(b[1].shape[0], b[1].shape[1], features, 0)
+            for b in i_buckets
+        ) + 2.0 * n_u_pad * features * features
+        train_flops = iterations * (flops_half_u + flops_half_i)
         if timings is None:
             # donation is a no-op (with a warning) on CPU; only take the
             # donated program where buffer reuse actually exists
@@ -781,31 +834,24 @@ def train_als(
                 if donate_y0 and jax.default_backend() != "cpu"
                 else als_train_bucketed_jit
             )
-            x, y = fn(*args, **kwargs)
+            t_exec = _time.perf_counter()
+            x, y = jax.block_until_ready(fn(*args, **kwargs))
+            train_s = _time.perf_counter() - t_exec
         else:
             # AOT lower/compile so the one-time XLA compile is measured
             # apart from the compute it amortizes into
             timings["lists_s"] = _time.perf_counter() - t_mark
-            # analytic FLOPs of the whole build (dominant einsum terms
-            # only — ops/flops.py): benchmarks divide by train_s and the
-            # chip peak for an honest MFU figure
-            from oryx_tpu.ops.flops import als_halfstep_flops
-
-            flops_half_u = sum(
-                als_halfstep_flops(b[1].shape[0], b[1].shape[1], features, 0)
-                for b in u_buckets
-            ) + 2.0 * n_i_pad * features * features
-            flops_half_i = sum(
-                als_halfstep_flops(b[1].shape[0], b[1].shape[1], features, 0)
-                for b in i_buckets
-            ) + 2.0 * n_u_pad * features * features
-            timings["train_flops"] = iterations * (flops_half_u + flops_half_i)
+            timings["train_flops"] = train_flops
             t_mark = _time.perf_counter()
             compiled = als_train_bucketed_jit.lower(*args, **kwargs).compile()
             timings["compile_s"] = _time.perf_counter() - t_mark
             t_mark = _time.perf_counter()
             x, y = jax.block_until_ready(compiled(*args))
-            timings["train_s"] = _time.perf_counter() - t_mark
+            train_s = timings["train_s"] = _time.perf_counter() - t_mark
+        _record_train_dispatch(
+            args, train_flops, train_s, n_u, n_i, n_u_pad, n_i_pad,
+            features, compute_dtype,
+        )
         return _finish_model(x, y, n_u, n_i, data)
 
     # mesh path: one global width, rows padded to a common multiple of the

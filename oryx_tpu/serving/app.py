@@ -233,6 +233,12 @@ class ServingApp:
         # tracing follows THIS app's config (last constructed wins — one
         # config per process); /healthz reports uptime + frontend fan-out
         configure_tracing(config)
+        # runtime perf accounting (live MFU/occupancy gauges, /debug/
+        # profile window knobs) adopts the same config and pre-registers
+        # its metric families
+        from oryx_tpu.common.perfstats import configure_perfstats
+
+        configure_perfstats(config)
         self.started_at = time.monotonic()
         self.loop_count = 1  # the async frontend overwrites with its fan-out
         reg = get_registry()
@@ -455,7 +461,13 @@ class ServingApp:
         # bucket unknown methods: the label is client-controlled and must
         # not grow the process-global registry without bound
         method = req.method if req.method in _KNOWN_METHODS else "OTHER"
-        self._m_latency.observe(time.monotonic() - start, method=method)
+        # traced requests leave their trace id as the bucket's exemplar:
+        # a latency bucket on /metrics then names a concrete request
+        # joinable against /debug/traces (OpenMetrics exemplar syntax)
+        trace_id = req.trace.trace_id if req.trace is not None else None
+        self._m_latency.observe(
+            time.monotonic() - start, trace_id=trace_id, method=method
+        )
         self._m_requests.inc(method=method, status=str(status))
 
     def _dispatch(self, req: Request):

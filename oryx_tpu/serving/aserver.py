@@ -42,7 +42,11 @@ import weakref
 from concurrent.futures import ThreadPoolExecutor
 from urllib.parse import parse_qs, urlsplit
 
-from oryx_tpu.common.tracing import get_tracer, parse_traceparent
+from oryx_tpu.common.tracing import (
+    format_traceparent,
+    get_tracer,
+    parse_traceparent,
+)
 from oryx_tpu.serving.app import Deferred, Request, ServingApp
 from oryx_tpu.serving.auth import Authenticator
 
@@ -590,7 +594,15 @@ class AsyncHTTPServer:
             # headers accumulated during dispatch (Retry-After on sheds,
             # Warning on stale-model responses) — read AFTER any Deferred
             # completed, so chained handlers' headers are included too
-            return status, payload, ctype, tuple(req.response_headers)
+            hdrs = list(req.response_headers)
+            if span is not None:
+                # traced responses name their trace: the id to look up in
+                # /debug/traces and to match against /metrics exemplars
+                hdrs.append((
+                    "traceparent",
+                    format_traceparent(span.trace_id, span.span_id),
+                ))
+            return status, payload, ctype, tuple(hdrs)
         finally:
             if own_span:
                 tr.finish(span)

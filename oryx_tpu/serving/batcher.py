@@ -45,6 +45,7 @@ import time
 from concurrent.futures import Future
 
 from oryx_tpu.common import faults
+from oryx_tpu.common.perfstats import get_perfstats
 from oryx_tpu.common.tracing import current_span, get_tracer
 from oryx_tpu.serving.futureutil import try_set_exception, try_set_result
 
@@ -55,6 +56,22 @@ log = logging.getLogger(__name__)
 # process-singleton tracer, bound once: the disabled-tracing submit cost
 # is a single attribute read (common/tracing.py)
 _TRACER = get_tracer()
+
+# process-singleton dispatch-cost accounting (common/perfstats.py): every
+# resolved device group records FLOPs/bytes/wall/occupancy, every host
+# fallback zeroes the live MFU window
+_PERF = get_perfstats()
+
+
+def _dispatch_bytes(padded: int, features: int, y, kb: int) -> float:
+    """Approximate bytes one coalesced dispatch moves: the query upload,
+    the item-matrix stream out of HBM (the dominant term — the top-k scan
+    is bandwidth-bound in Y), and the result fetch."""
+    try:
+        y_bytes = float(getattr(y, "nbytes", 0) or 0)
+    except Exception:  # non-jax stub matrices in tests
+        y_bytes = 0.0
+    return float(padded * features * 4 + y_bytes + padded * kb * 8)
 
 from oryx_tpu.ops.als import PALLAS_TOPK_MAX_K
 
@@ -378,6 +395,9 @@ class TopKBatcher:
                 self._peak_flops = None
         except Exception:  # non-jax stub matrices in tests
             self._peak_flops = None
+        # hand the resolved chip peak to the live-MFU accounting (it must
+        # never resolve jax.devices() itself on a scrape path)
+        _PERF.note_peak("serving", self._device_peak())
 
     # -- public API --------------------------------------------------------
 
@@ -468,6 +488,7 @@ class TopKBatcher:
             if p.resolve_on_host():
                 with self._lock:
                     self.host_fallbacks += 1
+                _PERF.note_fallback(1)
         return fut
 
     def close(self) -> None:
@@ -503,7 +524,7 @@ class TopKBatcher:
         # overlap is not an optimization, it is the difference between a
         # usable and an unusable serving tier on remote-attached devices.
         me = threading.current_thread()
-        inflight: list[tuple[list[_Pending], int, object, object, tuple]] = []
+        inflight: list[tuple[list[_Pending], int, object, object, tuple, tuple]] = []
         while True:
             with self._cond:
                 while not self._queue and not self._closed and not inflight:
@@ -547,7 +568,7 @@ class TopKBatcher:
 
     def _launch(
         self, batch: list[_Pending]
-    ) -> list[tuple[list[_Pending], int, object, object]]:
+    ) -> list[tuple[list[_Pending], int, object, object, tuple, tuple]]:
         """Issue one device dispatch per (matrix, k-bucket) group and start
         the async result copies; returns the in-flight group handles."""
         import jax.numpy as jnp
@@ -581,6 +602,7 @@ class TopKBatcher:
             shape_key = None
             try:
                 faults.fire("serving.device")
+                t0 = time.monotonic()
                 y = group[0].y
                 self._last_y = y  # recovery probes re-test against this
                 b = len(group)
@@ -588,7 +610,8 @@ class TopKBatcher:
                 # valid_rows — they're HBM-cheap but not useful FLOPs, so
                 # the MFU figure counts only the real-data prefix
                 n_rows = group[0].valid_rows or y.shape[0]
-                self.flops_scored += 2.0 * b * n_rows * y.shape[1]
+                group_flops = 2.0 * b * n_rows * y.shape[1]
+                self.flops_scored += group_flops
                 self._note_device(y)
                 padded = _pad_rows(b, self._on_accel)
                 # keyed on the FULL (capacity) shape: the serving view
@@ -630,7 +653,17 @@ class TopKBatcher:
                     idx.copy_to_host_async()
                 except AttributeError:  # non-jax array (tests with stubs)
                     pass
-                launched.append((group, kb, vals, idx, shape_key))
+                # per-dispatch cost accounting, finalized at resolve time
+                # (wall-clock runs dispatch → host fetch materialized):
+                # occupancy = real rows / the capacity-padded view shape
+                tp = group[0].trace_parent
+                cost = (
+                    t0, group_flops,
+                    _dispatch_bytes(padded, y.shape[1], y, kb),
+                    b, padded, int(n_rows), int(y.shape[0]),
+                    tp.trace_id if tp is not None else None,
+                )
+                launched.append((group, kb, vals, idx, shape_key, cost))
             except Exception as e:
                 log.exception("batcher group dispatch failed (k=%d)", kb)
                 # no compile is in flight anymore: drop the grace entry,
@@ -661,14 +694,29 @@ class TopKBatcher:
         if n:
             with self._lock:
                 self.host_fallbacks += n
+            # visible degraded-mode accounting: count the host dispatches
+            # and zero the live MFU window — host throughput during the
+            # outage must not read as healthy device utilization
+            _PERF.note_fallback(n)
 
     def _resolve(
-        self, item: tuple[list[_Pending], int, object, object, tuple]
+        self, item: tuple[list[_Pending], int, object, object, tuple, tuple]
     ) -> None:
-        group, kb, vals_dev, idx_dev, shape_key = item
+        group, kb, vals_dev, idx_dev, shape_key, cost = item
         try:
             vals = np.asarray(vals_dev)
             idx = np.asarray(idx_dev)
+            # results are on the host: the dispatch's device work + fetch
+            # is complete — record its cost (FLOPs/bytes/wall/occupancy)
+            # into the live perf accounting
+            t0, flops, bytes_moved, b, padded, valid, cap, trace_id = cost
+            _PERF.record_dispatch(
+                "serving",
+                flops=flops, bytes_moved=bytes_moved,
+                wall_s=time.monotonic() - t0, rows=b, padded_rows=padded,
+                valid_rows=valid, capacity_rows=cap, trace_id=trace_id,
+                t_start=t0,
+            )
             # the dispatch completed, so this shape's compile is done:
             # drop its grace window and never grant it one again. Pop
             # under the lock — the watchdog iterates _compiling.values()
@@ -747,6 +795,7 @@ class TopKBatcher:
                         n += 1
                 with self._lock:
                     self.host_fallbacks += n
+                _PERF.note_fallback(n)
 
             n_threads = min(8, max(1, len(stuck) // 32 + 1))
             if n_threads == 1:

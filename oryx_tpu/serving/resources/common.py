@@ -151,6 +151,44 @@ def register(app: ServingApp) -> None:
             )
         return RawResponse(200, body.encode("utf-8"), "application/json")
 
+    # NOT nonblocking: the handler sleeps for the capture window — that
+    # must park a worker thread, never an event loop
+    @app.route("GET", "/debug/profile")
+    def debug_profile(a: ServingApp, req: Request):
+        """On-demand performance capture: blocks for ?seconds=N (clamped
+        to oryx.monitoring.profile.max-seconds) recording every device
+        dispatch's cost (common/perfstats.py) — plus finished tracing
+        spans, and a jax.profiler device trace into
+        oryx.monitoring.profile.dir when configured — and returns the
+        window as a downloadable Perfetto-loadable Chrome trace-event
+        artifact with an `oryx` summary block (per-kind FLOPs, bytes,
+        occupancy, window MFU). 403 until
+        oryx.monitoring.profile.enabled = true; 409 while another capture
+        holds the (process-global) jax profiler."""
+        from oryx_tpu.common.perfstats import get_perfstats
+
+        ps = get_perfstats()
+        if not ps.profile_enabled:
+            raise OryxServingException(
+                403, "profiling disabled (set oryx.monitoring.profile.enabled)"
+            )
+        try:
+            seconds = float(req.q1("seconds", "1") or 1.0)
+        except ValueError:
+            raise OryxServingException(400, "bad seconds")
+        seconds = max(0.0, min(seconds, ps.profile_max_seconds))
+        try:
+            artifact = ps.capture_profile(seconds)
+        except RuntimeError as e:
+            raise OryxServingException(409, str(e))
+        req.response_headers.append((
+            "Content-Disposition",
+            f'attachment; filename="oryx-profile-{int(time.time())}.json"',
+        ))
+        return RawResponse(
+            200, json.dumps(artifact).encode("utf-8"), "application/json"
+        )
+
     if app.config.get_bool("oryx.monitoring.metrics", True):
 
         from oryx_tpu.serving.batcher import TopKBatcher
@@ -161,8 +199,21 @@ def register(app: ServingApp) -> None:
 
         @app.route("GET", "/metrics")
         def metrics(a: ServingApp, req: Request):
-            text = get_registry().render_prometheus()
-            return RawResponse(200, text.encode("utf-8"), "text/plain; version=0.0.4")
+            """Prometheus text exposition; a scraper that negotiates
+            `Accept: application/openmetrics-text` gets the OpenMetrics
+            dialect instead, which is the ONLY format exemplars
+            (metric→trace joins, docs/observability.md) may legally ride
+            — emitting them into classic text would fail legacy
+            parsers on the whole scrape."""
+            wants_om = "application/openmetrics-text" in req.headers.get(
+                "accept", ""
+            )
+            text = get_registry().render_prometheus(openmetrics=wants_om)
+            ctype = (
+                "application/openmetrics-text; version=1.0.0; charset=utf-8"
+                if wants_om else "text/plain; version=0.0.4"
+            )
+            return RawResponse(200, text.encode("utf-8"), ctype)
 
     @app.route("GET", "/console")
     def console(a: ServingApp, req: Request):

@@ -22,7 +22,11 @@ from oryx_tpu.bus.api import ConsumeDataIterator, TopicProducer
 from oryx_tpu.bus.broker import get_broker
 from oryx_tpu.common.classutil import load_instance_of
 from oryx_tpu.common.config import Config
-from oryx_tpu.common.tracing import get_tracer, parse_traceparent
+from oryx_tpu.common.tracing import (
+    format_traceparent,
+    get_tracer,
+    parse_traceparent,
+)
 from oryx_tpu.serving.app import Request, ServingApp
 from oryx_tpu.serving.auth import Authenticator, make_authenticator
 
@@ -346,6 +350,13 @@ def _make_handler(app: ServingApp, auth: Authenticator | None):
                 tr.log_if_slow(span, log)
             self.send_response(status)
             self.send_header("Content-Type", ctype)
+            if span is not None:
+                # traced responses name their trace: the id to look up in
+                # /debug/traces and to match against /metrics exemplars
+                self.send_header(
+                    "traceparent",
+                    format_traceparent(span.trace_id, span.span_id),
+                )
             # headers accumulated during dispatch (Retry-After on sheds,
             # Warning on stale-model responses)
             for k, v in req.response_headers:

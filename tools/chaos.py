@@ -177,11 +177,17 @@ print("UNREACHABLE")
 
 @scenario("device-transfer-error",
           "injected device dispatch error on the serving batcher; the "
-          "request must be served exactly from the host matrix, no 5xx")
+          "request must be served exactly from the host matrix, no 5xx, "
+          "with the fallback COUNTED and the live MFU gauge zeroed for "
+          "the degraded window")
 def device_transfer_error(tmp: str) -> list[str]:
+    import math
+
     import numpy as np
 
     from oryx_tpu.common.faults import get_injector
+    from oryx_tpu.common.perfstats import get_perfstats
+    from oryx_tpu.common.metrics import get_registry
     from oryx_tpu.serving.batcher import TopKBatcher, host_topk
 
     host = np.asarray(
@@ -192,6 +198,11 @@ def device_transfer_error(tmp: str) -> list[str]:
     y = jnp.asarray(host)
     vec = np.asarray([1.0, 2.0], dtype=np.float32)
     b = TopKBatcher()
+    ps = get_perfstats()
+    fallback_counter = get_registry().counter(
+        "oryx_device_fallback_dispatches_total"
+    )
+    fallbacks_before = fallback_counter.value()
     problems = []
     try:
         get_injector().arm("serving.device", kind="error", count=1)
@@ -201,6 +212,20 @@ def device_transfer_error(tmp: str) -> list[str]:
             problems.append(f"degraded result wrong: {list(idx)} != {list(eidx)}")
         if b.host_fallbacks != 1:
             problems.append(f"host_fallbacks={b.host_fallbacks}, want 1")
+        # degraded-mode visibility: the fallback must increment the
+        # counter and zero the live MFU gauge for the fallback window —
+        # host-scored throughput must not read as device utilization
+        got = fallback_counter.value() - fallbacks_before
+        if got != 1:
+            problems.append(
+                f"oryx_device_fallback_dispatches_total moved by {got}, want 1"
+            )
+        mfu_now = ps.mfu("serving")
+        if math.isnan(mfu_now) or mfu_now != 0.0:
+            problems.append(
+                f"oryx_device_mfu reads {mfu_now} during the fallback "
+                "window, want 0.0"
+            )
         vals2, idx2 = b.submit(vec, 2, y, host_mat=host)
         if list(idx2) != list(eidx):
             problems.append("device path did not resume after the error")

@@ -1,7 +1,8 @@
 #!/usr/bin/env python
 """Static metric-name consistency check (wired as a tier-1 test).
 
-Two invariants, so metric docs cannot drift from the code:
+Three invariants, so metric docs and the bench ratchet cannot drift from
+the code:
 
 1. Every metric name used under ``oryx_tpu/`` (any string literal that is
    exactly an ``oryx_``-prefixed identifier) matches the naming contract
@@ -9,6 +10,11 @@ Two invariants, so metric docs cannot drift from the code:
 2. Every such name appears in the reference table of
    ``docs/observability.md`` (a row whose first column is the backticked
    name) — and every name in the table exists in code.
+3. Every metric name ratcheted in ``BASELINE_RATCHET.json``
+   (tools/check_bench.py) still exists in ``bench.py``'s output
+   vocabulary — a renamed bench field would otherwise make the ratchet
+   fail every future run as "missing" (or, worse, silently skip on a
+   platform filter) long after the measurement it locks moved on.
 
 Histogram series suffixes (``_bucket``/``_sum``/``_count``) are derived by
 the exposition layer and are documented under the base name only.
@@ -25,6 +31,8 @@ from pathlib import Path
 ROOT = Path(__file__).resolve().parent.parent
 PACKAGE = ROOT / "oryx_tpu"
 DOC = ROOT / "docs" / "observability.md"
+BENCH = ROOT / "bench.py"
+RATCHET = ROOT / "BASELINE_RATCHET.json"
 
 VALID_NAME = re.compile(r"^oryx_[a-z0-9_]+$")
 # A whole string literal that is an oryx_-prefixed identifier. Literals
@@ -54,6 +62,32 @@ def doc_metric_names() -> set[str]:
     return set(DOC_ROW.findall(DOC.read_text(encoding="utf-8")))
 
 
+def ratchet_problems() -> list[str]:
+    """Every ratcheted metric name must appear as a quoted key literal in
+    bench.py — the static stand-in for 'bench.py output emits it'."""
+    if not RATCHET.exists():
+        return [f"missing {RATCHET.relative_to(ROOT)}"]
+    import json
+
+    try:
+        metrics = json.loads(RATCHET.read_text(encoding="utf-8"))["metrics"]
+    except (json.JSONDecodeError, KeyError, TypeError) as e:
+        return [f"{RATCHET.name}: unparseable ({e})"]
+    bench_text = BENCH.read_text(encoding="utf-8")
+    problems = []
+    for m in metrics:
+        name = m.get("name")
+        if not name:
+            problems.append(f"{RATCHET.name}: metric entry without a name: {m}")
+        elif not re.search(rf'"{re.escape(name)}"', bench_text):
+            problems.append(
+                f"{name}: ratcheted in {RATCHET.name} but bench.py never "
+                "emits a field of that name — the ratchet would fail every "
+                "run as 'missing'"
+            )
+    return problems
+
+
 def main() -> int:
     problems: list[str] = []
     if not DOC.exists():
@@ -77,6 +111,7 @@ def main() -> int:
             f"{name}: documented in docs/observability.md but not found "
             "anywhere under oryx_tpu/"
         )
+    problems.extend(ratchet_problems())
     for p in problems:
         print(p, file=sys.stderr)
     if not problems:
