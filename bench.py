@@ -317,6 +317,132 @@ print("LATMS " + " ".join(f"{l*1000:.1f}" for l in all_lats), flush=True)
 """
 
 
+_EPOLL_CLIENT_CODE = """
+# Single-threaded selector-based HTTP/1.1 load client: N concurrent
+# keep-alive connections driven by one event loop. The threaded client
+# above costs ~3-4 ms of client CPU per request once ~100 blocked
+# threads churn the scheduler; on a bench host where the load generator
+# shares cores with the processes under test, that overhead comes
+# straight out of measured server capacity. One epoll loop holding every
+# socket sustains the same in-flight depth for a fraction of the cost.
+import random, selectors, socket, sys, time
+
+port, n_conns, t_measure, t_end, n_users, seed, how_many = (
+    int(sys.argv[1]), int(sys.argv[2]), float(sys.argv[3]), float(sys.argv[4]),
+    int(sys.argv[5]), int(sys.argv[6]), int(sys.argv[7]),
+)
+count = errors = 0
+lats = []
+rng = random.Random(seed)
+reqs = [
+    (
+        f"GET /recommend/u{rng.randrange(n_users)}?howMany={how_many} "
+        f"HTTP/1.1\\r\\nHost: b\\r\\n\\r\\n"
+    ).encode()
+    for _ in range(4096)
+]
+sel = selectors.DefaultSelector()
+
+class Conn:
+    __slots__ = ("s", "buf", "head_end", "need", "ok", "t0", "j", "out")
+
+    def __init__(self, j):
+        self.j = j
+        self.s = None
+        self.open()
+
+    def open(self):
+        self.close()
+        self.s = socket.create_connection(("127.0.0.1", port), timeout=60)
+        self.s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.s.setblocking(False)
+        self.buf = bytearray()
+        self.head_end = -1
+        self.need = 0
+        sel.register(self.s, selectors.EVENT_READ, self)
+        self.send_next()
+
+    def close(self):
+        if self.s is not None:
+            try:
+                sel.unregister(self.s)
+            except Exception:
+                pass
+            try:
+                self.s.close()
+            except Exception:
+                pass
+            self.s = None
+
+    def send_next(self):
+        req = reqs[self.j % len(reqs)]
+        self.j += n_conns
+        self.t0 = time.time()
+        self.out = req[self.s.send(req):]  # tiny; rarely partial
+        if self.out:
+            sel.modify(self.s, selectors.EVENT_READ | selectors.EVENT_WRITE, self)
+
+    def on_ready(self, mask):
+        if mask & selectors.EVENT_WRITE and self.out:
+            self.out = self.out[self.s.send(self.out):]
+            if not self.out:
+                sel.modify(self.s, selectors.EVENT_READ, self)
+        if not (mask & selectors.EVENT_READ):
+            return
+        data = self.s.recv(1 << 16)
+        if not data:
+            raise ConnectionError("closed")
+        self.buf += data
+        while True:
+            if self.head_end < 0:
+                self.head_end = self.buf.find(b"\\r\\n\\r\\n")
+                if self.head_end < 0:
+                    return
+                head = bytes(self.buf[: self.head_end + 4])
+                self.ok = head.startswith(b"HTTP/1.1 200")
+                low = head.lower()
+                i = low.find(b"content-length:")
+                clen = int(low[i + 15 : low.find(b"\\r", i)]) if i >= 0 else 0
+                self.need = self.head_end + 4 + clen
+            if len(self.buf) < self.need:
+                return
+            done = time.time()
+            global count, errors
+            if t_measure <= done < t_end:
+                if self.ok:
+                    count += 1
+                    lats.append(done - self.t0)
+                else:
+                    errors += 1
+            del self.buf[: self.need]
+            self.head_end = -1
+            self.send_next()
+
+conns = [Conn(i) for i in range(n_conns)]
+while time.time() < t_end:
+    for key, mask in sel.select(timeout=0.2):
+        c = key.data
+        try:
+            c.on_ready(mask)
+        except Exception:
+            now = time.time()
+            if t_measure <= now < t_end:
+                errors += 1
+            # reconnect with bounded retry; a refused connect must not
+            # kill the generator silently
+            deadline = min(t_end, now + 5.0)
+            while time.time() < deadline:
+                try:
+                    c.open()
+                    break
+                except Exception:
+                    time.sleep(0.05)
+print(f"COUNTS {count} {errors}", flush=True)
+lats.sort()
+print("LATMS " + " ".join(f"{l*1000:.1f}" for l in lats), flush=True)
+"""
+
+
 def _bench_http_body(sample_rate: float = 1.0) -> None:
     """End-to-end /recommend throughput through the REAL serving stack:
     HTTP parse -> route dispatch -> readiness gate -> micro-batched device
@@ -1189,6 +1315,393 @@ def _bench_update_storm_body() -> None:
     }))
 
 
+def _bench_fleet_body() -> None:
+    """Fleet scaling: /recommend qps through the L7 fleet front backed by
+    ONE vs TWO serving replica PROCESSES (fleet/supervisor.py +
+    fleet/front.py) — the scale-out answer to "N event loops are not N
+    hosts" (ROADMAP item 5). Both measurements go through the front, so
+    the ratio isolates what adding a replica process buys once the model
+    is bus-distributed and the router is in the path.
+
+    Always CPU: replica processes cannot share one accelerator chip, and
+    this stage measures the PROCESS-topology story (per-process GIL and
+    model replicas), not kernel throughput. The model is bus-distributed
+    as a chunked MODEL-REF so the stage also measures the shared
+    artifact-relay amortization: the 2-replica host should decode ~1x the
+    artifact (oryx_fleet_distribution_bytes{mode=shared}), not 2x.
+
+    The raw ratio is reported against a MEASURED host ceiling: a pinned
+    busy-loop pair probe (cpu_capacity_2proc) captures how much parallel
+    CPU the host actually delivers to two processes vs one, and
+    fleet_scaling_efficiency = fleet_scaling_2rep / cpu_capacity_2proc.
+    On an overcommitted host (this sandbox delivers ~1.4 of 2 advertised
+    cores) raw scaling is physically capped below 2.0 by steal, and the
+    efficiency number is the honest, host-portable fleet claim.
+    """
+    import re
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from oryx_tpu.bus.api import TopicProducer
+    from oryx_tpu.bus.broker import get_broker, topics
+    from oryx_tpu.common.artifact import ModelArtifact, publish_model_ref
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.common.executil import (
+        config_overlay_from_sets,
+        cpu_subprocess_env,
+        free_port_run,
+    )
+    from oryx_tpu.common.freshness import publish_stamp
+    from oryx_tpu.fleet import FleetFront, FleetSupervisor
+
+    # The catalog is the server-cost dial: every request scores ALL items
+    # for its user (items x features MACs), and batching amortizes only
+    # dispatch overhead, never that per-request compute — so a big
+    # catalog pins request cost in GIL-released BLAS on the replica's
+    # core, where adding a replica process adds real capacity. A tiny
+    # howMany keeps the bytes-proportional costs (front relay, generator
+    # parse, Python render) marginal; a 500-row render would make the
+    # shared-core router+generator tax comparable to replica cost and cap
+    # 2-core scaling at ~2/(1+1) = 1x (measured 0.92x before this shape).
+    # 1.2M items (not 400k): a catalog sweep on this host measured
+    # direct-drive 2-replica scaling 0.99x at 400k vs 1.30x at 1.2M —
+    # the bigger per-request BLAS slab shrinks every fixed per-request
+    # cost (client, front relay, sandboxed network syscalls) that is
+    # serviced out of the SAME host CPU budget as the replicas.
+    n_items, n_users, features = 1_200_000, 20_000, 50
+    # Offered load scales WITH the measured topology: a closed-loop
+    # capacity test must offer each phase the same in-flight depth PER
+    # REPLICA (here 24), or the fleet phase starves — holding total
+    # connections fixed across phases halves per-replica depth in phase
+    # 2, dispatch pipelines drain between batches, and the measured
+    # "scaling" collapses to the client pool's shape (0.54x measured)
+    # instead of the replicas' capacity (1.30x at equal depth). Depth 24
+    # covers the batcher's depth-1 dispatch pipeline with margin while
+    # keeping measured latency service-dominated, and single-threaded
+    # selector clients keep generator CPU marginal at any depth.
+    n_procs, conns_per_replica, how_many = 2, 24, 10
+
+    work = tempfile.mkdtemp(prefix="oryx-bench-fleet-")
+    bus = f"file://{work}/bus"
+    topics.maybe_create(bus, "OryxInput", 1)
+    topics.maybe_create(bus, "OryxUpdate", 1)
+    broker = get_broker(bus)
+
+    rng = np.random.default_rng(42)
+    art = ModelArtifact(
+        "als",
+        extensions={
+            "features": str(features), "lambda": "0.001", "alpha": "1.0",
+            "implicit": "true", "logStrength": "false",
+        },
+        tensors={
+            "X": rng.standard_normal((n_users, features), dtype=np.float32),
+            "Y": rng.standard_normal((n_items, features), dtype=np.float32),
+        },
+    )
+    art.set_extension("XIDs", [f"u{j}" for j in range(n_users)])
+    art.set_extension("YIDs", [f"i{j}" for j in range(n_items)])
+    serialized = art.to_string()
+    model_dir = os.path.join(work, "models", "gen-1")
+    art.write(model_dir)
+    # chunked bus distribution (1 MB chunks): replicas on this host
+    # assemble it ONCE through the shared relay cache
+    publish_model_ref(
+        TopicProducer(broker, "OryxUpdate"), serialized, model_dir, 1 << 20
+    )
+    broker.send("OryxUpdate", "TRACE", publish_stamp(generation=1))
+
+    base_port = free_port_run(2)
+    sets = [
+        "oryx.id=bench-fleet",
+        f"oryx.input-topic.broker={bus}",
+        f"oryx.update-topic.broker={bus}",
+        "oryx.serving.model-manager-class="
+        "oryx_tpu.apps.als.serving.ALSServingModelManager",
+        'oryx.serving.application-resources='
+        '["oryx_tpu.serving.resources.common",'
+        '"oryx_tpu.serving.resources.als"]',
+        "oryx.serving.api.read-only=true",
+        # each replica runs ONE event loop: the stage isolates process-
+        # level scaling, and replicas sharing 2 cores with the front and
+        # the load generators must not each spawn a per-core loop set
+        "oryx.serving.api.loops=1",
+        "oryx.fleet.replicas=2",
+        f"oryx.fleet.base-port={base_port}",
+        f"oryx.fleet.data-dir={work}/fleet",
+        # a replica dying mid-measurement must fail the stage loudly, not
+        # be silently respawned into a half-warm window
+        "oryx.fleet.supervisor.restart=false",
+        # replicas share the repo's persistent CPU compile cache: r1's
+        # first dispatches load r0's (and earlier runs') compiled buckets
+        f"oryx.compute.compilation-cache-dir={HERE}/.jax_cache/cpu",
+    ]
+
+    cfg = load_config(overlay=config_overlay_from_sets(sets))
+    argv = [x for s in sets for x in ("--set", s)]
+
+    import http.client
+
+    def _wait_ready(port: int, deadline_s: float) -> None:
+        deadline = time.time() + deadline_s
+        last = "no attempt"
+        while time.time() < deadline:
+            try:
+                c = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+                c.request("GET", "/ready")
+                r = c.getresponse()
+                r.read()
+                c.close()
+                if r.status == 200:
+                    return
+                last = f"HTTP {r.status}"
+            except Exception as e:  # noqa: BLE001 - retried
+                last = f"{type(e).__name__}: {e}"
+            time.sleep(0.5)
+        raise RuntimeError(f"replica :{port} never ready ({last})")
+
+    def _warm_front(port: int, deadline_s: float) -> None:
+        deadline = time.time() + deadline_s
+        while True:
+            try:
+                c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+                c.request("GET", "/recommend/u0?howMany=10")
+                r = c.getresponse()
+                r.read()
+                c.close()
+                if r.status == 200:
+                    return
+            except Exception:  # noqa: BLE001 - retried until deadline
+                pass
+            if time.time() > deadline:
+                raise RuntimeError("front warm request never returned 200")
+            time.sleep(0.5)
+
+    def _drive_front(
+        port: int, warm_s: float, window_s: float, n_replicas: int = 1
+    ):
+        """External load generators (single-threaded selector clients, so
+        generator CPU stays marginal) against the front; offered in-flight
+        depth is conns_per_replica x n_replicas, split across n_procs
+        client processes. Returns (total, errors, sorted latencies ms)."""
+        conns_per = max(1, conns_per_replica * n_replicas // n_procs)
+        t_measure = time.time() + warm_s
+        t_end = t_measure + window_s
+        procs = [
+            subprocess.Popen(
+                [
+                    sys.executable, "-c", _EPOLL_CLIENT_CODE, str(port),
+                    str(conns_per), repr(t_measure), repr(t_end),
+                    str(n_users), str(pi), str(how_many),
+                ],
+                env={
+                    k: v
+                    for k, v in os.environ.items()
+                    if k not in ("PYTHONPATH", "JAX_PLATFORMS")
+                },
+                stdout=subprocess.PIPE,
+                text=True,
+            )
+            for pi in range(n_procs)
+        ]
+        total = n_errors = 0
+        lat_ms: list[float] = []
+        for pi, p in enumerate(procs):
+            out, _ = p.communicate(timeout=warm_s + window_s + 240)
+            counted = False
+            for line in out.splitlines():
+                if line.startswith("COUNTS "):
+                    _, c, e = line.split()
+                    total += int(c)
+                    n_errors += int(e)
+                    counted = True
+                elif line.startswith("LATMS "):
+                    lat_ms.extend(float(v) for v in line.split()[1:])
+            assert p.returncode == 0 and counted, (
+                f"fleet client proc {pi} rc={p.returncode} counted={counted}"
+            )
+        lat_ms.sort()
+        return total, n_errors, lat_ms
+
+    def _scrape_counter(port: int, name: str, label: str) -> dict[str, float]:
+        """label-value -> sample for one counter family off a replica's
+        /metrics."""
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        c.request("GET", "/metrics")
+        text = c.getresponse().read().decode("utf-8", "replace")
+        c.close()
+        out: dict[str, float] = {}
+        for line in text.splitlines():
+            m = re.match(rf'{name}\{{{label}="([^"]+)"\}} (\S+)', line)
+            if m:
+                out[m.group(1)] = float(m.group(2))
+        return out
+
+    # pin each replica to its own core where the platform allows: the
+    # fleet models one-replica-PER-HOST, and XLA's multi-threaded CPU
+    # runtime would otherwise let the single-replica baseline consume
+    # every core — inflating the denominator and hiding exactly the
+    # process-level scaling this stage exists to measure. taskset at exec
+    # time pins every thread the replica will spawn (a post-hoc
+    # sched_setaffinity(pid) pins only the main thread on Linux).
+    import shutil as _shutil
+
+    ncpu = os.cpu_count() or 1
+    pinned = _shutil.which("taskset") is not None and ncpu >= 2
+    prefixes = (
+        [["taskset", "-c", str(i % ncpu)] for i in range(2)] if pinned else None
+    )
+
+    _BUSY_CODE = (
+        "import resource, sys, time\n"
+        "t = time.monotonic() + float(sys.argv[1])\n"
+        "while time.monotonic() < t:\n"
+        "    pass\n"
+        "ru = resource.getrusage(resource.RUSAGE_SELF)\n"
+        "print(ru.ru_utime + ru.ru_stime)\n"
+    )
+
+    def _measure_busy(n: int, seconds: float) -> float:
+        """Total CPU-seconds/sec n pinned busy-loop processes actually
+        receive — syscall-free pure compute, so the shortfall from n is
+        hypervisor steal/overcommit, not sandbox syscall tax."""
+        cmds = [
+            ((prefixes[i % 2] if pinned else [])
+             + [sys.executable, "-c", _BUSY_CODE, str(seconds)])
+            for i in range(n)
+        ]
+        t0 = time.monotonic()
+        procs = [
+            subprocess.Popen(c, stdout=subprocess.PIPE, text=True)
+            for c in cmds
+        ]
+        outs = [p.communicate(timeout=seconds + 30)[0] for p in procs]
+        elapsed = time.monotonic() - t0
+        return sum(float(o.strip().splitlines()[-1]) for o in outs) / elapsed
+
+    def _cpu_capacity_2proc() -> float | None:
+        """The parallel-CPU ceiling the host ACTUALLY delivers to two
+        single-core processes relative to one — measured, not assumed
+        from os.cpu_count(). On an overcommitted/steal-heavy host (this
+        sandbox's 2 advertised vCPUs deliver ~1.4 cores to a pinned
+        busy-loop pair, 0.93 to a single) no process topology can scale
+        past this ratio, so reporting it alongside the raw scaling lets
+        fleet_scaling_efficiency separate 'the fleet layer wasted
+        capacity' from 'the host never had it'. Must run while the
+        replicas are truly idle — BEFORE the load phases, not after them
+        (post-window the batchers are still draining tens of queued
+        requests for many seconds, which starves the single-loop probe
+        and inflated the measured ratio to an impossible 2.51)."""
+        try:
+            single = _measure_busy(1, 3.0)
+            both = _measure_busy(2, 3.0)
+            if single <= 0:
+                return None
+            return round(both / single, 2)
+        except Exception:  # noqa: BLE001 - calibration is best-effort
+            return None
+
+    sup = FleetSupervisor(
+        cfg, argv=argv, env=cpu_subprocess_env(), exec_prefixes=prefixes
+    )
+    front = None
+    try:
+        sup.start()
+        sup.wait_listening(120)
+        for _, _, port in sup.backends():
+            _wait_ready(port, 180)
+
+        # measured host ceiling for 2-process scaling — probed now, while
+        # the replicas are provably idle (ready, no traffic offered yet)
+        capacity = _cpu_capacity_2proc()
+
+        # ---- phase 1: one replica behind the front ----
+        front = FleetFront(cfg, backends=sup.backends()[:1], port=0)
+        front.start()
+        _warm_front(front.port, 180)
+        window = 8.0
+        total1, err1, _ = _drive_front(front.port, 12.0, window)
+        qps_single = total1 / window
+        front.close()
+        front = None
+
+        # ---- phase 2: both replicas ----
+        # warm r1 DIRECTLY first (same compile ramp r0 got in phase 1):
+        # the scaling claim is about steady-state process topology, and a
+        # cold replica compiling inside the measured window would charge
+        # its one-time XLA ramp against the fleet number
+        _drive_front(sup.ports()[1], 10.0, 2.0)
+        front = FleetFront(cfg, backends=sup.backends(), port=0)
+        front.start()
+        _warm_front(front.port, 120)
+        # per-phase delta: the front request counter is process-global
+        # and already carries phase 1 + warm traffic
+        req0 = {
+            r.id: front._m_requests.value(replica=r.id)
+            for r in front.replicas
+        }
+        total2, err2, lat2 = _drive_front(
+            front.port, 5.0, window, n_replicas=2
+        )
+        fleet_qps = total2 / window
+        by_replica = {
+            r.id: int(front._m_requests.value(replica=r.id) - req0[r.id])
+            for r in front.replicas
+        }
+
+        # distribution amortization: fleet-wide decoded bytes vs artifact
+        dist_shared = dist_per = 0.0
+        for _, _, port in sup.backends():
+            got = _scrape_counter(
+                port, "oryx_fleet_distribution_bytes", "mode"
+            )
+            dist_shared += got.get("shared", 0.0)
+            dist_per += got.get("per-replica", 0.0)
+        artifact_bytes = len(serialized.encode("utf-8"))
+
+        pct = lambda lats, p: (
+            round(lats[min(len(lats) - 1, int(p * len(lats)))], 2)
+            if lats else None
+        )
+        scaling = round(fleet_qps / qps_single, 2) if qps_single else None
+        efficiency = (
+            round(scaling / capacity, 2)
+            if scaling is not None and capacity else None
+        )
+        print(json.dumps({
+            "metric": "fleet_scaling",
+            "value": scaling,
+            "unit": "x",
+            "platform": "cpu",
+            "replicas": 2,
+            "items": n_items,
+            "features": features,
+            "replica_affinity": "one-core-per-replica" if pinned else "none",
+            "cpu_capacity_2proc": capacity,
+            "fleet_scaling_efficiency": efficiency,
+            "qps_single": round(qps_single, 1),
+            "fleet_qps_2rep": round(fleet_qps, 1),
+            "fleet_scaling_2rep": scaling,
+            "fleet_errors": err1 + err2,
+            "latency_ms_p50_2rep": pct(lat2, 0.50),
+            "latency_ms_p99_2rep": pct(lat2, 0.99),
+            "front_requests_by_replica": by_replica,
+            "fleet_distribution_shared_bytes": int(dist_shared),
+            "fleet_distribution_per_replica_bytes": int(dist_per),
+            "artifact_bytes": artifact_bytes,
+            "distribution_amortization": (
+                round(dist_shared / artifact_bytes, 2) if artifact_bytes else None
+            ),
+        }))
+    finally:
+        if front is not None:
+            front.close()
+        sup.stop()
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def _bench_speed_body() -> None:
     """Speed-tier throughput: raw input events -> parse -> aggregate ->
     vmapped fold-in solves -> UP messages, through the real
@@ -1704,6 +2217,30 @@ def _merge_update_storm(result: dict, row: dict) -> None:
         result["update_stall_ratio"] = row["stall_ratio"]
 
 
+def _merge_fleet(result: dict, row: dict) -> None:
+    """Fleet block lands nested (its own scenario, not the headline),
+    with the process-scaling ratio promoted to the compact final line."""
+    result["fleet"] = {
+        key: row[key]
+        for key in (
+            "qps_single", "fleet_qps_2rep", "fleet_scaling_2rep",
+            "cpu_capacity_2proc", "fleet_scaling_efficiency",
+            "fleet_errors", "latency_ms_p50_2rep", "latency_ms_p99_2rep",
+            "front_requests_by_replica", "fleet_distribution_shared_bytes",
+            "fleet_distribution_per_replica_bytes", "artifact_bytes",
+            "distribution_amortization", "replicas", "items", "features",
+            "platform",
+        )
+        if key in row
+    }
+    if row.get("fleet_scaling_2rep") is not None:
+        result["fleet_scaling_2rep"] = row["fleet_scaling_2rep"]
+    if row.get("fleet_qps_2rep") is not None:
+        result["fleet_qps_2rep"] = row["fleet_qps_2rep"]
+    if row.get("fleet_scaling_efficiency") is not None:
+        result["fleet_scaling_efficiency"] = row["fleet_scaling_efficiency"]
+
+
 def _merge_lsh(result: dict, row: dict) -> None:
     result["lsh_qps"] = row.get("value")
     result["lsh_vs_baseline"] = row.get("vs_baseline")
@@ -1738,6 +2275,13 @@ _SUITE_STAGES = (
     ("_bench_kmeans_rdf_body", 420, False, _merge_kmeans_rdf, False),
     ("_bench_http_lsh_body", 240, False, _merge_lsh, True),
     ("_bench_update_storm_body", 240, False, _merge_update_storm, False),
+    # fleet scaling is host-CPU process topology by definition (N replica
+    # processes cannot share one accelerator chip) — pinned to CPU even
+    # inside an accelerator suite, like the LSH parity row
+    # 480s: the 1.2M-item catalog costs ~1 min of model build + chunked
+    # bus publish and ~1.5 min of replica assemble/JIT before the
+    # measured windows even start
+    ("_bench_fleet_body", 480, False, _merge_fleet, True),
     ("_bench_scale_body", 900, True, _merge_scaling, False),
 )
 
@@ -1753,6 +2297,7 @@ _ACCEL_STAGE_ORDER = (
     "_bench_update_storm_body", "_bench_train_body",
     "_bench_generations_body", "_bench_speed_body",
     "_bench_kmeans_rdf_body", "_bench_http_lsh_body",
+    "_bench_fleet_body",
 )
 
 
@@ -1886,6 +2431,7 @@ def _attach_spark_baseline(result: dict, deadline: float) -> None:
         result["spark_baseline_seconds"] = spark_s
         result["spark_baseline_interactions"] = spark_nnz
         result["spark_baseline_source"] = "ORYX_SPARK_BASELINE_S"
+        result["speedup_vs_mllib_basis"] = "measured"
         if build_s and nnz == spark_nnz:
             result["speedup_vs_mllib"] = round(spark_s / build_s, 1)
         else:
@@ -1935,6 +2481,7 @@ def _attach_spark_baseline(result: dict, deadline: float) -> None:
     if parsed and parsed.get("value"):
         result["spark_baseline_seconds"] = parsed["value"]
         result["spark_baseline_source"] = "live"
+        result["speedup_vs_mllib_basis"] = "measured"
         if build_s:
             result["speedup_vs_mllib"] = round(parsed["value"] / build_s, 1)
     else:
@@ -1997,7 +2544,9 @@ _SUMMARY_KEYS = (
     "rdf_accuracy", "lsh_qps", "lsh_vs_baseline", "qps_per_core_vs_baseline",
     "update_stall_p99_ms", "update_stall_ratio",
     "gen_incremental_speedup", "warm_start_iters_saved",
-    "speedup_vs_mllib", "partial", "stages_done", "tpu_wait",
+    "fleet_scaling_2rep", "fleet_qps_2rep", "fleet_scaling_efficiency",
+    "speedup_vs_mllib", "speedup_vs_mllib_basis", "partial", "stages_done",
+    "tpu_wait",
 )
 
 
@@ -2111,50 +2660,23 @@ def _attach_baseline_bound(result: dict, build_s, nnz) -> None:
     """No measured Spark denominator is reachable from this host (no
     pyspark, no egress) — record an EXPLICITLY-LABELED bound instead so
     the >=20x north-star target has *some* denominator until a real
-    measurement lands (round-3 verdict #8). Two bounds, both honest about
-    what they are:
+    measurement lands (round-3 verdict #8). The bound itself lives in
+    tools/spark_baseline.py (`analytic_bound`) — ONE source of truth
+    shared with the runner's machine-readable SKIPPED artifact — and the
+    artifact carries speedup_vs_mllib_basis="analytic" so the stand-in
+    can never be mistaken for a measurement."""
+    import importlib.util
 
-    - an analytic compute floor: the normal-equation FLOPs the reference's
-      exact algorithm must perform, at a deliberately over-generous
-      200 GFLOP/s sustained for its 32-core Haswell + netlib BLAS,
-      ignoring every shuffle/JVM/scheduling cost. The true MLlib
-      wall-clock cannot be below this, so speedup >= floor/build.
-    - a literature anchor: publicly reported Spark-MLlib ALS builds at
-      ML-20M/25M scale (rank 10-50, ~10 iterations, multi-node clusters)
-      land in the minutes range; recorded as [300, 1800] s per 25M
-      interactions and scaled linearly in nnz. An anchor, NOT a
-      measurement — labeled as such.
-    """
-    features, iterations = 50, 10  # both train configs use these
-    bound: dict = {
-        "command": "python tools/spark_baseline.py --interactions <nnz> "
-        "# on a pyspark-capable host; feed the result back via "
-        "ORYX_SPARK_BASELINE_S / ORYX_SPARK_BASELINE_INTERACTIONS",
-    }
-    if nnz:
-        floor_flops = (
-            iterations * 2.0 * nnz * (2.0 * features**2 + 2.0 * features)
-        )
-        floor_s = floor_flops / 200e9
-        anchor = [round(300.0 * nnz / 25e6, 1), round(1800.0 * nnz / 25e6, 1)]
-        bound.update(
-            {
-                "analytic_floor_seconds": round(floor_s, 1),
-                "analytic_floor_basis": "pure normal-equation FLOPs at an "
-                "optimistic 200 GFLOP/s sustained f64 on the reference's "
-                "32-core Haswell; ignores all shuffle/JVM/scheduling cost",
-                "literature_anchor_seconds": anchor,
-                "literature_anchor_basis": "publicly reported MLlib ALS "
-                "wall-clocks at ML-20M/25M scale, scaled linearly in "
-                "interactions; an anchor, not a measurement",
-            }
-        )
-        if build_s:
-            bound["speedup_vs_mllib_floor"] = round(floor_s / build_s, 2)
-            bound["speedup_vs_mllib_anchor_range"] = [
-                round(anchor[0] / build_s, 1), round(anchor[1] / build_s, 1),
-            ]
-    result["spark_baseline_bound"] = bound
+    spec = importlib.util.spec_from_file_location(
+        "spark_baseline", os.path.join(HERE, "tools", "spark_baseline.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # features/iterations: both train configs use these
+    result["spark_baseline_bound"] = mod.analytic_bound(
+        nnz, features=50, iterations=10, build_s=build_s
+    )
+    result["speedup_vs_mllib_basis"] = "analytic"
 
 
 def main() -> None:

@@ -206,6 +206,18 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
         pod-wide agreed generation window (layers/batch.py)."""
         return dict(enumerate(self._broker.end_offsets(self._topic)))
 
+    def lag(self) -> int:
+        """Records between this consumer's delivered positions and the
+        topic's current end offsets — its backlog. The serving layer
+        surfaces it on /healthz (``update_lag``) so a fleet front can see
+        one replica falling behind model distribution while its siblings
+        keep up, before the staleness bound ever trips."""
+        ends = self._broker.end_offsets(self._topic)
+        return sum(
+            max(0, end - self._delivered_pos.get(p, 0))
+            for p, end in enumerate(ends)
+        )
+
     def poll_available(
         self, up_to: dict[int, int] | None = None
     ) -> list[KeyMessage]:

@@ -35,8 +35,23 @@ def _parse_args(argv):
         "command",
         choices=[
             "batch", "speed", "serving", "setup", "tail", "input",
-            "import-pmml", "loadtest", "config", "pod",
+            "import-pmml", "loadtest", "config", "pod", "fleet",
         ],
+    )
+    p.add_argument(
+        "--replicas", type=int, default=None,
+        help="fleet: serving replica processes to supervise on this host "
+        "(overrides oryx.fleet.replicas)",
+    )
+    p.add_argument(
+        "--front-port", type=int, default=None,
+        help="fleet: listening port of the L7 fleet front (overrides "
+        "oryx.fleet.front.port)",
+    )
+    p.add_argument(
+        "--policy", choices=["round-robin", "hash"], default=None,
+        help="fleet: front placement policy (overrides "
+        "oryx.fleet.front.policy; hash = consistent-hash-by-user)",
     )
     p.add_argument(
         "--compute", type=int, default=1,
@@ -458,51 +473,124 @@ def _supervise_serving_replicas(config: Config, n_procs: int, argv: list[str]) -
     return rc_out
 
 
-def _pod_child_flags(raw_argv: list[str]) -> list[str]:
-    """Rebuild a child command line from the pod invocation: drop the
-    'pod' SUBCOMMAND token and the pod-only flags with their values.
+# every value-taking option of the shared parser: the child-argv
+# rebuilders below must know which flags bind the next bare token so the
+# SUBCOMMAND token (the first UNBOUND bare token) is identified correctly
+_VALUE_OPTS = {
+    "--compute", "--local-start", "--local-count", "--coordinator",
+    "--conf", "--url", "--paths", "--rate", "--duration", "--workers",
+    "--pmml", "--set", "--loops", "--sync-mode", "--sync-headroom",
+    "--replicas", "--front-port", "--policy",
+}
+
+
+def _child_flags(
+    raw_argv: list[str],
+    drop_value_opts: set[str],
+    drop_bare_flags: frozenset[str] = frozenset(),
+) -> list[str]:
+    """Rebuild a child command line from a supervisor invocation: drop the
+    SUBCOMMAND token and the supervisor-only flags with their values.
     The subcommand is the first bare token NOT bound as the value of a
     value-taking option — argparse accepts options before the positional,
     so `--conf pod pod --compute 2` must keep --conf's value 'pod' and
     drop the second bare token (round-4 advice: matching the first bare
     'pod' dropped the flag value and left the real subcommand in the
     child argv)."""
-    value_opts = {
-        "--compute", "--local-start", "--local-count", "--coordinator",
-        "--conf", "--url", "--paths", "--rate", "--duration", "--workers",
-        "--pmml", "--set", "--loops", "--sync-mode", "--sync-headroom",
-    }
-    pod_only = {
-        "--compute", "--local-start", "--local-count", "--coordinator",
-    }
     out: list[str] = []
     seen_subcommand = False
     i = 0
     while i < len(raw_argv):
         tok = raw_argv[i]
         name = tok.split("=", 1)[0]
-        if name in pod_only:
+        if name in drop_value_opts:
             # separate-token form consumes its value too; '=' form is one
             i += 2 if tok == name else 1
             continue
-        if tok in ("--speed", "--serving"):
+        if tok in drop_bare_flags:
             i += 1
             continue
         if tok.startswith("-"):
             out.append(tok)
-            if tok == name and name in value_opts and i + 1 < len(raw_argv):
+            if tok == name and name in _VALUE_OPTS and i + 1 < len(raw_argv):
                 out.append(raw_argv[i + 1])  # bound value: never subcommand
                 i += 2
                 continue
             i += 1
             continue
-        if not seen_subcommand:  # first UNBOUND bare token: 'pod' itself
+        if not seen_subcommand:  # first UNBOUND bare token: the subcommand
             seen_subcommand = True
             i += 1
             continue
         out.append(tok)
         i += 1
     return out
+
+
+def _pod_child_flags(raw_argv: list[str]) -> list[str]:
+    return _child_flags(
+        raw_argv,
+        {"--compute", "--local-start", "--local-count", "--coordinator"},
+        frozenset(("--speed", "--serving")),
+    )
+
+
+def _fleet_child_flags(raw_argv: list[str]) -> list[str]:
+    return _child_flags(
+        raw_argv, {"--replicas", "--front-port", "--policy"}
+    )
+
+
+def cmd_fleet(config: Config, args, raw_argv: list[str]) -> int:
+    """One-host serving fleet: N replica serving processes on distinct
+    ports (fleet/supervisor.py) behind the L7 front (fleet/front.py) —
+    round-robin or consistent-hash placement, health-driven ejection,
+    retry-on-shed. The multi-host shape is the same pieces run per host:
+    `serving` with an `oryx.fleet.replica.id` overlay on each host, one
+    `fleet` front (or any L7 LB consuming GET /healthz) in front.
+
+        python -m oryx_tpu.cli fleet --conf oryx.conf --replicas 3 \\
+            --front-port 8090 --policy hash
+
+    SIGTERM/SIGINT stop the front first (stop taking traffic), then fan
+    out to the replicas. Dead replicas are restarted with backoff; a
+    crash-looping fleet exits nonzero (docs/operations.md "Running a
+    serving fleet")."""
+    from oryx_tpu.fleet import FleetFront, FleetSupervisor
+
+    overlay = {}
+    if args.replicas is not None:
+        overlay["oryx.fleet.replicas"] = args.replicas
+    if args.front_port is not None:
+        overlay["oryx.fleet.front.port"] = args.front_port
+    if args.policy is not None:
+        overlay["oryx.fleet.front.policy"] = args.policy
+    if overlay:
+        config = config.overlay(overlay)
+    sup = FleetSupervisor(config, argv=_fleet_child_flags(raw_argv))
+    front = None
+    prev_term = signal.signal(signal.SIGTERM, lambda *_: sup.request_stop())
+    rc = 0
+    try:
+        sup.start()
+        sup.wait_listening(timeout=120)
+        front = FleetFront(config, backends=sup.backends())
+        front.start()
+        print(
+            f"fleet: {len(sup.ports())} replicas on ports "
+            f"{sup.ports()[0]}..{sup.ports()[-1]}, front :{front.port} "
+            f"({front.policy})",
+            flush=True,
+        )
+        rc = sup.run()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if front is not None:
+            front.close()  # stop taking traffic before killing backends
+        sup.stop()
+        signal.signal(signal.SIGTERM, prev_term)
+    return rc
 
 
 def cmd_pod(config: Config, args, raw_argv: list[str]) -> int:
@@ -990,6 +1078,10 @@ def main(argv=None) -> int:
         return cmd_loadtest(config, args)
     if args.command == "pod":
         return cmd_pod(
+            config, args, list(argv if argv is not None else sys.argv[1:])
+        )
+    if args.command == "fleet":
+        return cmd_fleet(
             config, args, list(argv if argv is not None else sys.argv[1:])
         )
     if args.command == "serving":

@@ -157,6 +157,12 @@ def read_artifact_from_update(key: str, message: str) -> ModelArtifact:
     path through a shared Hadoop FileSystem (AppPMMLUtils.java:261-275,
     FileSystem.get), which has no equivalent here without HDFS."""
     if key == "MODEL":
+        # an inline MODEL is decoded by EVERY consumer that receives it —
+        # inherently per-replica distribution cost (N replicas on a host
+        # pay N decodes); only the chunked MODEL-REF path can amortize
+        _distribution_bytes().inc(
+            len(message.encode("utf-8")), mode="per-replica"
+        )
         return ModelArtifact.from_string(message)
     if key == "MODEL-REF":
         return ModelArtifact.read(artifact_relay().resolve(message))
@@ -166,6 +172,31 @@ def read_artifact_from_update(key: str, message: str) -> ModelArtifact:
 # -- bus-chunked MODEL-REF transfer (no shared filesystem required) --------
 
 CHUNK_KEY = "MODEL-CHUNK"
+
+# sha marker the relay leaves beside a materialized artifact so co-hosted
+# sibling processes can tell "this exact chunk stream is already decoded
+# here" without re-assembling it (the fleet's amortized distribution)
+RELAY_META_FILENAME = "relay.json"
+
+
+def _distribution_bytes():
+    """Counter behind the fleet's distribution-amortization claim:
+    artifact bytes this process decoded+materialized getting a model to
+    its serving replica(s). mode="shared" rode the per-host artifact
+    cache (first completer decodes, siblings skip — N co-hosted replicas
+    total ~1x the artifact); mode="per-replica" was a redundant
+    per-process decode (inline MODELs, or sharing disabled)."""
+    from oryx_tpu.common.metrics import get_registry
+
+    return get_registry().counter(
+        "oryx_fleet_distribution_bytes",
+        "Artifact bytes decoded for model distribution in this process: "
+        "mode=shared deduplicated through the per-host artifact cache "
+        "(one decode per host), mode=per-replica redundant per-process "
+        "decode (inline MODEL messages, or oryx.fleet.distribution."
+        "shared=false)",
+        labeled=True,
+    )
 
 
 class ArtifactRelay:
@@ -209,6 +240,11 @@ class ArtifactRelay:
         # republish parks the same ref twice, and firing both would load
         # and swap the same model twice
         self._parked: dict[str, object] = {}
+        # amortize assembly across co-hosted replicas (the fleet's shared
+        # model distribution): a sibling's sha-marked materialization is
+        # adopted instead of redundantly re-decoded. Configured from
+        # oryx.fleet.distribution.shared (configure_artifact_relay).
+        self.shared_distribution = True
 
     def _root(self) -> Path:
         if self._cache_root is None:
@@ -228,13 +264,36 @@ class ArtifactRelay:
 
     def offer(self, message: str) -> None:
         """Ingest one MODEL-CHUNK message; materializes the artifact into
-        the local cache when the last chunk arrives."""
+        the local cache when the last chunk arrives. With shared
+        distribution on, a chunk stream a co-hosted sibling already
+        assembled (matching sha marker in the shared cache) is skipped
+        wholesale — not even base64-decoded — so N replicas on one host
+        pay ~one decode total."""
         import hashlib
 
         d = json.loads(message)
         ref, i, n = str(d["ref"]), int(d["i"]), int(d["n"])
         if not (0 <= i < n):
             raise ValueError(f"bad chunk index {i}/{n}")
+        if (
+            self.shared_distribution
+            and d.get("sha") is not None
+            and self._cached_sha(ref) == d["sha"]
+        ):
+            # the marker re-check stays per-chunk (one tiny-file read —
+            # it also notices a sibling evicting the dir mid-stream) but
+            # the adoption side-effects (LRU utime, cache-root scan,
+            # parked-ref fire) run once per STREAM, not once per chunk:
+            # a replayed 1 GB artifact at 1 MB chunks must not cost ~1000
+            # directory scans on the update-consumer thread. A parked
+            # MODEL-REF fires immediately instead of waiting for the
+            # stream's tail.
+            with self._lock:
+                self._pending.pop(ref, None)
+                parked = ref in self._parked
+            if parked or i == n - 1:
+                self._adopt(ref)
+            return
         data = base64.b64decode(d["data"])
         with self._lock:
             ent = self._pending.setdefault(
@@ -259,8 +318,88 @@ class ArtifactRelay:
         sha = ent.get("sha")
         if sha and hashlib.sha256(blob).hexdigest() != sha:
             raise ValueError(f"MODEL-CHUNK sha mismatch for {ref}")
+        self._finish(ref, blob, sha)
+
+    def _finish(self, ref: str, blob: bytes, sha: str | None) -> None:
+        """Decode + materialize one fully assembled chunk stream, deduped
+        across co-hosted processes when sharing is on: the assembly lock
+        serializes the (fast) decode+write, and a loser re-checking the
+        sha marker under the lock adopts the winner's bytes-identical
+        copy instead of decoding its own. Either way exactly one process
+        counts the blob into mode=shared; the disabled path counts every
+        process's decode into mode=per-replica."""
+        if self.shared_distribution and sha is not None:
+            with self._assembly_lock(ref):
+                if self._cached_sha(ref) == sha:
+                    # lost the race to a sibling replica — its copy is the
+                    # same bytes (same sha); nothing left to decode
+                    self._adopt(ref)
+                    return
+                art = ModelArtifact.from_string(blob.decode("utf-8"))
+                self._materialize(ref, art, sha=sha)
+            _distribution_bytes().inc(len(blob), mode="shared")
+            return
         art = ModelArtifact.from_string(blob.decode("utf-8"))
-        self._materialize(ref, art)
+        self._materialize(ref, art, sha=sha)
+        _distribution_bytes().inc(len(blob), mode="per-replica")
+
+    def _adopt(self, ref: str) -> None:
+        """Adopt a sibling's materialization as this relay's own: bump the
+        shared LRU stamp and apply this relay's cache cap. An adopting
+        consumer replaying a long topic history must prune exactly like a
+        materializing one would, or its MAX_CACHED stops bounding the
+        shared root whenever the artifacts are already decoded."""
+        import os
+
+        dest = self._dest(ref)
+        try:
+            os.utime(dest)
+        except OSError:
+            pass
+        self._evict_cache_dirs(keep=dest)
+        self._fire_parked(ref)
+
+    def _assembly_lock(self, ref: str):
+        """Cross-process exclusive lock for one ref's decode+materialize
+        (an advisory flock file beside the cache dir — a dotfile, so the
+        cache-dir LRU never sees it). Platforms without fcntl fall back
+        to unlocked operation: the race then just costs a redundant
+        decode, never corruption (materialize is rename-atomic)."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _cm():
+            try:
+                import fcntl
+
+                f = open(self._root() / f".{self._dest(ref).name}.lock", "a+b")
+            except (ImportError, OSError):
+                yield
+                return
+            try:
+                fcntl.flock(f, fcntl.LOCK_EX)
+                yield
+            finally:
+                try:
+                    fcntl.flock(f, fcntl.LOCK_UN)
+                except OSError:  # pragma: no cover - unlock-on-close wins
+                    pass
+                f.close()
+
+        return _cm()
+
+    def _cached_sha(self, ref: str) -> str | None:
+        """sha of the materialized artifact for `ref` in the shared cache,
+        or None (not materialized, or materialized by a pre-marker
+        writer)."""
+        try:
+            with open(
+                self._dest(ref) / RELAY_META_FILENAME, encoding="utf-8"
+            ) as f:
+                v = json.load(f).get("sha")
+            return str(v) if v else None
+        except (OSError, ValueError):
+            return None
 
     def _dest(self, ref: str) -> Path:
         """The deterministic cache dir for a ref — derived, not tracked:
@@ -270,11 +409,15 @@ class ArtifactRelay:
 
         return self._root() / hashlib.sha256(ref.encode()).hexdigest()[:24]
 
-    def _materialize(self, ref: str, art: ModelArtifact) -> None:
+    def _materialize(
+        self, ref: str, art: ModelArtifact, sha: str | None = None
+    ) -> None:
         """Write the assembled artifact into the stable cache, atomically
         enough for concurrent processes: build in a per-pid temp dir, then
         rename into place; a lost race just adopts the winner's copy
-        (identical bytes — both assembled the same chunk stream)."""
+        (identical bytes — both assembled the same chunk stream). The sha
+        marker rides INSIDE the dir (written before the rename) so a
+        sibling never reads a marker whose artifact is half-written."""
         import os
         import shutil
 
@@ -282,6 +425,9 @@ class ArtifactRelay:
         tmp = self._root() / f".{dest.name}.tmp-{os.getpid()}"
         shutil.rmtree(tmp, ignore_errors=True)
         art.write(tmp)
+        if sha is not None:
+            with open(tmp / RELAY_META_FILENAME, "w", encoding="utf-8") as f:
+                json.dump({"sha": sha}, f)
         shutil.rmtree(dest, ignore_errors=True)
         try:
             os.replace(tmp, dest)
@@ -421,6 +567,16 @@ def artifact_relay() -> ArtifactRelay:
     return _RELAY
 
 
+def configure_artifact_relay(config) -> None:
+    """Adopt the fleet's distribution mode (called wherever a process
+    adopts its config — ServingApp, layer startup): shared = amortize
+    chunk assembly across co-hosted replicas through the per-host cache;
+    off restores strictly per-process decodes."""
+    artifact_relay().shared_distribution = config.get_bool(
+        "oryx.fleet.distribution.shared", True
+    )
+
+
 def publish_model_ref(
     producer,
     serialized: str,
@@ -451,20 +607,43 @@ def publish_model_ref(
         raw = serialized.encode("utf-8")
         sha = hashlib.sha256(raw).hexdigest()
         n = max(1, math.ceil(len(raw) / budget))
+        # chunks ship in bounded batches through send_batch (one broker
+        # lock round-trip per group instead of per chunk; same-key records
+        # share a partition, so publish order is preserved). The group cap
+        # bounds transient memory to ~8 encoded chunks, not the whole
+        # artifact twice.
+        send_batch = getattr(producer, "send_batch", None)
+        batch: list[tuple[str, str]] = []
+
+        def _flush() -> None:
+            if not batch:
+                return
+            if send_batch is not None:
+                send_batch(batch)
+            else:  # bare-broker callers without the batch API
+                for key, msg in batch:
+                    producer.send(key, msg)
+            batch.clear()
+
         for i in range(n):
-            producer.send(
-                CHUNK_KEY,
-                json.dumps(
-                    {
-                        "ref": model_path,
-                        "i": i,
-                        "n": n,
-                        "sha": sha,
-                        "data": base64.b64encode(
-                            raw[i * budget : (i + 1) * budget]
-                        ).decode("ascii"),
-                    },
-                    separators=(",", ":"),
-                ),
+            batch.append(
+                (
+                    CHUNK_KEY,
+                    json.dumps(
+                        {
+                            "ref": model_path,
+                            "i": i,
+                            "n": n,
+                            "sha": sha,
+                            "data": base64.b64encode(
+                                raw[i * budget : (i + 1) * budget]
+                            ).decode("ascii"),
+                        },
+                        separators=(",", ":"),
+                    ),
+                )
             )
+            if len(batch) >= 8:
+                _flush()
+        _flush()
     producer.send("MODEL-REF", model_path)

@@ -63,6 +63,52 @@ class LoggingRunnable:
             raise
 
 
+def free_port_run(n: int, host: str = "127.0.0.1", attempts: int = 50) -> int:
+    """Base of a run of ``n`` consecutive free TCP ports on ``host`` —
+    the shape a fleet supervisor's ``base-port + i`` layout needs. All
+    ``n`` ports are held bound while probing so the run is free at the
+    moment of return (the usual bind race remains: the caller must bind
+    soon after)."""
+    import socket
+
+    for _ in range(attempts):
+        socks: list[socket.socket] = []
+        try:
+            s = socket.socket()
+            s.bind((host, 0))
+            base = s.getsockname()[1]
+            socks.append(s)
+            for i in range(1, n):
+                si = socket.socket()
+                si.bind((host, base + i))
+                socks.append(si)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError(f"no run of {n} free ports found on {host}")
+
+
+def config_overlay_from_sets(pairs) -> dict:
+    """``key=value`` strings (the CLI's ``--set`` grammar) as a config
+    overlay dict: values parse as JSON where possible (numbers, bools,
+    lists) and fall back to raw strings — exactly how cli.py applies
+    ``--set``, shared here so harnesses building a Config AND a child
+    argv from one list of sets cannot drift from the CLI's coercion."""
+    import json
+
+    out: dict = {}
+    for s in pairs:
+        k, v = s.split("=", 1)
+        try:
+            out[k] = json.loads(v)
+        except json.JSONDecodeError:
+            out[k] = v
+    return out
+
+
 def cpu_subprocess_env(base: dict | None = None, **overrides: str) -> dict:
     """Environment for a CPU-only child python process: forces
     JAX_PLATFORMS=cpu and strips accelerator-plugin triggers. A

@@ -1,0 +1,34 @@
+"""Replicated serving fleet: supervisor, consistent-hash front, shared
+model distribution.
+
+The single-host serving story scales event loops (PR 1) and replica
+processes on one port (``oryx.serving.api.processes``); this package is
+the N-hosts story the lambda contract makes natural — serving instances
+are stateless consumers of the update topic (PAPER.md), so a fleet is N
+independent serving processes behind a thin L7 front:
+
+- :mod:`oryx_tpu.fleet.supervisor` launches and monitors N serving
+  replicas on distinct ports with per-replica config overlays.
+- :mod:`oryx_tpu.fleet.front` is the router: round-robin or
+  consistent-hash-by-user placement, health-driven ejection from the
+  replicas' ``GET /healthz`` degraded states, and Retry-After-aware
+  retry of shed requests on a different replica.
+- :mod:`oryx_tpu.fleet.ring` is the hash ring behind the hash policy.
+
+Model distribution is amortized across co-hosted replicas by the shared
+artifact relay cache (``common/artifact.py``): MODEL-CHUNK reassembly
+happens once per host, measured by
+``oryx_fleet_distribution_bytes{mode=shared|per-replica}``.
+"""
+
+from oryx_tpu.fleet.ring import HashRing
+from oryx_tpu.fleet.front import FleetFront, ReplicaInfo
+from oryx_tpu.fleet.supervisor import FleetSupervisor, replica_overlays
+
+__all__ = [
+    "FleetFront",
+    "FleetSupervisor",
+    "HashRing",
+    "ReplicaInfo",
+    "replica_overlays",
+]

@@ -1,0 +1,294 @@
+"""Replica supervisor: N serving processes on one host, distinct ports.
+
+The in-process scaling story multiplies event loops over ONE model
+(``oryx.serving.api.loops``, PR 1); the fleet multiplies PROCESSES, each
+an independent stateless consumer of the update topic (the lambda
+contract, PAPER.md) with its own model replica, GIL, and failure domain.
+The supervisor launches them as real OS processes — the same
+``python -m oryx_tpu.cli serving`` an operator would run per host — with
+a per-replica config overlay: its own port (``base-port + i``), a
+replica identity (``oryx.fleet.replica.id``) that the /healthz degraded
+surface and the front's ejection log name, a namespaced ``oryx.id`` so
+consumer groups/offset stores never collide, and per-replica scratch
+dirs under ``oryx.fleet.data-dir``. Everything else — the broker, the
+model dir, the update topic — is shared: model distribution is the bus's
+job (amortized per host by the shared artifact relay,
+``common/artifact.py``).
+
+Dead replicas are restarted with exponential backoff; a fleet whose
+replicas keep dying within seconds of spawn is crash-looping (bad
+config, port conflict) and the supervisor gives up loudly instead of
+hammering the port forever.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from oryx_tpu.common.config import Config
+from oryx_tpu.common.ioutil import strip_scheme
+
+log = logging.getLogger(__name__)
+
+# a replica dying within this many seconds of spawn counts as a fast
+# fail (crash loop), not an operational death
+_FAST_FAIL_S = 10.0
+
+
+def replica_overlays(
+    config: Config, n: int | None = None, base_port: int | None = None
+) -> list[dict[str, object]]:
+    """Per-replica ``--set`` overlays for an N-replica fleet on this host.
+
+    Shared config stays shared (broker, topics, model dir); only identity
+    and per-process resources differ per replica. Exposed as a function so
+    tests and the bench can build the exact child configs without spawning.
+    """
+    if n is None:
+        n = config.get_int("oryx.fleet.replicas", 2)
+    if base_port is None:
+        base_port = config.get_int("oryx.fleet.base-port", 8100)
+    if n < 1:
+        raise ValueError(f"fleet needs >= 1 replica, got {n}")
+    data_root = strip_scheme(
+        config.get_string("oryx.fleet.data-dir", "file:/tmp/oryx_tpu/fleet")
+    )
+    base_id = config.get_string("oryx.id", None) or "fleet"
+    overlays: list[dict[str, object]] = []
+    for i in range(n):
+        rid = f"r{i}"
+        overlays.append(
+            {
+                # identity: names this process in /healthz degraded
+                # reasons, the front's ejection log, and fleet metrics
+                "oryx.fleet.replica.id": rid,
+                "oryx.serving.api.port": base_port + i,
+                # each replica is a full process already; nested replica
+                # supervision would fork N^2 servers
+                "oryx.serving.api.processes": 1,
+                # namespaced deployment id -> distinct consumer groups and
+                # offset stores per replica (each replays the update topic
+                # independently, the stateless-consumer contract)
+                "oryx.id": f"{base_id}-{rid}",
+                # per-replica scratch: quarantined records name their
+                # replica instead of interleaving in one dead-letter dir
+                "oryx.monitoring.quarantine.dir": os.path.join(
+                    data_root, rid, "quarantine"
+                ),
+            }
+        )
+    return overlays
+
+
+class FleetSupervisor:
+    """Launches and monitors the replica processes of a one-host fleet.
+
+    ``argv`` is the passthrough command line (``--conf``/``--set`` flags)
+    every replica child receives BEFORE its per-replica overlay — later
+    ``--set`` wins, so the overlay's port/id always take effect.
+    """
+
+    def __init__(
+        self,
+        config: Config,
+        argv: list[str] | None = None,
+        n: int | None = None,
+        base_port: int | None = None,
+        env: dict | None = None,
+        stdout=None,
+        stderr=None,
+        exec_prefixes: list[list[str]] | None = None,
+    ):
+        self.config = config
+        self.overlays = replica_overlays(config, n, base_port)
+        # per-replica command prefixes (e.g. ["taskset", "-c", "0"]):
+        # affinity set at exec time is inherited by every thread the
+        # replica spawns, unlike a post-hoc sched_setaffinity(pid) which
+        # on Linux pins only the main thread
+        if exec_prefixes is not None and len(exec_prefixes) != len(self.overlays):
+            raise ValueError(
+                f"exec_prefixes has {len(exec_prefixes)} entries for "
+                f"{len(self.overlays)} replicas"
+            )
+        self.exec_prefixes = exec_prefixes
+        self.restart = config.get_bool("oryx.fleet.supervisor.restart", True)
+        self.max_fast_fails = config.get_int(
+            "oryx.fleet.supervisor.max-fast-fails", 6
+        )
+        self.argv = list(argv or [])
+        self.env = dict(env if env is not None else os.environ)
+        self._stdout = stdout
+        self._stderr = stderr
+        self.procs: list[subprocess.Popen | None] = [None] * len(self.overlays)
+        self._spawned_at: list[float] = [0.0] * len(self.overlays)
+        # a death is CLASSIFIED (fast-fail accounting, backoff growth)
+        # exactly once, when first observed — a corpse waiting out its
+        # restart backoff must not be re-counted by every poll() tick, or
+        # crash-loop detection counts supervision ticks instead of deaths
+        self._death_counted: list[bool] = [False] * len(self.overlays)
+        self._fast_fails = 0
+        self._backoff = 1.0
+        self._next_restart = 0.0
+        self.crash_looping = False
+        self._stopping = threading.Event()
+
+    # -- topology ----------------------------------------------------------
+
+    def backends(self) -> list[tuple[str, str, int]]:
+        """(replica id, host, port) rows in the shape FleetFront takes."""
+        return [
+            (str(o["oryx.fleet.replica.id"]), "127.0.0.1", int(o["oryx.serving.api.port"]))
+            for o in self.overlays
+        ]
+
+    def ports(self) -> list[int]:
+        return [int(o["oryx.serving.api.port"]) for o in self.overlays]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _spawn(self, i: int) -> subprocess.Popen:
+        prefix = self.exec_prefixes[i] if self.exec_prefixes else []
+        cmd = [*prefix, sys.executable, "-m", "oryx_tpu.cli", "serving", *self.argv]
+        for k, v in self.overlays[i].items():
+            cmd += ["--set", f"{k}={v}"]
+        p = subprocess.Popen(
+            cmd, env=self.env, stdout=self._stdout, stderr=self._stderr
+        )
+        self._spawned_at[i] = time.monotonic()
+        log.info(
+            "fleet supervisor: replica %s (pid %d) on port %d",
+            self.overlays[i]["oryx.fleet.replica.id"],
+            p.pid,
+            self.overlays[i]["oryx.serving.api.port"],
+        )
+        return p
+
+    def start(self) -> None:
+        for i in range(len(self.overlays)):
+            self.procs[i] = self._spawn(i)
+
+    def wait_listening(self, timeout: float = 90.0) -> None:
+        """Block until every replica answers ``HEAD /healthz`` (pure
+        liveness — 200 as soon as the frontend dispatches, independent of
+        model readiness). Raises if a replica dies or the deadline
+        passes."""
+        import http.client
+
+        deadline = time.monotonic() + timeout
+        pending = set(range(len(self.overlays)))
+        while pending:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"replicas never started listening: "
+                    f"{sorted(self.ports()[i] for i in pending)}"
+                )
+            for i in sorted(pending):
+                p = self.procs[i]
+                if p is not None and p.poll() is not None:
+                    raise RuntimeError(
+                        f"replica {i} exited rc={p.returncode} before "
+                        "listening"
+                    )
+                try:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", self.ports()[i], timeout=2
+                    )
+                    try:
+                        conn.request("HEAD", "/healthz")
+                        if conn.getresponse().status == 200:
+                            pending.discard(i)
+                    finally:
+                        conn.close()
+                except OSError:
+                    pass
+            if pending:
+                time.sleep(0.2)
+
+    def poll(self) -> None:
+        """One supervision pass: restart dead replicas (with backoff),
+        flag a crash loop. Call periodically, or let run() do it."""
+        if self._stopping.is_set() or not self.restart or self.crash_looping:
+            return
+        now = time.monotonic()
+        for i, p in enumerate(self.procs):
+            if p is None or p.poll() is None:
+                continue
+            if not self._death_counted[i]:
+                self._death_counted[i] = True
+                fast = now - self._spawned_at[i] < _FAST_FAIL_S
+                if fast:
+                    self._fast_fails += 1
+                    if self._fast_fails >= self.max_fast_fails:
+                        log.error(
+                            "fleet supervisor: replicas crash-looping "
+                            "(rc=%s); giving up on restarts", p.returncode,
+                        )
+                        self.crash_looping = True
+                        return
+                    self._backoff = min(self._backoff * 2, 30.0)
+                else:
+                    self._fast_fails = 0
+                    self._backoff = 1.0
+            if now < self._next_restart:
+                continue
+            log.warning(
+                "fleet supervisor: replica %d died rc=%s; restarting "
+                "(next backoff %.0fs)", i, p.returncode, self._backoff,
+            )
+            self._next_restart = now + self._backoff
+            self.procs[i] = self._spawn(i)
+            self._death_counted[i] = False
+
+    def request_stop(self) -> None:
+        """Signal-handler-safe stop request: run() exits on the next
+        tick; the caller then does the blocking stop()."""
+        self._stopping.set()
+
+    def run(self) -> int:
+        """Supervise until stop(); returns 1 if the fleet crash-looped."""
+        while not self._stopping.is_set():
+            self.poll()
+            if self.crash_looping:
+                return 1
+            self._stopping.wait(1.0)
+        return 0
+
+    # -- chaos / teardown --------------------------------------------------
+
+    def kill(self, i: int, sig: int = signal.SIGKILL) -> None:
+        """Kill one replica (the chaos hook: ``fleet-kill`` sends SIGKILL
+        mid update-storm). The next poll() restarts it unless restarts
+        are off or stop() was called."""
+        p = self.procs[i]
+        if p is not None and p.poll() is None:
+            p.send_signal(sig)
+
+    def stop(self, timeout: float = 15.0) -> None:
+        self._stopping.set()
+        for p in self.procs:
+            if p is not None and p.poll() is None:
+                p.terminate()
+        for p in self.procs:
+            if p is None:
+                continue
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    pass
+
+    def __enter__(self) -> "FleetSupervisor":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

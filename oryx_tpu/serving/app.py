@@ -208,6 +208,16 @@ class ServingApp:
         # and clients can SEE the degradation.
         raw_stale = config.get("oryx.serving.api.max-staleness-sec", None)
         self.max_staleness_sec = float(raw_stale) if raw_stale is not None else None
+        # fleet identity: names this process in degraded reasons, the
+        # fleet front's ejection log, and oryx_fleet_replica_* labels
+        # (set per replica by fleet/supervisor.py; null outside a fleet)
+        self.replica_id = config.get_string("oryx.fleet.replica.id", None)
+        # the bound listening port, filled in by the serving layer once
+        # the (possibly ephemeral) bind resolves; 0 until then
+        self.listen_port = 0
+        # update-topic consumer backlog callback (ConsumeDataIterator.lag),
+        # wired by ServingLayer so /healthz can report update_lag
+        self.update_lag_fn = None
         # mount point (reference: Tomcat context path, ServingLayer.java);
         # "" = root. Requests outside the prefix 404 before routing.
         raw_ctx = (config.get_string("oryx.serving.api.context-path", "/") or "/").strip("/")
@@ -239,6 +249,11 @@ class ServingApp:
         from oryx_tpu.common.perfstats import configure_perfstats
 
         configure_perfstats(config)
+        # the update-topic listener's artifact relay adopts the fleet's
+        # distribution mode (shared per-host cache vs per-process decode)
+        from oryx_tpu.common.artifact import configure_artifact_relay
+
+        configure_artifact_relay(config)
         self.started_at = time.monotonic()
         self.loop_count = 1  # the async frontend overwrites with its fan-out
         reg = get_registry()
@@ -388,7 +403,12 @@ class ServingApp:
         """Why this serving process is degraded right now (empty = fully
         healthy). The /healthz readiness surface: model past its
         staleness bound, top-k serving failed over to host scoring, or a
-        co-resident layer's wedge watchdog tripped."""
+        co-resident layer's wedge watchdog tripped.
+
+        In a fleet, each reason carries this replica's identity
+        (``model-stale@r1:8101``): a front aggregating N processes' probe
+        bodies into one ejection log needs reasons that name the process,
+        not anonymous strings N replicas all emit identically."""
         reasons: list[str] = []
         if self.model_staleness() is not None:
             reasons.append("model-stale")
@@ -400,7 +420,23 @@ class ServingApp:
         from oryx_tpu.layers.watchdog import wedged_layers
 
         reasons.extend(f"wedged:{name}" for name in wedged_layers())
+        if self.replica_id:
+            tag = f"@{self.replica_id}:{self.listen_port}"
+            reasons = [r + tag for r in reasons]
         return reasons
+
+    def staleness_age(self) -> float | None:
+        """Raw age in seconds of the served model's publish stamp (None
+        until a stamped model loaded) — the number behind
+        ``oryx_model_staleness_seconds``, reported on /healthz regardless
+        of the degraded bound so a fleet front can watch staleness
+        converge per replica instead of only seeing the bound trip."""
+        from oryx_tpu.common.freshness import model_freshness
+
+        p = model_freshness().published_ms
+        if p is None:
+            return None
+        return max(0.0, time.time() * 1000.0 - p) / 1000.0
 
     def send_input(self, line: str) -> None:
         """POST a raw input line to the input topic, keyed by its hash

@@ -103,13 +103,39 @@ def register(app: ServingApp) -> None:
         from oryx_tpu.common.freshness import model_freshness
 
         degraded = a.degraded_reasons()
-        return (503 if degraded else 200), {
+        body = {
             "status": "degraded" if degraded else "up",
             "degraded": degraded,
             "uptime_seconds": round(time.monotonic() - a.started_at, 3),
             "loops": a.loop_count,
             "model_generation": model_freshness().generation,
         }
+        # fleet surface: name this process (the front's ejection log and
+        # oryx_fleet_replica_* labels come straight from here) and carry
+        # the per-replica freshness/perf numbers the front aggregates
+        if a.replica_id:
+            body["replica"] = a.replica_id
+        if a.listen_port:
+            body["port"] = a.listen_port
+        age = a.staleness_age()
+        if age is not None:
+            body["staleness_seconds"] = round(age, 3)
+        if a.update_lag_fn is not None:
+            try:
+                body["update_lag"] = int(a.update_lag_fn())
+            except Exception:  # noqa: BLE001 - a probe never 500s on lag
+                pass
+        try:
+            import math
+
+            from oryx_tpu.common.perfstats import get_perfstats
+
+            mfu = get_perfstats().mfu("serving")
+            if not math.isnan(mfu):
+                body["mfu"] = round(mfu, 6)
+        except Exception:  # noqa: BLE001 - perf accounting is optional
+            pass
+        return (503 if degraded else 200), body
 
     @app.route("HEAD", "/healthz", nonblocking=True)
     def healthz_head(a: ServingApp, req: Request):

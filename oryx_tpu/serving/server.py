@@ -147,6 +147,30 @@ class ServingLayer:
         self._listener.start()
 
         self.app = ServingApp(self.config, self.model_manager, input_producer)
+        # /healthz reports this consumer's update-topic backlog so a
+        # fleet front can see a replica falling behind model distribution.
+        # Sampled on a dedicated thread, never on the probe: lag() does
+        # synchronous broker I/O (Kafka ListOffsets round trips, filelog
+        # stats), and /healthz dispatches inline on the serving event
+        # loop — a slow bus must degrade the lag NUMBER, not stall every
+        # in-flight /recommend behind a blocked probe (which would then
+        # get the replica ejected by the very front asking after it).
+        self._lag_sample: int | None = None
+        self._lag_stop = threading.Event()
+
+        def sample_lag() -> None:
+            while not self._lag_stop.is_set():
+                try:
+                    self._lag_sample = self._update_consumer.lag()
+                except Exception:  # noqa: BLE001 - lag is best-effort
+                    self._lag_sample = None
+                self._lag_stop.wait(2.0)
+
+        self._lag_thread = threading.Thread(
+            target=sample_lag, name="oryx-serving-update-lag", daemon=True
+        )
+        self._lag_thread.start()
+        self.app.update_lag_fn = lambda: self._lag_sample
         # saturation shedding knobs for the process-wide top-k batcher
         # (oryx.serving.api.shed.*): past max-queue, submits 503 with
         # Retry-After instead of queueing without bound
@@ -246,6 +270,9 @@ class ServingLayer:
                 target=self._httpd.serve_forever, name="oryx-serving-http", daemon=True
             )
             self._http_thread.start()
+        # the bound port is now concrete (ephemeral binds resolved):
+        # /healthz and degraded reasons can name it
+        self.app.listen_port = self.port
         if self._aio_server is not None:
             log.info(
                 "serving layer listening on :%d (async, %d event loops)",
@@ -261,6 +288,8 @@ class ServingLayer:
             self._http_thread.join()
 
     def close(self) -> None:
+        if getattr(self, "_lag_stop", None) is not None:
+            self._lag_stop.set()
         if self._aio_server:
             self._aio_server.close()
         if self._httpd:

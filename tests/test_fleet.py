@@ -1,0 +1,474 @@
+"""Fleet subsystem units: consistent-hash ring stability, the front's
+retry-on-shed / ejection semantics against scripted backends, shared
+model-distribution amortization, replica-tagged health, and supervisor
+overlays — the in-process halves of ISSUE 7 (the process-level kill
+scenario lives in test_fleet_chaos.py)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from oryx_tpu.common.config import load_config
+from oryx_tpu.fleet import FleetFront, HashRing, replica_overlays
+from oryx_tpu.fleet.front import ReplicaInfo  # noqa: F401 - public surface
+
+
+# ---- consistent-hash ring -------------------------------------------------
+
+KEYS = [f"user-{i}" for i in range(3000)]
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5])
+def test_ring_grow_moves_only_the_new_nodes_slice(n):
+    """Property (ISSUE 7 satellite): same user -> same replica across a
+    fleet resize, except the minimal slice the new replica takes over —
+    every remapped key must land on the ADDED node, and the slice should
+    be ~1/(n+1) of the keyspace, nothing like a full reshuffle."""
+    ring = HashRing([f"r{i}" for i in range(n)])
+    before = {k: ring.lookup(k) for k in KEYS}
+    ring.add(f"r{n}")
+    moved = {k for k in KEYS if ring.lookup(k) != before[k]}
+    assert all(ring.lookup(k) == f"r{n}" for k in moved)
+    # minimal-disruption bound: expected |moved| ~ len(KEYS)/(n+1); allow
+    # generous slack for hash variance, but far below "most keys moved"
+    assert len(moved) <= 3.0 * len(KEYS) / (n + 1)
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 5])
+def test_ring_shrink_moves_only_the_removed_nodes_keys(n):
+    ring = HashRing([f"r{i}" for i in range(n)])
+    before = {k: ring.lookup(k) for k in KEYS}
+    ring.remove("r0")
+    for k in KEYS:
+        if before[k] != "r0":
+            assert ring.lookup(k) == before[k]
+        else:
+            assert ring.lookup(k) != "r0"
+
+
+def test_ring_deterministic_across_instances():
+    a = HashRing(["x", "y", "z"])
+    b = HashRing(["z", "y", "x"])  # insertion order must not matter
+    assert [a.lookup(k) for k in KEYS[:200]] == [b.lookup(k) for k in KEYS[:200]]
+
+
+def test_ring_successor_walk_covers_all_nodes_once():
+    ring = HashRing(["a", "b", "c"])
+    seq = list(ring.lookup_seq("some-user"))
+    assert sorted(seq) == ["a", "b", "c"]
+    assert seq[0] == ring.lookup("some-user")
+
+
+# ---- front behavior against scripted backends -----------------------------
+
+
+class _StubReplica:
+    """Scripted HTTP backend: /healthz answers 200; every other GET/POST
+    runs the injected behavior. Counts the non-probe requests it served."""
+
+    def __init__(self, behave):
+        self.behave = behave
+        self.hits = 0
+        self.lock = threading.Lock()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _serve(self, method):
+                length = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(length) if length else b""
+                if self.path == "/healthz":
+                    body = b'{"status":"up","degraded":[]}'
+                    self.send_response(200)
+                else:
+                    with stub.lock:
+                        stub.hits += 1
+                    status, headers, body = stub.behave(method, self.path)
+                    self.send_response(status)
+                    for k, v in headers:
+                        self.send_header(k, v)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._serve("GET")
+
+            def do_POST(self):
+                self._serve("POST")
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _front_for(backends, **front_keys):
+    overlay = {"oryx.fleet.front.probe-interval-sec": 0.2}
+    overlay.update(
+        {f"oryx.fleet.front.{k.replace('_', '-')}": v for k, v in front_keys.items()}
+    )
+    cfg = load_config(overlay=overlay)
+    front = FleetFront(
+        cfg,
+        backends=[(f"r{i}", "127.0.0.1", s.port) for i, s in enumerate(backends)],
+        port=0,
+    )
+    front.start()
+    return front
+
+
+def _get(port, path, method="GET", body=b""):
+    import http.client
+
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        c.request(method, path, body=body)
+        r = c.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        c.close()
+
+
+def test_front_retries_shed_on_another_replica_exactly_once():
+    """A deliberate shed (503 + Retry-After) did NOT process the request:
+    the front must re-place it on a different replica, the client sees
+    ONE 200, and fleet-wide the request was processed exactly once."""
+    shedder = _StubReplica(
+        lambda m, p: (503, [("Retry-After", "1")], b'{"error":"overloaded"}')
+    )
+    worker = _StubReplica(lambda m, p: (200, [], b'{"ok":true}'))
+    front = _front_for([shedder, worker])
+    try:
+        # drive enough requests that round-robin hits the shedder first at
+        # least once (rr order is request-arrival dependent)
+        oks = 0
+        for i in range(8):
+            status, headers, body = _get(front.port, f"/recommend/u{i}")
+            assert status == 200, (status, body)
+            assert body == b'{"ok":true}'
+            oks += 1
+        assert worker.hits == oks  # every request answered exactly once
+        assert shedder.hits >= 1  # the shed path actually exercised
+        retries = front._m_retries.value(reason="shed")
+        assert retries == shedder.hits  # one re-placement per shed, no loops
+    finally:
+        front.close()
+        shedder.close()
+        worker.close()
+
+
+def test_front_surfaces_shed_when_every_replica_sheds():
+    a = _StubReplica(
+        lambda m, p: (503, [("Retry-After", "7")], b'{"error":"overloaded"}')
+    )
+    b = _StubReplica(
+        lambda m, p: (503, [("Retry-After", "7")], b'{"error":"overloaded"}')
+    )
+    front = _front_for([a, b])
+    try:
+        status, headers, body = _get(front.port, "/recommend/u1")
+        assert status == 503
+        # the backpressure signal (Retry-After) survives to the client
+        assert headers.get("Retry-After") == "7"
+        assert a.hits + b.hits == 2  # tried each replica once, no loops
+    finally:
+        front.close()
+        a.close()
+        b.close()
+
+
+def test_front_hash_policy_sticks_users_to_one_replica():
+    replicas = [
+        _StubReplica(lambda m, p, i=i: (200, [], b"%d" % i)) for i in range(3)
+    ]
+    front = _front_for(replicas, policy="hash")
+    try:
+        for u in range(20):
+            answers = {
+                _get(front.port, f"/recommend/user{u}?howMany=2")[2]
+                for _ in range(3)
+            }
+            assert len(answers) == 1  # same user -> same replica, always
+    finally:
+        front.close()
+        for s in replicas:
+            s.close()
+
+
+def test_front_post_connect_failure_is_not_replayed():
+    """A POST that may have reached a dead backend must NOT be replayed on
+    a sibling (double-ingest risk); the front answers 502 instead."""
+    worker = _StubReplica(lambda m, p: (200, [], b'{"ok":true}'))
+    dead_port_holder = _StubReplica(lambda m, p: (200, [], b"{}"))
+    dead_port = dead_port_holder.port
+    dead_port_holder.close()  # port now refuses connections
+    import http.client
+
+    cfg = load_config(overlay={"oryx.fleet.front.probe-interval-sec": 30})
+    front = FleetFront(
+        cfg,
+        backends=[
+            ("rdead", "127.0.0.1", dead_port),
+            ("rok", "127.0.0.1", worker.port),
+        ],
+        port=0,
+    )
+    front.start()
+    try:
+        got = {"ok": 0, "bad": 0}
+        for i in range(6):
+            status, _, _ = _get(front.port, "/ingest", method="POST", body=b"x,y,1")
+            if status == 200:
+                got["ok"] += 1
+            else:
+                assert status == 502
+                got["bad"] += 1
+        # round-robin sent some POSTs at the dead replica: those must be
+        # 502s (not silently replayed), the rest clean 200s
+        assert got["bad"] >= 1 and got["ok"] >= 1
+        assert worker.hits == got["ok"]
+        # while the same failure on a GET IS retried transparently
+        status, _, body = _get(front.port, "/recommend/u1")
+        assert status == 200 and body == b'{"ok":true}'
+    finally:
+        front.close()
+        worker.close()
+
+
+def test_front_ejects_and_readmits_on_health():
+    flaky_degraded = {"on": False}
+
+    class _Probe(_StubReplica):
+        pass
+
+    worker = _StubReplica(lambda m, p: (200, [], b'{"ok":true}'))
+    front = _front_for([worker], eject_after=1, readmit_after=1)
+    try:
+        r = front.replicas[0]
+        deadline = time.time() + 10
+        while not r.routable or r.state != "up":
+            assert time.time() < deadline
+            time.sleep(0.05)
+        worker.close()  # probe target gone -> unreachable -> eject
+        deadline = time.time() + 10
+        while r.routable:
+            assert time.time() < deadline, "dead replica never ejected"
+            time.sleep(0.05)
+        assert r.state == "down"
+        assert front._m_ejections.value(replica="r0") >= 1
+    finally:
+        front.close()
+
+
+# ---- shared model distribution (amortization acceptance) ------------------
+
+
+def _chunk_messages(serialized: str, ref: str, max_size: int = 2048):
+    """Capture the MODEL-CHUNK train publish_model_ref would emit."""
+    from oryx_tpu.common.artifact import publish_model_ref
+
+    sent: list[tuple[str, str]] = []
+
+    class _Capture:
+        def send(self, key, message):
+            sent.append((key, message))
+
+        def send_batch(self, records):
+            sent.extend(records)
+
+    publish_model_ref(_Capture(), serialized, ref, max_size)
+    chunks = [m for k, m in sent if k == "MODEL-CHUNK"]
+    assert sent[-1] == ("MODEL-REF", ref)
+    assert len(chunks) > 1  # the scenario needs a real chunk train
+    return chunks
+
+
+def _fresh_relay(tmp_path, shared: bool):
+    from oryx_tpu.common.artifact import ArtifactRelay
+
+    r = ArtifactRelay()
+    r._cache_root = tmp_path  # all "replicas" share one host cache
+    r.shared_distribution = shared
+    return r
+
+
+def _make_artifact():
+    import numpy as np
+
+    from oryx_tpu.common.artifact import ModelArtifact
+
+    rng = np.random.default_rng(11)
+    art = ModelArtifact(
+        "als",
+        extensions={"features": "4"},
+        tensors={"Y": rng.standard_normal((256, 4), dtype=np.float32)},
+    )
+    return art
+
+
+def test_shared_distribution_amortizes_to_one_decode_per_host(tmp_path):
+    """ISSUE 7 acceptance: a chunked MODEL publish consumed by 3 replicas
+    on one host counts ~1x the artifact bytes under mode=shared — not 3x —
+    because replicas 2 and 3 adopt the first one's cache materialization
+    instead of re-assembling."""
+    from oryx_tpu.common.artifact import ModelArtifact, _distribution_bytes
+
+    serialized = _make_artifact().to_string()
+    ref = str(tmp_path / "models" / "gen-1")
+    chunks = _chunk_messages(serialized, ref)
+    counter = _distribution_bytes()
+    shared0 = counter.value(mode="shared")
+    per0 = counter.value(mode="per-replica")
+
+    relays = [_fresh_relay(tmp_path / "cache", shared=True) for _ in range(3)]
+    for relay in relays:
+        for m in chunks:
+            relay.offer(m)
+        # every replica can serve the model from the shared cache
+        art = ModelArtifact.read(relay.resolve(ref))
+        assert art.tensors["Y"].shape == (256, 4)
+
+    artifact_bytes = len(serialized.encode("utf-8"))
+    assert counter.value(mode="shared") - shared0 == artifact_bytes  # 1x, not 3x
+    assert counter.value(mode="per-replica") - per0 == 0
+
+
+def test_per_replica_distribution_counts_every_decode(tmp_path):
+    from oryx_tpu.common.artifact import _distribution_bytes
+
+    serialized = _make_artifact().to_string()
+    ref = str(tmp_path / "models" / "gen-2")
+    chunks = _chunk_messages(serialized, ref)
+    counter = _distribution_bytes()
+    per0 = counter.value(mode="per-replica")
+    for _ in range(3):
+        relay = _fresh_relay(tmp_path / "cache", shared=False)
+        for m in chunks:
+            relay.offer(m)
+    artifact_bytes = len(serialized.encode("utf-8"))
+    assert counter.value(mode="per-replica") - per0 == 3 * artifact_bytes
+
+
+def test_shared_distribution_survives_republished_content(tmp_path):
+    """A republish of the SAME ref with different bytes (new sha) must not
+    be satisfied from the stale cache — the new stream re-assembles."""
+    from oryx_tpu.common.artifact import ModelArtifact
+
+    ref = str(tmp_path / "models" / "gen-3")
+    first = _make_artifact()
+    chunks1 = _chunk_messages(first.to_string(), ref)
+    second = _make_artifact()
+    second.extensions["features"] = "9"  # different bytes, same ref
+    chunks2 = _chunk_messages(second.to_string(), ref)
+
+    r1 = _fresh_relay(tmp_path / "cache", shared=True)
+    for m in chunks1:
+        r1.offer(m)
+    r2 = _fresh_relay(tmp_path / "cache", shared=True)
+    for m in chunks2:
+        r2.offer(m)
+    art = ModelArtifact.read(r2.resolve(ref))
+    assert art.get_extension("features") == "9"
+
+
+# ---- replica-tagged health (ISSUE 7 satellite) ----------------------------
+
+
+class _NoModelManager:
+    def __init__(self, config=None):
+        self.config = config
+
+    def consume(self, it):
+        pass
+
+    def get_model(self):
+        return None
+
+
+def test_degraded_reasons_name_replica_and_port():
+    from oryx_tpu.serving.app import ServingApp
+
+    cfg = load_config(overlay={"oryx.fleet.replica.id": "r3"})
+    app = ServingApp(cfg, _NoModelManager(cfg), None)
+    app.listen_port = 8103
+    app.model_staleness = lambda: 99.0  # force the degraded condition
+    assert "model-stale@r3:8103" in app.degraded_reasons()
+
+    # outside a fleet the reasons stay bare (pre-PR7 contract unchanged)
+    cfg2 = load_config()
+    app2 = ServingApp(cfg2, _NoModelManager(cfg2), None)
+    app2.model_staleness = lambda: 99.0
+    assert "model-stale" in app2.degraded_reasons()
+
+
+# ---- supervisor overlays --------------------------------------------------
+
+
+def test_replica_overlays_namespace_identity_and_ports():
+    cfg = load_config(
+        overlay={"oryx.id": "prod", "oryx.fleet.data-dir": "/tmp/fx"}
+    )
+    ov = replica_overlays(cfg, n=3, base_port=9100)
+    assert [o["oryx.serving.api.port"] for o in ov] == [9100, 9101, 9102]
+    assert [o["oryx.fleet.replica.id"] for o in ov] == ["r0", "r1", "r2"]
+    assert [o["oryx.id"] for o in ov] == ["prod-r0", "prod-r1", "prod-r2"]
+    dirs = {o["oryx.monitoring.quarantine.dir"] for o in ov}
+    assert len(dirs) == 3  # per-replica dead-letter dirs never interleave
+    for o in ov:
+        assert o["oryx.serving.api.processes"] == 1
+
+
+def test_replica_overlays_reject_empty_fleet():
+    with pytest.raises(ValueError):
+        replica_overlays(load_config(), n=0)
+
+
+def test_supervisor_counts_deaths_not_poll_ticks():
+    """A corpse waiting out its restart backoff must not be re-counted as
+    a fresh fast fail by every supervision tick — crash-loop detection
+    counts DEATHS (regression: two real deaths used to trip
+    max-fast-fails=6 after a few 1s ticks)."""
+    from oryx_tpu.fleet.supervisor import FleetSupervisor
+
+    cfg = load_config(
+        overlay={"oryx.fleet.replicas": 1, "oryx.fleet.base-port": 9300}
+    )
+    sup = FleetSupervisor(cfg)
+
+    class _Dead:
+        returncode = 1
+
+        def poll(self):
+            return 1
+
+    spawns = []
+    sup._spawn = lambda i: spawns.append(i) or _Dead()  # type: ignore[assignment]
+    sup.procs[0] = _Dead()
+    sup._spawned_at[0] = time.monotonic()  # died instantly = fast fail
+
+    sup.poll()  # counts the death, restarts (backoff now pending)
+    assert sup._fast_fails == 1 and len(spawns) == 1
+    # respawned corpse sits through many ticks inside the backoff window:
+    # its death is counted ONCE, and no further restarts fire early
+    for _ in range(20):
+        sup.poll()
+    assert sup._fast_fails == 2
+    assert len(spawns) == 1
+    assert not sup.crash_looping

@@ -1,0 +1,30 @@
+"""Fleet-scale chaos (ISSUE 7 satellite): the PR 5 chaos discipline
+extended to multi-PROCESS topology. The scenario itself lives in
+tools/chaos.py (`fleet-kill`) so the CLI chaos driver and this tier-1
+smoke run the SAME code: two real serving replica processes behind the
+in-process fleet front, an update storm on the bus, SIGKILL one replica
+mid-storm — the front must keep answering (zero non-shed 5xx, zero
+client-level errors), eject the corpse, and the survivor's
+oryx_model_staleness_seconds must stay under the configured bound."""
+
+from __future__ import annotations
+
+import importlib.util
+from pathlib import Path
+
+
+def _chaos_module():
+    root = Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "chaos", root / "tools" / "chaos.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fleet_kill_zero_non_shed_5xx_and_bounded_staleness(tmp_path):
+    chaos = _chaos_module()
+    doc, fn = chaos.SCENARIOS["fleet-kill"]
+    problems = fn(str(tmp_path))
+    assert problems == []

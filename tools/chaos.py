@@ -22,9 +22,11 @@ Exit status 0 = every scenario's contract held; 1 otherwise.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import tempfile
+import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
@@ -258,6 +260,222 @@ def batcher_overload(tmp: str) -> list[str]:
                 problems.append(f"shed lacks Retry-After: {e.headers}")
     finally:
         b._closed = True
+    return problems
+
+
+def _fleet_model_message(gen: int):
+    """A small publishable ALS artifact (fresh factors per generation so
+    the storm is real model churn, not republished bytes)."""
+    import numpy as np
+
+    from oryx_tpu.common.artifact import ModelArtifact
+
+    rng = np.random.default_rng(gen)
+    n_users, n_items, f = 32, 64, 4
+    art = ModelArtifact(
+        "als",
+        extensions={
+            "features": str(f), "lambda": "0.001", "alpha": "1.0",
+            "implicit": "true", "logStrength": "false",
+        },
+        tensors={
+            "X": rng.standard_normal((n_users, f), dtype=np.float32),
+            "Y": rng.standard_normal((n_items, f), dtype=np.float32),
+        },
+    )
+    art.set_extension("XIDs", [f"u{j}" for j in range(n_users)])
+    art.set_extension("YIDs", [f"i{j}" for j in range(n_items)])
+    return art.to_string()
+
+
+@scenario("fleet-kill",
+          "SIGKILL one serving replica mid update-storm behind the fleet "
+          "front; the front must keep answering with zero non-shed 5xx, "
+          "eject the corpse, and the survivor's model staleness must stay "
+          "under the configured bound")
+def fleet_kill(tmp: str) -> list[str]:
+    import http.client
+    import subprocess
+    import threading
+
+    from oryx_tpu.bus.broker import get_broker, topics
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.common.executil import (
+        config_overlay_from_sets,
+        cpu_subprocess_env,
+        free_port_run,
+    )
+    from oryx_tpu.common.freshness import publish_stamp
+    from oryx_tpu.fleet import FleetFront, FleetSupervisor
+
+    bus = f"file://{os.path.join(tmp, 'bus')}"
+    topics.maybe_create(bus, "OryxInput", 1)
+    topics.maybe_create(bus, "OryxUpdate", 1)
+    broker = get_broker(bus)
+
+    def publish_model(gen: int) -> None:
+        broker.send("OryxUpdate", "MODEL", _fleet_model_message(gen))
+        broker.send("OryxUpdate", "TRACE", publish_stamp(generation=gen))
+
+    publish_model(1)
+
+    staleness_bound = 120.0
+    base_port = free_port_run(2)
+    sets = [
+        "oryx.id=chaos-fleet",
+        f"oryx.input-topic.broker={bus}",
+        f"oryx.update-topic.broker={bus}",
+        "oryx.serving.model-manager-class="
+        "oryx_tpu.apps.als.serving.ALSServingModelManager",
+        'oryx.serving.application-resources='
+        '["oryx_tpu.serving.resources.common",'
+        '"oryx_tpu.serving.resources.als"]',
+        "oryx.serving.api.read-only=true",
+        "oryx.serving.api.loops=1",
+        f"oryx.serving.api.max-staleness-sec={staleness_bound}",
+        "oryx.fleet.replicas=2",
+        f"oryx.fleet.base-port={base_port}",
+        f"oryx.fleet.data-dir={os.path.join(tmp, 'fleet')}",
+        # the kill must STICK for the scenario's window: no auto-restart
+        "oryx.fleet.supervisor.restart=false",
+        # fast ejection so the 5-second storm window sees it
+        "oryx.fleet.front.probe-interval-sec=0.2",
+        "oryx.fleet.front.eject-after=1",
+    ]
+
+    cfg = load_config(overlay=config_overlay_from_sets(sets))
+    argv = [x for s in sets for x in ("--set", s)]
+    problems: list[str] = []
+    sup = FleetSupervisor(
+        cfg, argv=argv, env=cpu_subprocess_env(),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    front = None
+    stop = threading.Event()
+    counts = {"ok": 0, "shed": 0, "non_shed_5xx": 0, "other": 0,
+              "client_error": 0, "ok_after_kill": 0}
+    killed = threading.Event()
+    lock = threading.Lock()
+
+    def driver(front_port: int) -> None:
+        conn = None
+        j = 0
+        while not stop.is_set():
+            if conn is None:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", front_port, timeout=30
+                )
+            try:
+                conn.request("GET", f"/recommend/u{j % 32}?howMany=3")
+                r = conn.getresponse()
+                retry_after = r.getheader("Retry-After")
+                r.read()
+                with lock:
+                    if r.status == 200:
+                        counts["ok"] += 1
+                        if killed.is_set():
+                            counts["ok_after_kill"] += 1
+                    elif r.status == 503 and retry_after:
+                        counts["shed"] += 1  # deliberate, not a failure
+                    elif r.status >= 500:
+                        counts["non_shed_5xx"] += 1
+                    else:
+                        counts["other"] += 1
+            except Exception:
+                # the FRONT itself refused/was unreachable — the fleet
+                # contract broke (replica failures must be absorbed)
+                with lock:
+                    counts["client_error"] += 1
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                conn = None
+            j += 1
+
+    def storm() -> None:
+        gen = 2
+        while not stop.is_set():
+            publish_model(gen)
+            gen += 1
+            stop.wait(0.2)
+
+    try:
+        sup.start()
+        sup.wait_listening(90)
+        # both replicas model-ready before the storm starts
+        for _, host, port in sup.backends():
+            deadline = time.time() + 60
+            while True:
+                c = http.client.HTTPConnection(host, port, timeout=5)
+                c.request("GET", "/ready")
+                r = c.getresponse()
+                r.read()
+                c.close()
+                if r.status == 200:
+                    break
+                if time.time() > deadline:
+                    raise RuntimeError(f"replica :{port} never became ready")
+                time.sleep(0.3)
+        front = FleetFront(cfg, backends=sup.backends(), port=0)
+        front.start()
+        threads = [
+            threading.Thread(target=driver, args=(front.port,))
+            for _ in range(2)
+        ] + [threading.Thread(target=storm)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        sup.kill(0)  # SIGKILL mid-storm
+        killed.set()
+        time.sleep(4.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        if counts["non_shed_5xx"]:
+            problems.append(
+                f"{counts['non_shed_5xx']} non-shed 5xx reached the front's "
+                f"clients (counts={counts})"
+            )
+        if counts["client_error"]:
+            problems.append(
+                f"{counts['client_error']} client-level errors talking to "
+                f"the front (counts={counts})"
+            )
+        if counts["ok_after_kill"] < 10:
+            problems.append(
+                f"only {counts['ok_after_kill']} successes after the kill "
+                "— the survivor never took the traffic"
+            )
+        dead = next(r for r in front.replicas if r.id == "r0")
+        alive = next(r for r in front.replicas if r.id == "r1")
+        if dead.routable:
+            problems.append("killed replica r0 was never ejected")
+        if not alive.routable:
+            problems.append("survivor r1 lost routability")
+        # survivor freshness: it kept consuming the storm, so its model
+        # age must sit under the degraded bound (and /healthz stays 200)
+        c = http.client.HTTPConnection("127.0.0.1", sup.ports()[1], timeout=5)
+        c.request("GET", "/healthz")
+        r = c.getresponse()
+        body = json.loads(r.read())
+        c.close()
+        stale = body.get("staleness_seconds")
+        if r.status != 200:
+            problems.append(
+                f"survivor /healthz is {r.status} ({body.get('degraded')})"
+            )
+        if not isinstance(stale, (int, float)) or stale >= staleness_bound:
+            problems.append(
+                f"survivor staleness {stale!r} not under the "
+                f"{staleness_bound:.0f}s bound"
+            )
+    finally:
+        stop.set()
+        if front is not None:
+            front.close()
+        sup.stop()
     return problems
 
 

@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Fleet load harness: drive a running fleet front, report both sides.
+
+`cli loadtest` measures one serving process; this harness measures the
+FLEET — it generates the recommend traffic itself (many distinct users,
+so consistent-hash placement actually spreads), drives the front with
+closed-loop workers, and then reads the front's own books: per-replica
+request distribution, retries (shed / connect), ejections, generation
+skew, and each replica's probe snapshot from ``/fleet/status``. A
+deliberate shed (503 + Retry-After surfacing after every replica shed)
+is counted separately from real errors, per the PR 5 contract.
+
+    python -m oryx_tpu.cli fleet --conf oryx.conf --replicas 2 &
+    python tools/fleetload.py --url http://localhost:8090 --duration 20
+
+Prints ONE JSON report line. Exit status 1 when any non-shed error was
+observed (the fleet contract: a healthy fleet behind the front serves
+every request or sheds it honestly).
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import re
+import sys
+import threading
+import time
+from urllib.parse import urlsplit
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _scrape(host: str, port: int, path: str) -> tuple[int, str]:
+    conn = http.client.HTTPConnection(host, port, timeout=10)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        return r.status, r.read().decode("utf-8", "replace")
+    finally:
+        conn.close()
+
+
+def _front_books(host: str, port: int) -> dict:
+    """The front's own view of the run: /fleet/status + the
+    oryx_fleet_* families off its /metrics."""
+    out: dict = {}
+    try:
+        status, body = _scrape(host, port, "/fleet/status")
+        if status == 200:
+            out.update(json.loads(body))
+    except Exception as e:  # noqa: BLE001 - report what we can
+        out["status_error"] = f"{type(e).__name__}: {e}"
+    try:
+        _, text = _scrape(host, port, "/metrics")
+        by_replica: dict[str, float] = {}
+        retries: dict[str, float] = {}
+        ejections: dict[str, float] = {}
+        for line in text.splitlines():
+            m = re.match(
+                r'oryx_fleet_front_requests_total\{replica="([^"]+)"\} (\S+)',
+                line,
+            )
+            if m:
+                by_replica[m.group(1)] = float(m.group(2))
+                continue
+            m = re.match(
+                r'oryx_fleet_front_retries_total\{reason="([^"]+)"\} (\S+)',
+                line,
+            )
+            if m:
+                retries[m.group(1)] = float(m.group(2))
+                continue
+            m = re.match(
+                r'oryx_fleet_ejections_total\{replica="([^"]+)"\} (\S+)', line
+            )
+            if m:
+                ejections[m.group(1)] = float(m.group(2))
+                continue
+            if line.startswith("oryx_fleet_generation_skew "):
+                out["generation_skew"] = float(line.split()[1])
+        if by_replica:
+            out["requests_by_replica"] = {
+                k: int(v) for k, v in sorted(by_replica.items())
+            }
+        if retries:
+            out["retries"] = {k: int(v) for k, v in sorted(retries.items())}
+        if ejections:
+            out["ejections"] = {k: int(v) for k, v in sorted(ejections.items())}
+    except Exception as e:  # noqa: BLE001
+        out["metrics_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--url", default="http://localhost:8090",
+        help="base URL of a running fleet front (default the front's "
+        "default port)",
+    )
+    ap.add_argument("--duration", type=float, default=20.0)
+    ap.add_argument(
+        "--workers", type=int, default=16,
+        help="concurrent closed-loop client connections",
+    )
+    ap.add_argument(
+        "--users", type=int, default=10_000,
+        help="distinct user ids in the generated /recommend traffic "
+        "(hash placement needs many to spread)",
+    )
+    ap.add_argument("--how-many", type=int, default=10)
+    args = ap.parse_args()
+
+    split = urlsplit(args.url if "//" in args.url else f"http://{args.url}")
+    host, port = split.hostname or "localhost", split.port or 8090
+    n_workers = max(1, args.workers)
+
+    ok = [0] * n_workers
+    shed = [0] * n_workers
+    errors = [0] * n_workers
+    lat_ms: list[list[float]] = [[] for _ in range(n_workers)]
+    t_end = time.perf_counter() + args.duration
+
+    def worker(w: int) -> None:
+        conn: http.client.HTTPConnection | None = None
+        j = w
+        while time.perf_counter() < t_end:
+            if conn is None:
+                conn = http.client.HTTPConnection(host, port, timeout=60)
+            path = f"/recommend/u{j % args.users}?howMany={args.how_many}"
+            j += n_workers
+            t0 = time.perf_counter()
+            try:
+                conn.request("GET", path)
+                r = conn.getresponse()
+                retry_after = r.getheader("Retry-After")
+                r.read()
+                if r.status == 200:
+                    ok[w] += 1
+                    lat_ms[w].append((time.perf_counter() - t0) * 1000)
+                elif r.status == 503 and retry_after:
+                    # the whole fleet shed: honest backpressure, honor it
+                    shed[w] += 1
+                    time.sleep(min(2.0, float(retry_after)))
+                else:
+                    errors[w] += 1
+            except Exception:
+                errors[w] += 1
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                conn = None
+        if conn is not None:
+            conn.close()
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=worker, args=(w,)) for w in range(n_workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+
+    lats = sorted(x for ws in lat_ms for x in ws)
+    n_ok, n_shed, n_err = sum(ok), sum(shed), sum(errors)
+    pct = lambda p: (
+        round(lats[min(len(lats) - 1, int(p / 100 * len(lats)))], 2)
+        if lats
+        else None
+    )
+    report = {
+        "requests": n_ok,
+        "shed_503": n_shed,
+        "errors": n_err,
+        "seconds": round(dt, 2),
+        "qps": round(n_ok / dt, 1) if dt else 0.0,
+        "latency_ms": {"p50": pct(50), "p90": pct(90), "p99": pct(99)},
+        "workers": n_workers,
+        "users": args.users,
+        "front": _front_books(host, port),
+    }
+    print(json.dumps(report))
+    # contract: behind a healthy front every request is answered or
+    # honestly shed — any residual error is a finding
+    return 1 if n_err else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

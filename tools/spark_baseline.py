@@ -23,6 +23,14 @@ Prints ONE JSON line:
     {"metric": "spark_mllib_als_build_seconds", "value": N, ...}
 Feed that value to bench.py via ORYX_SPARK_BASELINE_S=<N> to populate
 speedup_vs_mllib in the bench artifact.
+
+When pyspark is NOT importable the runner no longer dies with a bare
+error: it emits a machine-readable SKIPPED artifact (status="skipped",
+value=null) carrying the ANALYTIC bound it falls back to — the same
+bound bench.py attaches as `spark_baseline_bound` — so downstream
+consumers see exactly what denominator stands in and that any
+`speedup_vs_mllib` derived from it is basis="analytic", never mistaken
+for a measured number (ROADMAP item 5's credibility gap).
 """
 
 from __future__ import annotations
@@ -35,6 +43,79 @@ import time
 
 HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, HERE)
+
+
+def analytic_bound(
+    nnz: int | None,
+    features: int = 50,
+    iterations: int = 10,
+    build_s: float | None = None,
+) -> dict:
+    """The explicitly-labeled stand-in denominator when no measured
+    Spark wall-clock is reachable (single source of truth — bench.py's
+    `spark_baseline_bound` and this runner's SKIPPED artifact both come
+    from here). Two bounds, both honest about what they are:
+
+    - an analytic compute floor: the normal-equation FLOPs the
+      reference's exact algorithm must perform, at a deliberately
+      over-generous 200 GFLOP/s sustained for its 32-core Haswell +
+      netlib BLAS, ignoring every shuffle/JVM/scheduling cost. The true
+      MLlib wall-clock cannot be below this, so speedup >= floor/build.
+    - a literature anchor: publicly reported Spark-MLlib ALS builds at
+      ML-20M/25M scale (rank 10-50, ~10 iterations, multi-node
+      clusters) land in the minutes range; recorded as [300, 1800] s
+      per 25M interactions and scaled linearly in nnz. An anchor, NOT a
+      measurement — labeled as such.
+    """
+    bound: dict = {
+        "command": "python tools/spark_baseline.py --interactions <nnz> "
+        "# on a pyspark-capable host; feed the result back via "
+        "ORYX_SPARK_BASELINE_S / ORYX_SPARK_BASELINE_INTERACTIONS",
+    }
+    if nnz:
+        floor_flops = (
+            iterations * 2.0 * nnz * (2.0 * features**2 + 2.0 * features)
+        )
+        floor_s = floor_flops / 200e9
+        anchor = [round(300.0 * nnz / 25e6, 1), round(1800.0 * nnz / 25e6, 1)]
+        bound.update(
+            {
+                "analytic_floor_seconds": round(floor_s, 1),
+                "analytic_floor_basis": "pure normal-equation FLOPs at an "
+                "optimistic 200 GFLOP/s sustained f64 on the reference's "
+                "32-core Haswell; ignores all shuffle/JVM/scheduling cost",
+                "literature_anchor_seconds": anchor,
+                "literature_anchor_basis": "publicly reported MLlib ALS "
+                "wall-clocks at ML-20M/25M scale, scaled linearly in "
+                "interactions; an anchor, not a measurement",
+            }
+        )
+        if build_s:
+            bound["speedup_vs_mllib_floor"] = round(floor_s / build_s, 2)
+            bound["speedup_vs_mllib_anchor_range"] = [
+                round(anchor[0] / build_s, 1), round(anchor[1] / build_s, 1),
+            ]
+    return bound
+
+
+def skipped_artifact(
+    reason: str, nnz: int, features: int, iterations: int
+) -> dict:
+    """Machine-readable SKIPPED artifact: same metric name and shape a
+    successful run prints, value=null, plus the analytic bound that
+    stands in for the measurement."""
+    return {
+        "metric": "spark_mllib_als_build_seconds",
+        "value": None,
+        "unit": "s",
+        "status": "skipped",
+        "reason": reason,
+        "basis": "analytic",
+        "interactions": nnz,
+        "features": features,
+        "iterations": iterations,
+        "analytic_bound": analytic_bound(nnz, features, iterations),
+    }
 
 
 def main() -> int:
@@ -58,18 +139,19 @@ def main() -> int:
         from pyspark import SparkConf, SparkContext
         from pyspark.mllib.recommendation import ALS, Rating
     except ImportError:
+        # SKIPPED is an artifact, not an error: rc 0 with status="skipped"
+        # and the analytic fallback bound, so automation consuming this
+        # runner gets a parseable denominator story instead of a dead end
         print(
             json.dumps(
-                {
-                    "metric": "spark_mllib_als_build_seconds",
-                    "value": None,
-                    "unit": "s",
-                    "error": "pyspark not installed on this host "
+                skipped_artifact(
+                    "pyspark not installed on this host "
                     "(pip install pyspark, then rerun)",
-                }
+                    args.interactions, args.features, args.iterations,
+                )
             )
         )
-        return 2
+        return 0
 
     from oryx_tpu.ml.synth import synthesize_interactions
 
@@ -129,6 +211,8 @@ def main() -> int:
                 "metric": "spark_mllib_als_build_seconds",
                 "value": round(build_s, 1),
                 "unit": "s",
+                "status": "measured",
+                "basis": "measured",
                 "interactions": args.interactions,
                 "features": args.features,
                 "iterations": args.iterations,
