@@ -42,6 +42,18 @@ _LSH_REFRESH_SEC = 1.0
 # storm (no queries observing the drift) can stay un-synced.
 _RESYNC_POLL_S = 0.05
 
+# Serving score modes (oryx.serving.api.score-mode): how the device view
+# scores the catalog. "exact" = bf16 scan + f32 candidate re-rank;
+# "quantized" = int8 rows + per-row scales (half the HBM stream) with the
+# same exact f32 re-rank of survivors; "approx" = on-device partial
+# reduce (jax.lax.approx_max_k) at a recall target. The quality gate
+# (ml/quality.py) holds quantized/approx recall@k >= 0.95 against exact.
+SCORE_MODES = ("exact", "quantized", "approx")
+
+# Recall target score-mode=approx uses when oryx.als.approx-recall is
+# left at its exact default.
+DEFAULT_APPROX_RECALL = 0.95
+
 
 @dataclass
 class SyncConfig:
@@ -208,12 +220,28 @@ class ALSServingModel(ServingModel):
         approx_recall: float = 1.0,
         lsh_max_bits_differing: int | None = None,
         sync: SyncConfig | None = None,
+        score_mode: str = "exact",
     ):
         self.state = state
         # < 1.0: serve via the on-device approximate top-k (the TPU
         # replacement for the reference's LSH sampling); the exact f32
         # re-rank still runs over the returned candidates
         self.approx_recall = approx_recall
+        if score_mode not in SCORE_MODES:
+            raise ValueError(
+                f"score_mode must be one of {SCORE_MODES}, got {score_mode!r}"
+            )
+        if score_mode == "exact" and approx_recall < 1.0:
+            # the legacy knob: oryx.als.approx-recall < 1 meant
+            # approximate device selection before score-mode existed, and
+            # must keep meaning it for configs that never set score-mode
+            score_mode = "approx"
+        self.score_mode = score_mode
+        # the mode the device view ACTUALLY serves: _build_views_full may
+        # downgrade quantized -> exact past the chunking threshold, and
+        # dispatch labels/metrics must report what ran, not what the
+        # config asked for
+        self._effective_mode = score_mode
         self.sync = sync or SyncConfig()
         # (device matrix [capacity,K], ids [n], version, host f32 mirror
         # [capacity,K]) swapped as ONE tuple: readers always see a matched
@@ -257,6 +285,19 @@ class ALSServingModel(ServingModel):
         a MODEL update replaces the serving model)."""
         self._stop.set()
         self._resync_evt.set()
+
+    def effective_recall(self) -> float:
+        """The recall target this model's device dispatches carry: 1.0
+        (exact selection) outside approx mode; in approx mode the
+        configured oryx.als.approx-recall, or DEFAULT_APPROX_RECALL when
+        that knob was left at its exact 1.0 default."""
+        if self.score_mode != "approx":
+            return 1.0
+        return (
+            self.approx_recall
+            if self.approx_recall < 1.0
+            else DEFAULT_APPROX_RECALL
+        )
 
     def served_version(self) -> int | None:
         """Store version of the currently SERVED device view (None before
@@ -429,7 +470,7 @@ class ALSServingModel(ServingModel):
     def _build_unit_view(self, y, ids, version, host_mat) -> tuple:
         """Normalize the device view into the cosine-scoring unit view +
         cached host norms. Call under _sync_lock."""
-        from oryx_tpu.ops.transfer import ChunkedMatrix
+        from oryx_tpu.ops.transfer import ChunkedMatrix, QuantizedMatrix
 
         def normalize(a):
             af = a.astype(jnp.float32)
@@ -439,8 +480,15 @@ class ALSServingModel(ServingModel):
         # row normalization is row-local, so a chunked view normalizes
         # per chunk and stays chunked; capacity padding rows are zero and
         # normalize to zero (they never reach callers: _post drops
-        # out-of-range indices)
-        unit = y.map(normalize) if isinstance(y, ChunkedMatrix) else normalize(y)
+        # out-of-range indices). A quantized view normalizes by SCALE
+        # alone (unit(q·s) = q/||q||) and shares the int8 rows — the
+        # cosine view costs no second item matrix in HBM.
+        if isinstance(y, QuantizedMatrix):
+            unit = y.unit_scaled()
+        elif isinstance(y, ChunkedMatrix):
+            unit = y.map(normalize)
+        else:
+            unit = normalize(y)
         # host row norms cached per version too: the wedged-device cosine
         # fallback must not pay an O(N.K) norm pass per request
         host_norms = np.linalg.norm(host_mat, axis=1)
@@ -455,13 +503,28 @@ class ALSServingModel(ServingModel):
         exhausted, arena compaction). Call under _sync_lock."""
         from oryx_tpu.ops.transfer import (
             CHUNKED_OVER_BYTES, ChunkedMatrix, device_put_maybe_chunked,
-            row_capacity,
+            quantized_device_put, row_capacity,
         )
 
         t0 = time.monotonic()
         mat, ids, version = self.state.y.snapshot()
         mat = np.asarray(mat, dtype=np.float32)
         n = len(ids)
+        # int8 quantized views stream 1 byte/element; exact bf16 views 2
+        quantize = self.score_mode == "quantized"
+        if quantize and n * self.state.features > CHUNKED_OVER_BYTES:
+            # no chunked quantized form: a model this size serves exact
+            # bf16 chunks instead of silently quantizing half the catalog
+            log.warning(
+                "score-mode=quantized needs a single-program view; %d x %d "
+                "exceeds the chunking threshold — serving exact instead",
+                n, self.state.features,
+            )
+            quantize = False
+        if self.score_mode == "quantized":
+            # label dispatches with the mode actually served (see __init__)
+            self._effective_mode = "quantized" if quantize else "exact"
+        itemsize = 1 if quantize else 2
         # capacity-padded rows: store growth within the headroom scatters
         # into existing rows — no realloc, no new batcher dispatch shape.
         # Oversized (chunked) models skip the padding: their chunks are
@@ -471,31 +534,41 @@ class ALSServingModel(ServingModel):
         cap = n
         if self.sync.mode != "blocking":
             cap = row_capacity(n, self.sync.capacity_headroom)
-            if cap * self.state.features * 2 > CHUNKED_OVER_BYTES:
+            if cap * self.state.features * itemsize > CHUNKED_OVER_BYTES:
                 cap = n
         if cap > n:
             host = np.zeros((cap, self.state.features), dtype=np.float32)
             host[:n] = mat
         else:
             host = mat
-        # bf16 scoring view: halves the HBM traffic of the memory-bound
-        # top-k scan. Scores accumulate in f32 on the MXU; at 1M x 50f
-        # the bf16 ranking matched f32 index-for-index (pallas_topk.py).
-        # The f32 host matrix rides along for the exact candidate
-        # re-rank — row-aligned with the device view by construction,
-        # read lock-free on the request path. Oversized models come back
-        # as a ChunkedMatrix: a single (20M, 250)-class operand's program
-        # is too large to compile (ops/transfer.py); the batcher scores
-        # it chunk-and-merge.
-        y_dev = device_put_maybe_chunked(host, dtype=jnp.bfloat16)
+        # Device scoring view by score mode. exact: bf16 — halves the HBM
+        # traffic of the memory-bound top-k scan vs f32; at 1M x 50f the
+        # bf16 ranking matched f32 index-for-index (pallas_topk.py).
+        # quantized: int8 rows + per-row f32 scales — halves bf16's
+        # stream again; selection error is bounded by the per-row scale
+        # step. Either way the f32 host matrix rides along for the exact
+        # candidate re-rank — row-aligned with the device view by
+        # construction, read lock-free on the request path. Oversized
+        # models come back as a ChunkedMatrix: a single (20M, 250)-class
+        # operand's program is too large to compile (ops/transfer.py);
+        # the batcher scores it chunk-and-merge.
+        if quantize:
+            y_dev = quantized_device_put(host)
+        else:
+            y_dev = device_put_maybe_chunked(host, dtype=jnp.bfloat16)
         view = (y_dev, ids, version, host)
         self._device_view = view
         if self._unit_view is not None:
             self._build_unit_view(y_dev, ids, version, host)
         dur = time.monotonic() - t0
-        # the unit view normalizes ON device from the fresh upload, so a
-        # full resync moves exactly one bf16 matrix across the host link
-        self._note_resync("full", n, cap * self.state.features * 2, dur, version)
+        # the unit view normalizes ON device from the fresh upload (the
+        # quantized unit view is scale-only and shares the int8 rows), so
+        # a full resync moves exactly one scoring matrix across the host
+        # link — plus the per-row scales when quantized
+        sync_bytes = cap * self.state.features * itemsize + (
+            cap * 4 if quantize else 0
+        )
+        self._note_resync("full", n, sync_bytes, dur, version)
         return view
 
     # -- background resync --------------------------------------------------
@@ -604,8 +677,14 @@ class ALSServingModel(ServingModel):
     def _try_apply_delta(self, dv: tuple) -> bool:
         """Apply a dirty-row delta to the device/host/unit views. Returns
         False when only a full rebuild can serve (drift overflow, growth
-        past capacity, arena compaction). Call under _sync_lock."""
-        from oryx_tpu.ops.transfer import scatter_rows, scatter_transfer_bytes
+        past capacity, arena compaction). Call under _sync_lock. A
+        quantized view re-quantizes ONLY the dirty rows inside
+        scatter_rows (per-row scales are independent) — an update storm
+        never triggers a full-matrix requantization."""
+        from oryx_tpu.ops.transfer import (
+            QuantizedMatrix, quantize_rows_int8, quantized_scatter_bytes,
+            scatter_rows, scatter_transfer_bytes,
+        )
 
         t0 = time.monotonic()
         y_dev, ids, _version, host_mat = dv
@@ -651,14 +730,47 @@ class ALSServingModel(ServingModel):
         # the old view tuple stays fully consistent until the swap below,
         # at a transient cost of one extra matrix in HBM. Host->device
         # traffic is the bucket-padded delta rows either way.
-        y_new = scatter_rows(y_dev, rows, mat_rows)
+        quantized = isinstance(y_dev, QuantizedMatrix)
+        if quantized:
+            # quantize the dirty rows ONCE here (per-row scales are
+            # independent — never a full requantization) so the unit view
+            # below can keep SHARING the device view's int8 rows
+            q_rows, s_rows = quantize_rows_int8(mat_rows)
+            y_new = QuantizedMatrix(
+                scatter_rows(y_dev.q, rows, q_rows),
+                scatter_rows(y_dev.scale, rows, s_rows),
+            )
+        else:
+            y_new = scatter_rows(y_dev, rows, mat_rows)
         self._device_view = (y_new, ids, delta.version, host_mat)
-        n_bytes = scatter_transfer_bytes(rows.size, 2, self.state.features)
+
+        def _delta_bytes() -> int:
+            if quantized:
+                return quantized_scatter_bytes(rows.size, self.state.features)
+            return scatter_transfer_bytes(rows.size, 2, self.state.features)
+
+        n_bytes = _delta_bytes()
         if uv is not None:
-            unit_rows = mat_rows / np.maximum(norms, 1e-12)[:, None]
-            unit_new = scatter_rows(uv[0], rows, unit_rows)
+            if quantized and isinstance(uv[0], QuantizedMatrix):
+                # the quantized unit view is (shared int8 rows, scale =
+                # 1/||q_row||): adopt the device view's freshly scattered
+                # q and scatter ONLY the dirty rows' unit scales — the
+                # two views keep sharing one int8 matrix in HBM across
+                # every delta, and the unit half of the sync moves 8
+                # bytes/row instead of a second row scatter
+                qn = np.linalg.norm(q_rows.astype(np.float32), axis=1)
+                unit_scales = np.where(
+                    qn > 0, 1.0 / np.maximum(qn, 1e-12), 0.0
+                ).astype(np.float32)
+                unit_new = QuantizedMatrix(
+                    y_new.q, scatter_rows(uv[0].scale, rows, unit_scales)
+                )
+                n_bytes += scatter_transfer_bytes(rows.size, 4, 1)
+            else:
+                unit_rows = mat_rows / np.maximum(norms, 1e-12)[:, None]
+                unit_new = scatter_rows(uv[0], rows, unit_rows)
+                n_bytes += _delta_bytes()
             self._unit_view = (unit_new, ids, delta.version, host_mat, uv[4])
-            n_bytes += scatter_transfer_bytes(rows.size, 2, self.state.features)
         self._note_resync(
             "delta", int(rows.size), n_bytes,
             time.monotonic() - t0, delta.version,
@@ -797,8 +909,8 @@ class ALSServingModel(ServingModel):
         # FLOP accounting must not count the padding as scored work.
         fut = TopKBatcher.shared().submit_nowait(
             user_vector, k, y, host_mat=host_mat, cosine=cosine,
-            host_norms=host_norms, recall=self.approx_recall,
-            valid_rows=n,
+            host_norms=host_norms, recall=self.effective_recall(),
+            valid_rows=n, score_mode=self._effective_mode,
         )
 
         def _post(result):
@@ -1018,6 +1130,18 @@ class ALSServingModelManager(AbstractServingModelManager):
         super().__init__(config)
         self.als = ALSConfig.from_config(config)
         self.sync = SyncConfig.from_config(config)
+        # first-class serving score mode (exact | quantized | approx).
+        # Validated here so a typo fails at startup, not on the first
+        # /recommend; the model itself still promotes exact -> approx
+        # when the legacy oryx.als.approx-recall knob is < 1.
+        self.score_mode = str(
+            config.get("oryx.serving.api.score-mode", "exact")
+        )
+        if self.score_mode not in SCORE_MODES:
+            raise ValueError(
+                "oryx.serving.api.score-mode must be one of "
+                f"{SCORE_MODES}, got {self.score_mode!r}"
+            )
         self.model: ALSServingModel | None = None
         self._rescorer_provider = _load_rescorer_provider(config)
         configure_post_pool(
@@ -1041,6 +1165,7 @@ class ALSServingModelManager(AbstractServingModelManager):
                 num_cores=(self.als.candidate_partitions or None),
                 lsh_max_bits_differing=self.als.lsh_max_bits_differing,
                 sync=self.sync,
+                score_mode=self.score_mode,
             )
             if old is not None:
                 old.close()  # stop the replaced model's resync thread

@@ -75,7 +75,7 @@ class DispatchRecord:
     __slots__ = (
         "kind", "t_start", "wall_s", "flops", "bytes_moved",
         "rows", "padded_rows", "valid_rows", "capacity_rows",
-        "occupancy", "trace_id", "seq",
+        "occupancy", "trace_id", "score_mode", "seq",
     )
 
     def __init__(
@@ -90,6 +90,7 @@ class DispatchRecord:
         valid_rows: int,
         capacity_rows: int,
         trace_id: str | None,
+        score_mode: str | None = None,
     ):
         self.kind = kind
         self.t_start = t_start
@@ -106,6 +107,9 @@ class DispatchRecord:
             min(1.0, valid_rows / capacity_rows) if capacity_rows > 0 else 1.0
         )
         self.trace_id = trace_id
+        # serving score mode (exact | quantized | approx) when the
+        # dispatcher labels it; None for unlabeled kinds (train)
+        self.score_mode = score_mode
         self.seq = -1
 
     def chrome_event(self, pid: int) -> dict:
@@ -127,6 +131,7 @@ class DispatchRecord:
                 "capacity_rows": self.capacity_rows,
                 "occupancy": round(self.occupancy, 4),
                 "trace_id": self.trace_id or "",
+                "score_mode": self.score_mode or "",
             },
         }
 
@@ -207,6 +212,14 @@ class PerfStats:
         if self._peak.get(kind, ...) is ...:
             self._peak[kind] = peak
 
+    def set_peak(self, kind: str, peak: float | None) -> None:
+        """Overwrite the kind's peak unconditionally: the batcher resolves
+        a PER-DTYPE peak per dispatch (ops/flops.py tables), so the MFU
+        gauge's denominator follows the dtype actually dispatched — a
+        quantized int8 window reads against the int8 peak, never
+        flattering itself against bf16."""
+        self._peak[kind] = peak
+
     def peak_for(self, kind: str) -> float | None:
         peak = self._peak.get(kind, ...)
         if peak is ... or peak is None:
@@ -228,12 +241,13 @@ class PerfStats:
         capacity_rows: int,
         trace_id: str | None = None,
         t_start: float | None = None,
+        score_mode: str | None = None,
     ) -> DispatchRecord:
         rec = DispatchRecord(
             kind,
             t_start if t_start is not None else time.monotonic() - wall_s,
             wall_s, flops, bytes_moved, rows, padded_rows, valid_rows,
-            capacity_rows, trace_id,
+            capacity_rows, trace_id, score_mode,
         )
         rec.seq = next(self._seq)
         buf = self._buf
@@ -247,6 +261,10 @@ class PerfStats:
         self._h_dispatch.observe(wall_s, trace_id=trace_id, kind=kind)
         self._h_occupancy.observe(rec.occupancy, trace_id=trace_id, kind=kind)
         self._h_bytes.observe(bytes_moved, kind=kind)
+        if score_mode:
+            # per-mode dispatch accounting: dashboards separate exact /
+            # quantized / approx traffic without new histogram families
+            self._c_score_mode.inc(score_mode=score_mode)
         return rec
 
     def note_fallback(self, n: int = 1, kind: str = "serving") -> None:
@@ -431,6 +449,13 @@ class PerfStats:
                 "Host-fallback scoring dispatches after a device error or "
                 "wedge failover; each also zeroes oryx_device_mfu for one "
                 "rolling window",
+            )
+            self._c_score_mode = reg.counter(
+                "oryx_score_mode_dispatches_total",
+                "Device top-k dispatches by serving score mode "
+                "(score_mode = exact | quantized | approx); every "
+                "batcher perfstats record carries the label",
+                labeled=True,
             )
             # re-binding the same closures over the singleton is harmless,
             # and keeps the series alive across registry.clear() in tests

@@ -12,6 +12,11 @@ code, so a silent quality regression in any trainer fails both.
 - k-means: planted Gaussian blobs; SSE against the true generating
   centers plus silhouette (reference eval strategies:
   KMeansUpdate.java:137-173 and the four metric classes).
+- Serving recall gate: the quantized (int8 + exact rescore) and approx
+  (partial-reduce) score modes are measured for recall@k against the
+  exact top-k on a standing synthetic corpus; either mode below
+  MIN_SCORE_MODE_RECALL fails the QUALITY bench — speed can never
+  silently buy wrong answers.
 """
 
 from __future__ import annotations
@@ -20,6 +25,11 @@ import time
 from dataclasses import dataclass, field
 
 import numpy as np
+
+# The recall@k floor quantized/approx serving must hold against exact
+# top-k (the serve path's acceptance bar; enforced by the tier-1 gate in
+# tests/test_quality_gate.py and the nightly QUALITY artifact).
+MIN_SCORE_MODE_RECALL = 0.95
 
 
 @dataclass
@@ -127,6 +137,136 @@ def build_and_evaluate(
         nan_rows=nan_rows,
         interactions=nnz,
         timings=timings,
+    )
+
+
+@dataclass
+class RecallReport:
+    """Measured recall@k of the approximate serving score modes against
+    exact top-k on the standing corpus. green = both modes at/above the
+    floor."""
+
+    recall_quantized: float
+    recall_approx: float
+    k: int
+    n_queries: int
+    n_items: int
+    features: int
+    min_recall: float
+    approx_recall_target: float
+    eval_s: float
+
+    @property
+    def green(self) -> bool:
+        return (
+            self.recall_quantized >= self.min_recall
+            and self.recall_approx >= self.min_recall
+        )
+
+
+def mean_recall_at_k(got_idx: np.ndarray, exact_idx: np.ndarray, k: int) -> float:
+    """Mean per-query |top-k ∩ exact top-k| / k — the ONE recall
+    definition the gate and the bench's measured-recall fields share, so
+    the numbers they report can never drift in meaning."""
+    return float(
+        np.mean([
+            len(set(map(int, g[:k])) & set(map(int, e[:k]))) / k
+            for g, e in zip(got_idx, exact_idx)
+        ])
+    )
+
+
+def evaluate_score_mode_recall(
+    n_items: int = 100_000,
+    features: int = 50,
+    k: int = 10,
+    n_queries: int = 256,
+    seed: int = 23,
+    approx_recall_target: float = 0.95,
+    min_recall: float = MIN_SCORE_MODE_RECALL,
+    overfetch: int | None = None,
+) -> RecallReport:
+    """Measure recall@k of the quantized and approx serving modes against
+    the exact top-k on a standing synthetic corpus (deterministic seed —
+    the same corpus every run, so the number is a gate, not a dice roll).
+
+    Each mode is evaluated the way serving actually runs it
+    (apps/als/serving.py): the device kernel selects an over-fetched
+    candidate set, the candidates are re-ranked EXACTLY in f32, and the
+    top-k of that re-rank is what a client sees. So this measures the
+    mode's end answer, not the raw kernel's. The overfetch defaults to
+    k + 8 — the rescore set a NO-EXCLUSION request actually gets back
+    from the batcher (it slices the dispatch's k-bucket down to the
+    request's own k = how_many + |exclude| + 8 before the rescore), so
+    the gate is never more forgiving than production's weakest case.
+
+    On CPU hosts jax.lax.approx_max_k computes exactly, so the approx row
+    gates the plumbing there and the real recall target on TPU.
+    """
+    import jax.numpy as jnp
+
+    from oryx_tpu.ops.als import (
+        topk_dot_batch_approx, topk_dot_batch_quant_xla, topk_dot_batch_xla,
+    )
+    from oryx_tpu.ops.transfer import quantize_rows_int8
+
+    t0 = time.perf_counter()
+    rng = np.random.default_rng(seed)
+    # factor-model-shaped corpus: low-rank structure plus noise, like a
+    # trained Y — pure iid gaussians under-stress quantization (scores
+    # concentrate), planted structure gives realistic near-ties
+    basis = rng.standard_normal((max(8, features // 4), features))
+    y = (
+        rng.standard_normal((n_items, basis.shape[0])) @ basis
+        + 0.5 * rng.standard_normal((n_items, features))
+    ).astype(np.float32)
+    xs = rng.standard_normal((n_queries, features)).astype(np.float32)
+
+    # the serving over-fetch: exactly the candidate set a no-exclusion
+    # request's exact rescore sees (serving requests k = how_many + 8;
+    # the batcher returns that many rows of its k-bucket dispatch)
+    if overfetch is None:
+        overfetch = min(n_items, k + 8)
+
+    xs_j, y_j = jnp.asarray(xs), jnp.asarray(y)
+    _, exact_idx = topk_dot_batch_xla(xs_j, y_j, k=k)
+    exact_idx = np.asarray(exact_idx)
+
+    def rescored_topk(cand_idx: np.ndarray) -> np.ndarray:
+        """Exact f32 re-rank of each query's candidate rows (the serve
+        path's _rerank_exact), then top-k."""
+        out = np.empty((n_queries, k), dtype=np.int64)
+        for qi in range(n_queries):
+            rows = cand_idx[qi]
+            scores = y[rows] @ xs[qi]
+            order = np.argsort(-scores, kind="stable")[:k]
+            out[qi] = rows[order]
+        return out
+
+    # quantized: int8 + per-row scale selection, exact rescore
+    q, scale = quantize_rows_int8(y)
+    _, q_idx = topk_dot_batch_quant_xla(
+        xs_j, jnp.asarray(q), jnp.asarray(scale), k=overfetch
+    )
+    recall_q = mean_recall_at_k(rescored_topk(np.asarray(q_idx)), exact_idx, k)
+
+    # approx: the REAL partial-reduce serving kernel (ops/als.py) at the
+    # recall target, exact rescore of whatever it returns
+    _, a_idx = topk_dot_batch_approx(
+        xs_j, y_j, k=min(overfetch, n_items), recall=approx_recall_target
+    )
+    recall_a = mean_recall_at_k(rescored_topk(np.asarray(a_idx)), exact_idx, k)
+
+    return RecallReport(
+        recall_quantized=recall_q,
+        recall_approx=recall_a,
+        k=k,
+        n_queries=n_queries,
+        n_items=n_items,
+        features=features,
+        min_recall=min_recall,
+        approx_recall_target=approx_recall_target,
+        eval_s=time.perf_counter() - t0,
     )
 
 

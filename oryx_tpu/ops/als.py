@@ -1651,12 +1651,40 @@ def topk_dot_batch_approx(xs, y, *, k: int, recall: float):
     return jax.lax.approx_max_k(scores, k, recall_target=recall)
 
 
+@partial(jax.jit, static_argnames=("k", "recall"))
+def topk_dot_batch_quant_xla(xs, q, scale, *, k: int, recall: float = 1.0):
+    """Batched top-k over an int8-quantized item matrix (q [I,F] int8,
+    scale [I] f32). Queries quantize per-row exactly like the Pallas
+    int8 kernel (ops/pallas_topk.py quantize_queries), and the dot runs
+    over the quantized values in f32 — int8 x int8 products summed over
+    a lane tile stay < 2^24, so the f32 accumulation is EXACT and this
+    is a bit-faithful reference for the kernel's int32 MXU path. Scales
+    multiply back in the same order the kernel applies them. The XLA
+    reference the Pallas quantized kernel is tested against, and the CPU
+    path for score-mode=quantized; the serving tier's exact f32 re-rank
+    of the returned candidates corrects in-candidate ordering either
+    way."""
+    from oryx_tpu.ops.pallas_topk import quantize_queries
+
+    xq, sx = quantize_queries(xs)
+    scores = jnp.dot(
+        xq.astype(jnp.float32), q.T.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) * scale[None, :] * sx[:, None]
+    if recall < 1.0:
+        return jax.lax.approx_max_k(scores, k, recall_target=recall)
+    return jax.lax.top_k(scores, k)
+
+
 _pallas_failed_shapes: set = set()
 
 # Largest k dispatched to the fused Pallas kernel. The serving
 # micro-batcher derives a k bucket from this so default /recommend
-# overfetch (k=18) stays on the fused path — keep them coupled.
-PALLAS_TOPK_MAX_K = 32
+# overfetch (k=18) stays on the fused path — keep them coupled. The
+# gen-2 bitonic kernel maintains a full 128-lane running top-k whatever
+# the k, so the bound is the lane tile itself (the gen-1 argmax-round
+# kernel capped out at 32, pushing the 128 bucket to the XLA fallback).
+PALLAS_TOPK_MAX_K = 128
 
 
 def topk_dot_batch_chunked(xs, y_chunks, *, k: int, recall: float = 1.0):
@@ -1698,17 +1726,42 @@ def topk_dot_batch_chunked(xs, y_chunks, *, k: int, recall: float = 1.0):
 def topk_dot_batch(xs, y, *, k: int, recall: float = 1.0):
     """Batched top-k scoring with automatic kernel selection: recall < 1
     takes the approximate partial-reduce; exact requests take the fused
-    streaming Pallas kernel on TPU (measured 1.94x over matmul+top_k at
-    4096 queries x 1M items x 50 features bf16 on v5e, with exact index
-    agreement, and it never materializes the [B,I] scores), plain XLA
-    elsewhere. A ChunkedMatrix (oversized model, ops/transfer.py) routes
-    through the chunk-and-merge form. A kernel failure only disables
-    that exact (shapes, k) signature — standard serving shapes keep the
-    fast path."""
-    from oryx_tpu.ops.transfer import ChunkedMatrix
+    streaming Pallas kernel on TPU (gen-2 bitonic-merge kernel,
+    ops/pallas_topk.py — exact index agreement with lax.top_k up to
+    k=128, never materializes the [B,I] scores), plain XLA elsewhere. A
+    QuantizedMatrix (int8 rows + per-row scales, score-mode=quantized)
+    dispatches the quantized kernel on TPU and the dequantize-and-dot XLA
+    form elsewhere; a ChunkedMatrix (oversized model, ops/transfer.py)
+    routes through the chunk-and-merge form. A kernel failure only
+    disables that exact (shapes, k) signature — standard serving shapes
+    keep the fast path."""
+    from oryx_tpu.ops.transfer import ChunkedMatrix, QuantizedMatrix
 
     if isinstance(y, ChunkedMatrix):
         return topk_dot_batch_chunked(xs, y.chunks, k=k, recall=recall)
+    if isinstance(y, QuantizedMatrix):
+        n_items = y.shape[0]
+        sig = (xs.shape, y.shape, xs.dtype, "int8", k)
+        if (
+            recall >= 1.0
+            and k <= PALLAS_TOPK_MAX_K
+            and n_items >= 32768
+            and sig not in _pallas_failed_shapes
+            and jax.default_backend() == "tpu"
+        ):
+            from oryx_tpu.ops.pallas_topk import topk_dot_batch_pallas
+
+            try:
+                return topk_dot_batch_pallas(xs, y.q, scales=y.scale, k=k)
+            except Exception:  # noqa: BLE001 - e.g. VMEM overflow
+                log.exception(
+                    "pallas quantized top-k failed for %s; falling back to XLA",
+                    sig,
+                )
+                _pallas_failed_shapes.add(sig)
+        return topk_dot_batch_quant_xla(
+            xs, y.q, y.scale, k=k, recall=float(recall) if recall < 1.0 else 1.0
+        )
     n_items = y.shape[0]
     if xs.dtype != y.dtype:
         # mixed-precision queries score in the matrix's dtype (the bf16

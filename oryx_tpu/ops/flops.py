@@ -25,11 +25,38 @@ _PEAK_BF16 = {
     "v6e": 918e12,
 }
 
+# Dense int8 OP/s peaks. v5e/v5p/v6e run int8 at 2x the bf16 rate on the
+# MXU; earlier generations have no int8 fast path and score int8 operands
+# at the bf16 rate after conversion. An MFU for a quantized dispatch must
+# divide by THIS peak — dividing int8 throughput by the bf16 peak would
+# flatter a quantized kernel by up to 2x on chips with int8 support.
+_PEAK_INT8 = {
+    "v2": 45e12,
+    "v3": 123e12,
+    "v4": 275e12,
+    "v5e": 788e12,
+    "v5p": 918e12,
+    "v6e": 1836e12,
+}
+
+
+def normalize_dtype(dtype: str) -> str:
+    """Canonical dtype tag for peak lookup: int8 / bfloat16 / float32.
+    Unrecognized tags conservatively map to bfloat16 (the serving
+    default), never to the higher int8 peak."""
+    d = str(dtype).lower()
+    if d in ("int8", "i8", "s8"):
+        return "int8"
+    if d in ("float32", "f32"):
+        return "float32"
+    return "bfloat16"
+
 
 def peak_flops_for_kind(device_kind: str, dtype: str = "bfloat16") -> float | None:
-    """Per-chip dense peak FLOP/s for a jax device_kind string, or None
-    when the chip generation can't be identified (MFU is then omitted
-    rather than guessed)."""
+    """Per-chip dense peak FLOP/s for a jax device_kind string at the
+    dtype actually dispatched (int8 / bfloat16 / float32), or None when
+    the chip generation can't be identified (MFU is then omitted rather
+    than guessed)."""
     kind = device_kind.lower()
     if "v6" in kind or "trillium" in kind:
         gen = "v6e"
@@ -47,8 +74,11 @@ def peak_flops_for_kind(device_kind: str, dtype: str = "bfloat16") -> float | No
         gen = "v2"
     else:
         return None
+    d = normalize_dtype(dtype)
+    if d == "int8":
+        return _PEAK_INT8[gen]
     peak = _PEAK_BF16[gen]
-    if dtype in ("float32", "f32"):
+    if d == "float32":
         peak /= 2
     return peak
 

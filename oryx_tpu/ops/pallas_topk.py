@@ -10,6 +10,39 @@ blocks HBM->VMEM, scores each block on the MXU, and folds it into a
 running per-row top-k held in VMEM scratch, so Y is read exactly once and
 the score matrix never exists.
 
+Second generation (PR 8), three changes over the first kernel:
+
+- Selection: the first kernel ran k sequential argmax+mask sweeps over a
+  [Bb, k+Ib] candidate buffer — O(k·Ib) VPU work per block that exceeded
+  the MXU's matmul FLOPs at k=32 and capped the fused path at k<=32.
+  Now each block's scores are reduced by a BITONIC partial sort: the
+  block splits into 128-lane chunks, each chunk is bitonic-sorted
+  descending (28 compare-exchange stages), chunks pairwise-merge down a
+  tree (8 stages per level), and the block's top-128 merges into the
+  running top-128 (8 stages). ~36 vectorized stages per block total,
+  independent of k, exact for any k <= 128 — the comparisons order by
+  (value desc, index asc), the same total order as jax.lax.top_k, so
+  duplicate scores tie-break identically.
+- Streaming: the item matrix stays in HBM (`memory_space=ANY`) and the
+  kernel issues its own double-buffered `pltpu.make_async_copy` DMAs
+  into a 2-slot VMEM scratch, starting block i+1's copy before computing
+  block i — the MXU never waits on the HBM stream.
+- Blocks: `(block_b, block_i)` come from a per-(feature-pad, dtype)
+  table (`tuned_blocks`) sized against the VMEM budget and cached for
+  the process; `autotune_blocks` measures candidates on real hardware
+  and locks the winner into the same table (bench uses it; serving
+  inherits whatever the table holds at dispatch time).
+
+The kernel also scores QUANTIZED item matrices (int8 rows + per-row f32
+scales, ops/transfer.py QuantizedMatrix): the int8 stream halves the
+bf16 HBM traffic that dominates the scan, queries are per-row
+int8-quantized on device (quantize_queries) and the dot runs
+int8 x int8 -> int32 on the MXU — the 2x-rate mode the int8 MFU peak
+tables describe. Item scales multiply back before selection; query
+scales (order-invariant per row) multiply the returned values after the
+kernel. The serving tier re-ranks surviving candidates in f32 either
+way (apps/als/serving.py _rerank_exact).
+
 Layout: grid (B-blocks, I-blocks) with the item dimension innermost; the
 running top-k scratch is (re)initialized at item-block 0 and written to the
 output block on every step (the final step's write wins). k is padded to
@@ -18,6 +51,7 @@ the 128-lane tile internally and sliced by the wrapper.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -27,42 +61,158 @@ from jax.experimental.pallas import tpu as pltpu
 
 _LANE = 128  # TPU lane tile; also the padded top-k slot width
 
+# Scoped-VMEM working-set budget the block table sizes against (v5e
+# exposes ~16 MB; leave headroom for the compiler's own temporaries).
+_VMEM_BUDGET_BYTES = 12 << 20
 
-def _topk_kernel(xs_ref, y_ref, vals_ref, idx_ref, run_vals, run_idx, *, k, block_i, n_items):
+
+# ---------------------------------------------------------------------------
+# bitonic partial-sort selection (exact, index-carrying)
+# ---------------------------------------------------------------------------
+
+def _swap_xor(x, d):
+    """Partner values at lane XOR d along the last axis (reshape + flip of
+    the pair axis — lowers to lane shuffles, no gather)."""
+    shp = x.shape
+    l = shp[-1]
+    xr = x.reshape(shp[:-1] + (l // (2 * d), 2, d))
+    return jnp.flip(xr, axis=-2).reshape(shp)
+
+
+def _cmp_exchange(v, i, d, desc):
+    """One compare-exchange stage at XOR distance d, carrying indices.
+    desc: bool array over the last axis — True where the run containing
+    the lane sorts descending. Ordering is the strict total order
+    (value desc, index asc), so equal values resolve exactly like
+    jax.lax.top_k's stable lowest-index-first."""
+    v_o = _swap_xor(v, d)
+    i_o = _swap_xor(i, d)
+    lane = jax.lax.broadcasted_iota(jnp.int32, v.shape, v.ndim - 1)
+    is_lo = (lane & d) == 0
+    greater = (v > v_o) | ((v == v_o) & (i < i_o))
+    take_self = greater == (is_lo == desc)
+    return jnp.where(take_self, v, v_o), jnp.where(take_self, i, i_o)
+
+
+def _bitonic_sort_desc(v, i):
+    """Full descending sort of the (pow2-length) last axis, carrying i."""
+    l = v.shape[-1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, v.shape, v.ndim - 1)
+    size = 2
+    while size <= l:
+        desc = (lane & size) == 0
+        d = size // 2
+        while d >= 1:
+            v, i = _cmp_exchange(v, i, d, desc)
+            d //= 2
+        size *= 2
+    return v, i
+
+
+def _bitonic_merge_desc(v, i):
+    """Sort a bitonic (pow2-length) last axis descending: log2(L) stages."""
+    l = v.shape[-1]
+    desc = jnp.ones(v.shape, dtype=bool)
+    d = l // 2
+    while d >= 1:
+        v, i = _cmp_exchange(v, i, d, desc)
+        d //= 2
+    return v, i
+
+
+def _merge_top(av, ai, bv, bi):
+    """Exact top-L of two sorted-descending length-L lists: the bitonic
+    split (elementwise a[j] vs b[L-1-j], keep the greater) leaves the L
+    largest of the union as a bitonic sequence, then one log-merge sorts
+    it descending. 1 + log2(L) stages total."""
+    rv = jnp.flip(bv, axis=-1)
+    ri = jnp.flip(bi, axis=-1)
+    greater = (av > rv) | ((av == rv) & (ai < ri))
+    return _bitonic_merge_desc(
+        jnp.where(greater, av, rv), jnp.where(greater, ai, ri)
+    )
+
+
+def _block_topk(scores, col):
+    """[Bb, block_i] scores + global column ids -> the block's exact
+    top-128 (vals, idx), sorted descending. block_i must be a pow2
+    multiple of 128: chunk sort once, then a pairwise merge tree."""
+    bb, bi = scores.shape
+    g = bi // _LANE
+    v = scores.reshape(bb, g, _LANE)
+    i = col.reshape(bb, g, _LANE)
+    v, i = _bitonic_sort_desc(v, i)
+    while g > 1:
+        v = v.reshape(bb, g // 2, 2, _LANE)
+        i = i.reshape(bb, g // 2, 2, _LANE)
+        v, i = _merge_top(
+            v[:, :, 0, :], i[:, :, 0, :], v[:, :, 1, :], i[:, :, 1, :]
+        )
+        g //= 2
+    return v.reshape(bb, _LANE), i.reshape(bb, _LANE)
+
+
+# ---------------------------------------------------------------------------
+# the kernel: manual double-buffered Y stream + bitonic merge
+# ---------------------------------------------------------------------------
+
+def _topk_kernel(
+    *refs, block_i, n_items, quantized,
+):
+    if quantized:
+        (xs_ref, y_hbm, scale_ref, vals_ref, idx_ref,
+         run_vals, run_idx, y_buf, sem) = refs
+    else:
+        (xs_ref, y_hbm, vals_ref, idx_ref,
+         run_vals, run_idx, y_buf, sem) = refs
+        scale_ref = None
     i = pl.program_id(1)
+    ni = pl.num_programs(1)
+    slot = jax.lax.rem(i, 2)
+
+    def dma(s, chunk):
+        return pltpu.make_async_copy(
+            y_hbm.at[pl.ds(chunk * block_i, block_i)], y_buf.at[s], sem.at[s]
+        )
 
     @pl.when(i == 0)
     def _init():
+        dma(0, 0).start()
         run_vals[:] = jnp.full_like(run_vals, -jnp.inf)
         run_idx[:] = jnp.zeros_like(run_idx)
 
-    # [Bb, K] x [K, Ib] on the MXU, f32 accumulation
-    scores = jnp.dot(xs_ref[:], y_ref[:].T, preferred_element_type=jnp.float32)
+    # prefetch block i+1 while block i computes: the double buffer
+    @pl.when(i + 1 < ni)
+    def _prefetch():
+        dma(jax.lax.rem(i + 1, 2), i + 1).start()
+
+    dma(slot, i).wait()
+    y_block = y_buf[slot]
+
+    xs = xs_ref[:]
+    if scale_ref is not None:
+        # TRUE int8 path: queries arrive pre-quantized (wrapper, per-row
+        # scales), so the dot runs int8 x int8 -> int32 on the MXU — the
+        # 2x-rate mode the int8 MFU peak describes — exactly. Item scales
+        # multiply back in before selection (they reorder across rows);
+        # the QUERY scales do not: scaling a row by a positive constant
+        # never changes that row's top-k order, so the wrapper applies
+        # them to the returned values after the kernel.
+        scores = jnp.dot(
+            xs, y_block.T, preferred_element_type=jnp.int32
+        ).astype(jnp.float32) * scale_ref[0, :][None, :]
+    else:
+        # [Bb, K] x [K, Ib] on the MXU, f32 accumulation
+        scores = jnp.dot(xs, y_block.T, preferred_element_type=jnp.float32)
     col = i * block_i + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
     scores = jnp.where(col < n_items, scores, -jnp.inf)  # mask tail padding
 
-    cand_vals = jnp.concatenate([run_vals[:], scores], axis=1)
-    cand_idx = jnp.concatenate([run_idx[:], col], axis=1)
-    pos = jax.lax.broadcasted_iota(jnp.int32, cand_vals.shape, 1)
-
-    slot = jax.lax.broadcasted_iota(jnp.int32, run_vals.shape, 1)
-    new_vals = jnp.full_like(run_vals, -jnp.inf)
-    new_idx = jnp.zeros_like(run_idx)
-    # k selection rounds (k is small and static — unrolled): extract the
-    # row max, record it into slot t, then mask it out of the candidates
-    for t in range(k):
-        m = jnp.max(cand_vals, axis=1)
-        am = jnp.argmax(cand_vals, axis=1)
-        hit = pos == am[:, None]
-        sel_idx = jnp.sum(jnp.where(hit, cand_idx, 0), axis=1)
-        new_vals = jnp.where(slot == t, m[:, None], new_vals)
-        new_idx = jnp.where(slot == t, sel_idx[:, None], new_idx)
-        cand_vals = jnp.where(hit, -jnp.inf, cand_vals)
-
-    run_vals[:] = new_vals
-    run_idx[:] = new_idx
-    vals_ref[:] = new_vals
-    idx_ref[:] = new_idx
+    bv, bidx = _block_topk(scores, col)
+    nv, nidx = _merge_top(run_vals[:], run_idx[:], bv, bidx)
+    run_vals[:] = nv
+    run_idx[:] = nidx
+    vals_ref[:] = nv
+    idx_ref[:] = nidx
 
 
 def _pad_to(x, size, axis, value=0.0):
@@ -74,34 +224,141 @@ def _pad_to(x, size, axis, value=0.0):
     return jnp.pad(x, widths, constant_values=value)
 
 
-@partial(jax.jit, static_argnames=("k", "block_b", "block_i", "interpret"))
-def topk_dot_batch_pallas(
-    xs,
-    y,
-    *,
-    k: int,
-    block_b: int = 128,
-    block_i: int = 4096,
-    interpret: bool = False,
+def _pow2_ceil(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def _pow2_floor(n: int) -> int:
+    return 1 << max(0, n.bit_length() - 1)
+
+
+# ---------------------------------------------------------------------------
+# block tuning: per-(feature-pad, dtype) table, autotunable on hardware
+# ---------------------------------------------------------------------------
+
+# (feat_pad, y-dtype itemsize) -> (block_b, block_i). Seeded lazily by the
+# VMEM-budget solver; overwritten by autotune_blocks' measured winners and
+# the ORYX_PALLAS_BLOCKS env override ("block_b,block_i"). Compile-time
+# cache: every topk_dot_batch_pallas call with default blocks consults it,
+# so one autotune pass retunes every later dispatch of that (f, dtype).
+_BLOCK_TABLE: dict[tuple[int, int], tuple[int, int]] = {}
+
+AUTOTUNE_BLOCK_I = (1024, 2048, 4096, 8192)
+
+
+def _working_set_bytes(
+    block_b: int, block_i: int, feat_pad: int, y_itemsize: int
+) -> int:
+    """Conservative scoped-VMEM estimate for one grid step: the 2-slot Y
+    stream buffer, the query block, the f32 score block plus the sort
+    network's value/index temporaries, and the running/output top-k."""
+    return (
+        2 * block_i * feat_pad * y_itemsize
+        + block_b * feat_pad * 4
+        + 3 * block_b * block_i * 4
+        + 4 * block_b * _LANE * 8
+    )
+
+
+def tuned_blocks(feat_pad: int, y_itemsize: int) -> tuple[int, int]:
+    """(block_b, block_i) for a feature pad + item-matrix itemsize: the
+    cached table entry if one exists (env override, autotune winner, or a
+    previous solve), else the largest pow2 block_i whose working set fits
+    the VMEM budget at block_b=128. int8 matrices (itemsize 1) stream
+    twice the rows of bf16 per byte, so their tuned block_i is larger."""
+    key = (int(feat_pad), int(y_itemsize))
+    hit = _BLOCK_TABLE.get(key)
+    if hit is not None:
+        return hit
+    env = os.environ.get("ORYX_PALLAS_BLOCKS")
+    if env:
+        try:
+            bb, bi = (int(t) for t in env.split(","))
+            _BLOCK_TABLE[key] = (bb, bi)
+            return bb, bi
+        except ValueError:
+            pass
+    block_b = 128
+    block_i = 8192
+    while block_i > 256 and _working_set_bytes(
+        block_b, block_i, feat_pad, y_itemsize
+    ) > _VMEM_BUDGET_BYTES:
+        block_i //= 2
+    _BLOCK_TABLE[key] = (block_b, block_i)
+    return block_b, block_i
+
+
+def autotune_blocks(
+    xs, y, *, k: int, scales=None, candidates=AUTOTUNE_BLOCK_I, iters: int = 5
+) -> tuple[int, int]:
+    """Measure candidate block_i values on the live backend and lock the
+    winner into the block table (keyed by this matrix's feature pad +
+    dtype, so every later default-block dispatch of the same shape class
+    uses it). Compiles each candidate once before timing. Meant for bench
+    and operator tooling — never called on a request path."""
+    import time as _time
+
+    import numpy as np
+
+    feat_pad = max(_LANE, -(-xs.shape[1] // _LANE) * _LANE)
+    itemsize = jnp.dtype(y.dtype).itemsize
+    block_b = 128
+    best, best_ms = None, None
+    for bi in candidates:
+        if _working_set_bytes(block_b, bi, feat_pad, itemsize) > _VMEM_BUDGET_BYTES:
+            continue
+        try:
+            fn = lambda: topk_dot_batch_pallas(
+                xs, y, k=k, scales=scales, block_b=block_b, block_i=bi
+            )
+            jax.block_until_ready(fn())  # compile
+            t0 = _time.perf_counter()
+            r = None
+            for _ in range(iters):
+                r = fn()
+            np.asarray(r[0])
+            ms = (_time.perf_counter() - t0) / iters * 1000
+        except Exception:  # noqa: BLE001 - a candidate that fails just loses
+            continue
+        if best_ms is None or ms < best_ms:
+            best, best_ms = bi, ms
+    if best is not None:
+        _BLOCK_TABLE[(feat_pad, itemsize)] = (block_b, best)
+        return block_b, best
+    return tuned_blocks(feat_pad, itemsize)
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def quantize_queries(xs):
+    """Per-row symmetric int8 quantization of a query block (device-side
+    twin of transfer.quantize_rows_int8): (q int8, scale f32 [B]). The
+    quantized kernels run the score dot int8 x int8 -> int32 on the MXU,
+    which is what earns the int8 MFU denominator."""
+    ax = jnp.max(jnp.abs(xs.astype(jnp.float32)), axis=1)
+    sx = jnp.where(ax > 0, ax / 127.0, 1.0)
+    q = jnp.clip(
+        jnp.round(xs.astype(jnp.float32) / sx[:, None]), -127, 127
+    ).astype(jnp.int8)
+    return q, sx
+
+
+@partial(
+    jax.jit,
+    static_argnames=("k", "block_b", "block_i", "quantized", "interpret"),
+)
+def _topk_pallas_jit(
+    xs, y, scales, *, k, block_b, block_i, quantized, interpret
 ):
-    """Top-k of xs @ y.T per row without materializing the score matrix.
-
-    xs: [B, K] queries; y: [I, K] item factors; returns ([B, k] f32 scores,
-    [B, k] int32 indices), identical ordering to jax.lax.top_k. k <= 128.
-    interpret=True runs the kernel in the Pallas interpreter (CPU tests).
-
-    block_i=4096 keeps the f32 working set (double-buffered Y block +
-    score block + the two merge candidate arrays) inside the 16 MB scoped
-    VMEM limit on v5e; 8192 overflows it. Measured on v5e at 4096 x 1M x
-    50f bf16 k=10: 94 ms vs 187 ms for the XLA matmul+top_k (1.98x).
-    """
-    if k > _LANE:
-        raise ValueError(f"k must be <= {_LANE}, got {k}")
     n_b, n_feat = xs.shape
+    if quantized:
+        # int8 queries into the int8 kernel; per-row query scales apply
+        # to the returned VALUES only (row-positive scaling is top-k
+        # order-invariant, so they never need to enter the kernel)
+        xs, sx = quantize_queries(xs)
     n_items = y.shape[0]
-
-    block_b = min(block_b, max(8, n_b))
-    block_i = min(block_i, max(_LANE, -(-n_items // _LANE) * _LANE))
     # pad features to the lane tile (zeros leave dot products unchanged),
     # batch to the block size, items to the item block
     feat_pad = max(_LANE, -(-n_feat // _LANE) * _LANE)
@@ -110,14 +367,26 @@ def topk_dot_batch_pallas(
     nb = xs_p.shape[0] // block_b
     ni = y_p.shape[0] // block_i
 
-    kernel = partial(_topk_kernel, k=k, block_i=block_i, n_items=n_items)
+    kernel = partial(
+        _topk_kernel, block_i=block_i, n_items=n_items, quantized=quantized
+    )
+    in_specs = [
+        pl.BlockSpec((block_b, feat_pad), lambda b, i: (b, 0)),
+        # the item matrix stays in HBM: the kernel streams its own
+        # double-buffered DMA blocks out of it
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    operands = [xs_p, y_p]
+    if quantized:
+        scale_p = _pad_to(
+            jnp.asarray(scales, dtype=jnp.float32)[None, :], ni * block_i, 1
+        )
+        in_specs.append(pl.BlockSpec((1, block_i), lambda b, i: (0, i)))
+        operands.append(scale_p)
     vals, idx = pl.pallas_call(
         kernel,
         grid=(nb, ni),
-        in_specs=[
-            pl.BlockSpec((block_b, feat_pad), lambda b, i: (b, 0)),
-            pl.BlockSpec((block_i, feat_pad), lambda b, i: (i, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((block_b, _LANE), lambda b, i: (b, 0)),
             pl.BlockSpec((block_b, _LANE), lambda b, i: (b, 0)),
@@ -129,7 +398,65 @@ def topk_dot_batch_pallas(
         scratch_shapes=[
             pltpu.VMEM((block_b, _LANE), jnp.float32),
             pltpu.VMEM((block_b, _LANE), jnp.int32),
+            pltpu.VMEM((2, block_i, feat_pad), y_p.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=interpret,
-    )(xs_p, y_p)
-    return vals[:n_b, :k], idx[:n_b, :k]
+    )(*operands)
+    vals, idx = vals[:n_b, :k], idx[:n_b, :k]
+    if quantized:
+        # scale the selected values back into score units (sx > 0, so
+        # -inf padding slots stay -inf)
+        vals = vals * sx[:n_b, None]
+    return vals, idx
+
+
+def topk_dot_batch_pallas(
+    xs,
+    y,
+    *,
+    k: int,
+    scales=None,
+    block_b: int | None = None,
+    block_i: int | None = None,
+    interpret: bool = False,
+):
+    """Top-k of xs @ y.T per row without materializing the score matrix.
+
+    xs: [B, K] queries; y: [I, K] item factors; returns ([B, k] f32 scores,
+    [B, k] int32 indices), identical ordering to jax.lax.top_k — including
+    duplicate-score tie-breaks (lowest index first). k <= 128 (one lane
+    tile of running top-k state). scales: per-row f32 dequantization
+    scales for an int8 y (ops/transfer.py QuantizedMatrix) — scores become
+    (xs @ y.T) * scale. interpret=True runs the kernel in the Pallas
+    interpreter (CPU tests).
+
+    block_b/block_i default to the tuned table (`tuned_blocks`): the
+    largest pow2 item block whose double-buffered stream + score block +
+    sort temporaries fit the scoped-VMEM budget. Measured on v5e at
+    4096 x 1M x 50f bf16 k=10: the gen-1 argmax-round kernel ran 94 ms vs
+    187 ms XLA (1.98x); the bitonic merge removes the O(k·Ib) selection
+    sweeps that dominated that 94 ms.
+    """
+    if k > _LANE:
+        raise ValueError(f"k must be <= {_LANE}, got {k}")
+    n_b = xs.shape[0]
+    n_items = y.shape[0]
+    feat_pad = max(_LANE, -(-xs.shape[1] // _LANE) * _LANE)
+    t_bb, t_bi = tuned_blocks(feat_pad, jnp.dtype(y.dtype).itemsize)
+    if block_b is None:
+        block_b = t_bb
+    if block_i is None:
+        block_i = t_bi
+    block_b = min(block_b, max(8, n_b))
+    # the merge tree needs a pow2 block_i >= one lane tile. Non-pow2
+    # requests round DOWN — an operator shrinking the block to dodge a
+    # VMEM overflow must get at most what they asked for, never a
+    # silently larger block — and never past the next pow2 of the real
+    # row count (no point padding the item axis beyond it)
+    block_i = max(_LANE, min(_pow2_floor(block_i), _pow2_ceil(n_items)))
+    return _topk_pallas_jit(
+        xs, y, scales,
+        k=k, block_b=block_b, block_i=block_i,
+        quantized=scales is not None, interpret=interpret,
+    )

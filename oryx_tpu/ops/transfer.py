@@ -115,6 +115,89 @@ class ChunkedMatrix:
 
 
 # ---------------------------------------------------------------------------
+# quantized device matrices: int8 rows + per-row f32 scales. The serving
+# top-k scan is HBM-bandwidth-bound in Y; int8 halves the bf16 stream (a
+# quarter of f32) and the serving tier's exact f32 re-rank of surviving
+# candidates (apps/als/serving.py _rerank_exact) corrects any ordering
+# error quantization introduced inside the candidate set.
+# ---------------------------------------------------------------------------
+
+
+def quantize_rows_int8(mat: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-row int8 quantization: (q int8 [N,F], scale f32 [N])
+    with row = q * scale to within scale/2 per element. All-zero rows get
+    scale 1.0 so dequantization stays exact zeros (capacity padding rows
+    ride through unharmed)."""
+    a = np.asarray(mat, dtype=np.float32)
+    m = np.max(np.abs(a), axis=1) if a.size else np.zeros(a.shape[0])
+    scale = np.where(m > 0, m / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(a / scale[:, None]), -127, 127).astype(np.int8)
+    return q, scale
+
+
+class QuantizedMatrix:
+    """Committed device item matrix in int8 with per-row f32 scales.
+    Quacks like an array exactly where the serving batcher needs it
+    (shape / dtype / devices / nbytes); scoring dispatches through
+    ops.als's quantized kernels, which dequantize blocks in VMEM and
+    multiply the row scales back in after the matmul."""
+
+    __slots__ = ("q", "scale")
+
+    def __init__(self, q, scale):
+        if q.shape[0] != scale.shape[0]:
+            raise ValueError(
+                f"quantized rows/scales mismatch: {q.shape[0]} vs {scale.shape[0]}"
+            )
+        self.q = q
+        self.scale = scale
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    @property
+    def nbytes(self):
+        return int(
+            getattr(self.q, "nbytes", 0) + getattr(self.scale, "nbytes", 0)
+        )
+
+    def devices(self):
+        return self.q.devices()
+
+    def unit_scaled(self) -> "QuantizedMatrix":
+        """The cosine (row-normalized) view of this matrix, SHARING the
+        int8 rows: unit(q·s) = q/||q||, so normalization is purely a new
+        scale vector (1/||q_row||, zero rows stay zero) — the quantized
+        unit view costs no second item matrix in HBM, where the bf16 path
+        materializes a full normalized copy."""
+        return QuantizedMatrix(self.q, _int8_unit_scales(self.q))
+
+
+@jax.jit
+def _int8_unit_scales(q):
+    """1/||q_row|| per row (0 for zero rows), jitted so XLA fuses the
+    int8->f32 convert into the norm reduction — an eager astype would
+    materialize a full f32 copy of the matrix in HBM, defeating the
+    memory point of quantization on exactly the large catalogs it
+    targets."""
+    qf = q.astype(jnp.float32)
+    norms = jnp.sqrt((qf * qf).sum(axis=1))
+    return jnp.where(norms > 0, 1.0 / jnp.maximum(norms, 1e-12), 0.0)
+
+
+def quantized_device_put(a: np.ndarray) -> QuantizedMatrix:
+    """Quantize a host f32 matrix per-row and upload (staged) as a
+    QuantizedMatrix device view."""
+    q, scale = quantize_rows_int8(a)
+    return QuantizedMatrix(staged_device_put(q), staged_device_put(scale))
+
+
+# ---------------------------------------------------------------------------
 # incremental row sync: scatter dirty rows into an existing device matrix
 # instead of re-uploading it. The TensorFlow pattern of device-resident
 # mutable state updated by sparse scatters (PAPERS: TensorFlow, 2016):
@@ -165,6 +248,17 @@ def scatter_rows(buf, idx: np.ndarray, rows: np.ndarray, *, donate: bool = False
     idx = np.asarray(idx, dtype=np.int32)
     if idx.shape[0] == 0:
         return buf
+    if isinstance(buf, QuantizedMatrix):
+        # PR 3's delta sync contract carried over: only the DIRTY rows
+        # requantize (each row's scale is independent by construction), so
+        # an update storm never triggers a full-matrix requantization.
+        # rows arrive as f32 factor rows; the bucket-padded int8 rows +
+        # their f32 scales are all that crosses the host->device link.
+        q_rows, s_rows = quantize_rows_int8(np.asarray(rows, dtype=np.float32))
+        return QuantizedMatrix(
+            scatter_rows(buf.q, idx, q_rows, donate=donate),
+            scatter_rows(buf.scale, idx, s_rows, donate=donate),
+        )
     if isinstance(buf, ChunkedMatrix):
         order = np.argsort(idx, kind="stable")
         idx_s, rows_s = idx[order], np.asarray(rows)[order]
@@ -196,11 +290,23 @@ def scatter_rows(buf, idx: np.ndarray, rows: np.ndarray, *, donate: bool = False
 def scatter_transfer_bytes(d: int, row_itemsize: int, features: int) -> int:
     """Host->device bytes one scatter_rows call moves for ``d`` dirty rows
     (bucket padding included — the honest wire figure the
-    oryx_device_sync_bytes metric reports)."""
+    oryx_device_sync_bytes metric reports). For a QuantizedMatrix pass
+    row_itemsize=1 and add 8 for the two f32 side scatters (scale row +
+    its index) via quantized_scatter_bytes."""
     if d == 0:
         return 0
     b = _scatter_bucket(d)
     return b * (features * row_itemsize + np.dtype(np.int32).itemsize)
+
+
+def quantized_scatter_bytes(d: int, features: int) -> int:
+    """scatter_transfer_bytes for a QuantizedMatrix delta: the int8 row
+    scatter plus the per-row f32 scale scatter (each bucket-padded with
+    its own int32 index vector)."""
+    if d == 0:
+        return 0
+    b = _scatter_bucket(d)
+    return b * (features * 1 + 4) + b * (4 + 4)
 
 
 def row_capacity(n: int, headroom: float) -> int:

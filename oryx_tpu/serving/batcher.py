@@ -77,11 +77,12 @@ from oryx_tpu.ops.als import PALLAS_TOPK_MAX_K
 
 # k rounds up to the smallest of these (then min'd with the item count);
 # larger requests fall back to next_pow2(k). A few buckets cover every
-# realistic how_many + exclusion overfetch without recompiles. The
-# PALLAS_TOPK_MAX_K bucket matters: a default /recommend?howMany=10
-# overfetches to k=18, and this bucket keeps it on the fused Pallas path
-# instead of jumping to the 128 bucket's XLA fallback.
-K_BUCKETS = (16, PALLAS_TOPK_MAX_K, 128, 1024)
+# realistic how_many + exclusion overfetch without recompiles. Every
+# bucket up to PALLAS_TOPK_MAX_K (the full 128 lane tile since the gen-2
+# bitonic kernel) rides the fused Pallas path — a default
+# /recommend?howMany=10 overfetches to k=18 and lands in the 32 bucket,
+# which bounds the result fetch and host trim below the 128 bucket's.
+K_BUCKETS = (16, 32, PALLAS_TOPK_MAX_K, 1024)
 
 MAX_BATCH = 4096  # rows per device dispatch (the bench-measured knee)
 
@@ -173,11 +174,13 @@ def host_topk(
 class _Pending:
     __slots__ = (
         "vec", "k", "y", "future", "host_mat", "cosine", "host_norms",
-        "recall", "valid_rows", "t_enq", "trace_parent", "dev_span",
+        "recall", "valid_rows", "score_mode", "t_enq", "trace_parent",
+        "dev_span",
     )
 
     def __init__(self, vec, k, y, future, host_mat=None, cosine=False,
-                 host_norms=None, recall=1.0, valid_rows=None):
+                 host_norms=None, recall=1.0, valid_rows=None,
+                 score_mode="exact"):
         self.vec = vec
         self.k = k
         self.y = y
@@ -190,6 +193,10 @@ class _Pending:
         # (apps/als/serving.py) scatter-reserves rows past this for
         # speed-layer growth, and FLOP accounting must not count them
         self.valid_rows = valid_rows
+        # which serving score mode produced this request (exact |
+        # quantized | approx) — labels the dispatch's perfstats record so
+        # per-mode throughput/latency are separable on /metrics
+        self.score_mode = score_mode
         # tracing (only populated while tracing is enabled): enqueue time
         # for the queue-wait span, the submitting request's span as
         # parent, and a one-element box holding the in-flight device span
@@ -325,6 +332,11 @@ class TopKBatcher:
         # is the serving MFU over any scrape interval
         self.flops_scored = 0.0
         self._peak_flops = ...  # Ellipsis = not yet resolved (see _note_device)
+        # tpu device_kind captured once at first dispatch; per-dtype peak
+        # cache so a quantized (int8) dispatch divides by the int8 peak,
+        # not the bf16 one (ops/flops.py per-dtype tables)
+        self._device_kind: str | None = None
+        self._peak_by_dtype: dict[str, float | None] = {}
 
     def register_gauges(self) -> None:
         """Expose the batcher's counters as callback gauges on the global
@@ -365,8 +377,9 @@ class TopKBatcher:
              "(rate over oryx_device_peak_flops = serving MFU)",
              lambda: float(self.flops_scored)),
             ("oryx_device_peak_flops",
-             "dense bf16 peak FLOP/s of the serving chip (0 when unknown "
-             "or not a TPU)",
+             "dense peak FLOP/s of the serving chip at the dtype of the "
+             "most recent dispatch (int8/bf16/f32 tables, ops/flops.py; "
+             "0 when unknown or not a TPU)",
              lambda: float(self._device_peak() or 0.0)),
         ):
             reg.gauge(name, help_text).set_function(fn)
@@ -388,9 +401,8 @@ class TopKBatcher:
             if getattr(d, "platform", "") == "tpu":
                 from oryx_tpu.ops.flops import peak_flops_for_kind
 
-                self._peak_flops = peak_flops_for_kind(
-                    getattr(d, "device_kind", "") or ""
-                )
+                self._device_kind = getattr(d, "device_kind", "") or ""
+                self._peak_flops = peak_flops_for_kind(self._device_kind)
             else:
                 self._peak_flops = None
         except Exception:  # non-jax stub matrices in tests
@@ -398,6 +410,25 @@ class TopKBatcher:
         # hand the resolved chip peak to the live-MFU accounting (it must
         # never resolve jax.devices() itself on a scrape path)
         _PERF.note_peak("serving", self._device_peak())
+
+    def _peak_for_matrix(self, y) -> float | None:
+        """Chip peak at the dtype this dispatch actually streams (int8 for
+        a QuantizedMatrix, bf16/f32 otherwise) — cached per dtype, resolved
+        from the device kind _note_device captured. The live MFU gauge's
+        denominator follows the most recent dispatch's dtype; a quantized
+        deployment therefore reads against the int8 peak, never flattering
+        itself against bf16."""
+        if self._device_kind is None:
+            return self._peak_flops if self._peak_flops is not ... else None
+        from oryx_tpu.ops.flops import normalize_dtype, peak_flops_for_kind
+
+        dtype = normalize_dtype(str(getattr(y, "dtype", "") or "bfloat16"))
+        peak = self._peak_by_dtype.get(dtype, ...)
+        if peak is ...:
+            peak = peak_flops_for_kind(self._device_kind, dtype)
+            self._peak_by_dtype[dtype] = peak
+        self._peak_flops = peak  # the oryx_device_peak_flops gauge tracks it
+        return peak
 
     # -- public API --------------------------------------------------------
 
@@ -411,6 +442,7 @@ class TopKBatcher:
         host_norms: np.ndarray | None = None,
         recall: float = 1.0,
         valid_rows: int | None = None,
+        score_mode: str = "exact",
     ) -> tuple[np.ndarray, np.ndarray]:
         """Score vec against device matrix y, returning (values, indices)
         for the top-k rows. Blocks until the coalesced dispatch completes.
@@ -421,10 +453,13 @@ class TopKBatcher:
         approximate device kernel (host fallback stays exact). valid_rows
         marks the real-data prefix of a capacity-padded matrix (FLOP
         accounting only; the caller filters padding indices from results).
+        score_mode labels the dispatch's perfstats record (exact |
+        quantized | approx) for per-mode observability.
         """
         return self.submit_nowait(
             vec, k, y, host_mat=host_mat, cosine=cosine,
             host_norms=host_norms, recall=recall, valid_rows=valid_rows,
+            score_mode=score_mode,
         ).result()
 
     def submit_nowait(
@@ -437,6 +472,7 @@ class TopKBatcher:
         host_norms: np.ndarray | None = None,
         recall: float = 1.0,
         valid_rows: int | None = None,
+        score_mode: str = "exact",
     ) -> Future:
         """submit() without the wait: returns the Future of (values,
         indices). Deferred endpoints chain post-processing onto it instead
@@ -445,7 +481,7 @@ class TopKBatcher:
         fut: Future = Future()
         p = _Pending(
             vec, int(k), y, fut, host_mat, cosine, host_norms,
-            float(recall), valid_rows,
+            float(recall), valid_rows, score_mode,
         )
         if _TRACER.enabled:
             # parent = the submitting request's span (thread-current, set
@@ -613,6 +649,9 @@ class TopKBatcher:
                 group_flops = 2.0 * b * n_rows * y.shape[1]
                 self.flops_scored += group_flops
                 self._note_device(y)
+                # per-dtype peak: a quantized (int8) dispatch's MFU window
+                # divides by the int8 peak, an exact bf16 one by bf16
+                _PERF.set_peak("serving", self._peak_for_matrix(y))
                 padded = _pad_rows(b, self._on_accel)
                 # keyed on the FULL (capacity) shape: the serving view
                 # pads rows up a bucket ladder precisely so store growth
@@ -662,6 +701,7 @@ class TopKBatcher:
                     _dispatch_bytes(padded, y.shape[1], y, kb),
                     b, padded, int(n_rows), int(y.shape[0]),
                     tp.trace_id if tp is not None else None,
+                    group[0].score_mode,
                 )
                 launched.append((group, kb, vals, idx, shape_key, cost))
             except Exception as e:
@@ -709,13 +749,13 @@ class TopKBatcher:
             # results are on the host: the dispatch's device work + fetch
             # is complete — record its cost (FLOPs/bytes/wall/occupancy)
             # into the live perf accounting
-            t0, flops, bytes_moved, b, padded, valid, cap, trace_id = cost
+            t0, flops, bytes_moved, b, padded, valid, cap, trace_id, mode = cost
             _PERF.record_dispatch(
                 "serving",
                 flops=flops, bytes_moved=bytes_moved,
                 wall_s=time.monotonic() - t0, rows=b, padded_rows=padded,
                 valid_rows=valid, capacity_rows=cap, trace_id=trace_id,
-                t_start=t0,
+                t_start=t0, score_mode=mode,
             )
             # the dispatch completed, so this shape's compile is done:
             # drop its grace window and never grant it one again. Pop

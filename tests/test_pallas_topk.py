@@ -94,3 +94,84 @@ def test_dispatcher_uses_xla_off_tpu():
     v, i = topk_dot_batch(xs, y, k=3)
     v_ref, i_ref = topk_dot_batch_xla(xs, y, k=3)
     assert np.array_equal(np.asarray(i), np.asarray(i_ref))
+
+
+def test_b1_single_request():
+    # B=1: the un-coalesced dispatch shape (an idle server's immediate
+    # dispatch) — batch padding must not leak into the one real row
+    _check(b=1, n_items=900, feats=50, k=10)
+
+
+def test_k_not_divisor_of_lane_width():
+    # k that divides neither the 128-lane tile nor any bucket boundary:
+    # the kernel keeps a full sorted 128-slot state and the wrapper slices
+    _check(b=6, n_items=700, feats=20, k=18)
+    _check(b=6, n_items=700, feats=20, k=97)
+
+
+def test_duplicate_scores_stable_tie_break():
+    # duplicated rows produce exactly equal scores; the bitonic network's
+    # (value desc, index asc) total order must match lax.top_k's stable
+    # lowest-index-first tie-break bit-for-bit
+    rng = np.random.default_rng(21)
+    base = rng.normal(size=(60, 16)).astype(np.float32)
+    y = jnp.asarray(np.repeat(base, 5, axis=0))  # every score appears 5x
+    xs = jnp.asarray(rng.normal(size=(7, 16)), dtype=jnp.float32)
+    v_ref, i_ref = topk_dot_batch_xla(xs, y, k=25)
+    v, i = topk_dot_batch_pallas(xs, y, k=25, block_b=8, block_i=128, interpret=True)
+    assert np.array_equal(np.asarray(i), np.asarray(i_ref))
+    np.testing.assert_allclose(np.asarray(v), np.asarray(v_ref), atol=1e-4)
+
+
+def test_property_random_shapes_match_xla():
+    # randomized sweep over awkward shapes: non-multiple-of-128 item
+    # tails, batches off the block grid, k off every boundary — exact
+    # index agreement with the XLA reference in interpret mode
+    rng = np.random.default_rng(33)
+    for trial in range(5):
+        b = int(rng.integers(1, 20))
+        n_items = int(rng.integers(150, 2500))
+        feats = int(rng.integers(4, 70))
+        k = int(rng.integers(1, min(128, n_items) + 1))
+        block_i = int(rng.choice([128, 256, 512]))
+        _check(
+            b=b, n_items=n_items, feats=feats, k=k,
+            block_b=8, block_i=block_i, seed=100 + trial,
+        )
+
+
+def test_quantized_kernel_parity_interpret():
+    # the quantized (int8 + per-row scale) kernel against the quantized
+    # XLA reference: identical quantized scores -> identical indices
+    from oryx_tpu.ops.als import topk_dot_batch_quant_xla
+    from oryx_tpu.ops.transfer import quantize_rows_int8
+
+    rng = np.random.default_rng(44)
+    y = rng.normal(size=(1111, 30)).astype(np.float32)
+    xs = jnp.asarray(rng.normal(size=(9, 30)), dtype=jnp.float32)
+    q, s = quantize_rows_int8(y)
+    v, i = topk_dot_batch_pallas(
+        xs, jnp.asarray(q), scales=jnp.asarray(s), k=12,
+        block_b=8, block_i=256, interpret=True,
+    )
+    v_ref, i_ref = topk_dot_batch_quant_xla(
+        xs, jnp.asarray(q), jnp.asarray(s), k=12
+    )
+    assert np.array_equal(np.asarray(i), np.asarray(i_ref))
+    np.testing.assert_allclose(np.asarray(v), np.asarray(v_ref), atol=1e-4)
+
+
+def test_tuned_block_table_and_env_override(monkeypatch):
+    from oryx_tpu.ops import pallas_topk as pt
+
+    # int8 streams twice the rows per byte: its tuned block_i must be at
+    # least bf16's at the same feature pad
+    monkeypatch.setattr(pt, "_BLOCK_TABLE", {})
+    bb_bf16, bi_bf16 = pt.tuned_blocks(128, 2)
+    bb_i8, bi_i8 = pt.tuned_blocks(128, 1)
+    assert bi_i8 >= bi_bf16 >= 256
+    assert (128, 2) in pt._BLOCK_TABLE  # compile-time cached
+    # env override wins for fresh entries
+    monkeypatch.setattr(pt, "_BLOCK_TABLE", {})
+    monkeypatch.setenv("ORYX_PALLAS_BLOCKS", "64,1024")
+    assert pt.tuned_blocks(128, 2) == (64, 1024)

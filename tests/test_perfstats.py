@@ -621,6 +621,29 @@ def test_check_bench_latency_ratchets_down(tmp_path):
     assert "latency_ms_p99" in proc.stdout
 
 
+def test_check_bench_pending_rows_report_but_never_fail(tmp_path):
+    """A "pending": true row (baseline declared ahead of its first banked
+    measurement — PR 8's retightened pallas_speedup and the new
+    score-mode metrics) must render loudly but fail nothing, whether the
+    metric is absent from the run or present below the future floor."""
+    ratchet = {
+        "metrics": [
+            {"name": "kernel_mfu", "platform": "tpu", "baseline": 0.01,
+             "direction": "up", "tolerance": 0.1},
+            {"name": "qps_quantized", "platform": "tpu", "baseline": 36000,
+             "direction": "up", "tolerance": 0.25, "pending": True},
+            {"name": "pallas_speedup", "platform": "tpu", "baseline": 3.0,
+             "direction": "up", "tolerance": 0.15, "pending": True},
+        ]
+    }
+    proc = _run_check_bench(tmp_path, ratchet, {
+        "platform": "tpu", "kernel_mfu": 0.02, "pallas_speedup": 1.94,
+    })
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("PENDING") == 2
+    assert "ratchet ok" in proc.stdout
+
+
 def test_committed_ratchet_accepts_its_own_sources():
     """The committed BASELINE_RATCHET.json must accept the very artifacts
     its baselines were read from — a ratchet that fails its own source

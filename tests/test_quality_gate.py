@@ -150,3 +150,43 @@ def test_kmeans_quality_harness_smoke():
     rep = build_and_evaluate_kmeans(n_points=50_000, dims=20, k=12, iterations=8)
     assert rep.sse_ratio <= 1.05
     assert rep.silhouette >= 0.5
+
+
+# ---- serving score-mode recall gate (PR 8) ------------------------------
+# The quantized (int8 + exact rescore) and approx (partial-reduce) score
+# modes must hold recall@10 >= 0.95 against the exact top-k on the
+# standing corpus — speed can never silently buy wrong answers. Tier-1
+# (always on): the gate is CPU-cheap, and the CPU run regression-guards
+# the quantized claim everywhere even where approx_max_k computes exactly.
+
+
+def test_score_mode_recall_gate():
+    from oryx_tpu.ml.quality import (
+        MIN_SCORE_MODE_RECALL,
+        evaluate_score_mode_recall,
+    )
+
+    rep = evaluate_score_mode_recall(n_items=40_000, n_queries=128)
+    assert rep.min_recall == MIN_SCORE_MODE_RECALL == 0.95
+    assert rep.recall_quantized >= rep.min_recall, (
+        f"quantized recall@{rep.k} {rep.recall_quantized:.4f} below the "
+        f"{rep.min_recall} gate — int8 selection + exact rescore regressed"
+    )
+    assert rep.recall_approx >= rep.min_recall, (
+        f"approx recall@{rep.k} {rep.recall_approx:.4f} below the "
+        f"{rep.min_recall} gate"
+    )
+    assert rep.green
+
+
+@nightly
+def test_score_mode_recall_gate_full_corpus():
+    """The nightly-scale corpus (the same configuration
+    tools/quality_nightly.py records in the QUALITY artifact)."""
+    from oryx_tpu.ml.quality import evaluate_score_mode_recall
+
+    rep = evaluate_score_mode_recall()
+    assert rep.green, (
+        f"score-mode recall gate RED: quantized {rep.recall_quantized:.4f} "
+        f"approx {rep.recall_approx:.4f} (floor {rep.min_recall})"
+    )

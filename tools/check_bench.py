@@ -24,6 +24,16 @@ BASELINE_RATCHET.json riding the PR that earned the number — never
 automatic, so a lucky run can't quietly raise the bar for everyone.
 ``tools/check_metrics.py`` statically verifies every ratcheted metric
 name still exists in bench.py's output vocabulary.
+
+A row may carry ``"pending": true``: the baseline was set AHEAD of its
+first banked measurement (a PR that rebuilt the thing being measured and
+re-declared the bar, e.g. the gen-2 fused kernel retightening
+pallas_speedup before a TPU window could run it). Pending rows render
+loudly in the table but never fail the check — the committed ratchet
+must keep accepting the previously banked artifacts. The PR that banks
+the first artifact measuring a pending row REMOVES the flag (and
+corrects the baseline to the measured number), at which point the row
+enforces like any other.
 """
 
 from __future__ import annotations
@@ -109,6 +119,17 @@ def check(
         if want_platform and platform and want_platform != platform:
             rows.append((name, base, "-", direction, tol,
                          f"SKIP (locked for {want_platform}, run is {platform})"))
+            continue
+        if m.get("pending"):
+            # baseline declared ahead of its first banked measurement:
+            # report, never fail — the flag is removed by the PR that
+            # banks an artifact measuring it
+            got = current.get(name)
+            rows.append((
+                name, base, got if got is not None else "-", direction, tol,
+                "PENDING (baseline ahead of first banked measurement; "
+                "remove the flag when one lands)",
+            ))
             continue
         checked += 1
         got = current.get(name)

@@ -45,6 +45,35 @@ DOC_ROW = re.compile(r"^\|\s*`(oryx_[^`]+)`", re.M)
 # Not metrics: the package's own name appears as a string in a few places.
 IGNORE = {"oryx_tpu"}
 
+# Score-mode vocabulary (PR 8): bench fields the serving-mode claims ride
+# on, and the label key the batcher's dispatch records carry. A rename in
+# bench.py or docs would otherwise silently orphan the recall gate's and
+# the per-mode dashboards' names.
+REQUIRED_BENCH_FIELDS = (
+    "qps_quantized",
+    "approx_recall_at_10",
+    "quantized_recall_at_10",
+    "lsh_measured_recall_at_10",
+)
+REQUIRED_DOC_TOKENS = ("score_mode",)
+
+
+def vocabulary_problems() -> list[str]:
+    problems = []
+    bench_text = BENCH.read_text(encoding="utf-8")
+    for name in REQUIRED_BENCH_FIELDS:
+        if not re.search(rf'"{re.escape(name)}"', bench_text):
+            problems.append(
+                f"{name}: required bench vocabulary missing from bench.py"
+            )
+    doc_text = DOC.read_text(encoding="utf-8")
+    for tok in REQUIRED_DOC_TOKENS:
+        if tok not in doc_text:
+            problems.append(
+                f"{tok}: required label name missing from docs/observability.md"
+            )
+    return problems
+
 
 def code_metric_names() -> dict[str, str]:
     """name -> first file using it, for every metric-shaped literal."""
@@ -112,6 +141,7 @@ def main() -> int:
             "anywhere under oryx_tpu/"
         )
     problems.extend(ratchet_problems())
+    problems.extend(vocabulary_problems())
     for p in problems:
         print(p, file=sys.stderr)
     if not problems:

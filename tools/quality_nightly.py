@@ -34,6 +34,7 @@ def main() -> int:
     )
 
     from oryx_tpu.common.rng import RandomManager
+    from oryx_tpu.ml.quality import MIN_SCORE_MODE_RECALL
     from tests.test_quality_gate import (
         AUC_FLOOR,
         KMEANS_SIL_FLOOR,
@@ -53,6 +54,7 @@ def main() -> int:
             "rdf_accuracy": RDF_ACC_FLOOR,
             "kmeans_sse_ratio_max": KMEANS_SSE_RATIO_CEIL,
             "kmeans_silhouette": KMEANS_SIL_FLOOR,
+            "score_mode_recall_at_10": MIN_SCORE_MODE_RECALL,
         },
         "gates": {},
     }
@@ -74,6 +76,7 @@ def main() -> int:
         build_and_evaluate,
         build_and_evaluate_kmeans,
         build_and_evaluate_rdf,
+        evaluate_score_mode_recall,
     )
 
     t0 = time.perf_counter()
@@ -128,6 +131,32 @@ def main() -> int:
         },
         km.sse_ratio <= KMEANS_SSE_RATIO_CEIL
         and km.silhouette >= KMEANS_SIL_FLOOR,
+    )
+
+    # ---- gate 4: serving score-mode recall floor ------------------------
+    # speed modes can never silently buy wrong answers: quantized (int8 +
+    # exact rescore) and approx (partial reduce) must hold recall@10
+    # against the exact top-k on the standing corpus
+    RandomManager.use_test_seed(1)
+    t0 = time.perf_counter()
+    rr = evaluate_score_mode_recall()
+    record(
+        "score_mode_recall",
+        {
+            # _rescored suffix: these measure the full serve pipeline
+            # (overfetch + exact f32 re-rank). bench.py's
+            # approx_recall_at_10/quantized_recall_at_10 are the RAW
+            # kernel selections at k — same helper, different pipeline;
+            # the names differ so the two artifacts can't be conflated
+            "approx_recall_at_10_rescored": round(rr.recall_approx, 4),
+            "quantized_recall_at_10_rescored": round(rr.recall_quantized, 4),
+            "k": rr.k,
+            "n_items": rr.n_items,
+            "n_queries": rr.n_queries,
+            "approx_recall_target": rr.approx_recall_target,
+            "wall_s": round(time.perf_counter() - t0, 1),
+        },
+        rr.green,
     )
 
     doc["all_green"] = ok
