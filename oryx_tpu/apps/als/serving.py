@@ -248,28 +248,30 @@ class ALSServingModel(ServingModel):
         # set, no lock on the read path. capacity >= n rows the device
         # buffer at headroom (row_capacity) so store growth scatters into
         # existing rows instead of re-uploading Y
-        self._device_view: tuple | None = None
-        self._unit_view: tuple | None = None  # row-normalized Y, same keying
         self._sync_lock = threading.Lock()
+        # writes-guarded: mutation is serialized under _sync_lock; readers
+        # take the whole snapshot tuple lock-free by design (atomic swap)
+        self._device_view: tuple | None = None  # guarded-by: _sync_lock (writes)
+        self._unit_view: tuple | None = None  # row-normalized Y, same keying  # guarded-by: _sync_lock (writes)
         # background resync: queries observing version drift set the event
         # and keep serving the previous consistent snapshot; the thread
         # applies deltas / rebuilds and swaps the view tuples atomically
-        self._resync_thread: threading.Thread | None = None
+        self._resync_thread: threading.Thread | None = None  # guarded-by: _sync_lock (writes)
         self._resync_evt = threading.Event()
         self._stop = threading.Event()
         # last completed resync, for bench/debug introspection:
         # {kind, rows, bytes, seconds, version}
-        self.last_resync: dict | None = None
+        self.last_resync: dict | None = None  # guarded-by: _sync_lock (writes)
         # LSH candidate subsampling (CPU-parity approximation; the TPU path
         # scores everything exactly): built lazily at first query
         self.sample_rate = sample_rate
         self._num_cores = num_cores
         self._lsh_max_bits = lsh_max_bits_differing
-        self._lsh = None
+        self._lsh = None  # guarded-by: _sync_lock (writes)
         # (ids, parts, version, _LshPartitions) — no flat matrix copy: the
         # partition blocks inside _LshPartitions are the snapshot
-        self._partition_view: tuple | None = None
-        self._partition_built_at = 0.0
+        self._partition_view: tuple | None = None  # guarded-by: _sync_lock (writes)
+        self._partition_built_at = 0.0  # guarded-by: _sync_lock (writes)
         # Host LSH scoring gates on a core-sized semaphore: each request
         # gathers an O(sample_rate·N·F) candidate matrix, and unbounded
         # dispatch-pool concurrency multiplies that working set by the
@@ -318,7 +320,7 @@ class ALSServingModel(ServingModel):
                     )
         return self._lsh
 
-    def _build_partition_view(self) -> tuple:
+    def _build_partition_view(self) -> tuple:  # oryxlint: holds=_sync_lock
         """Full LSH re-partition from a store snapshot — O(N.H.F) plus the
         O(N.F) snapshot copy, so its cost is recorded (lsh.rebuild span +
         oryx_lsh_rebuild_seconds): with resyncs in the background this
@@ -467,7 +469,7 @@ class ALSServingModel(ServingModel):
             view = self._build_unit_view(y, ids, version, host_mat)
         return view[0], view[1], view[3], view[4]
 
-    def _build_unit_view(self, y, ids, version, host_mat) -> tuple:
+    def _build_unit_view(self, y, ids, version, host_mat) -> tuple:  # oryxlint: holds=_sync_lock
         """Normalize the device view into the cosine-scoring unit view +
         cached host norms. Call under _sync_lock."""
         from oryx_tpu.ops.transfer import ChunkedMatrix, QuantizedMatrix
@@ -496,7 +498,7 @@ class ALSServingModel(ServingModel):
         self._unit_view = view
         return view
 
-    def _build_views_full(self) -> tuple:
+    def _build_views_full(self) -> tuple:  # oryxlint: holds=_sync_lock
         """Full snapshot rebuild of the device + host scoring views (and
         the unit view, when materialized): the initial load, and the
         fallback when a delta can't serve (drift overflow, capacity
@@ -573,7 +575,7 @@ class ALSServingModel(ServingModel):
 
     # -- background resync --------------------------------------------------
 
-    def _note_resync(self, kind: str, rows: int, n_bytes: int,
+    def _note_resync(self, kind: str, rows: int, n_bytes: int,  # oryxlint: holds=_sync_lock
                      seconds: float, version: int) -> None:
         m_bytes, m_secs, m_total, _ = _sync_metrics()
         m_bytes.inc(n_bytes)
@@ -622,7 +624,7 @@ class ALSServingModel(ServingModel):
         pv = self._partition_view
         return pv is not None and pv[2] != v
 
-    def _resync_loop(self) -> None:
+    def _resync_loop(self) -> None:  # oryxlint: offloop (background resync thread)
         while not self._stop.is_set():
             self._resync_evt.wait(_RESYNC_POLL_S)
             self._resync_evt.clear()
@@ -674,7 +676,7 @@ class ALSServingModel(ServingModel):
                     progress = True
         return progress
 
-    def _try_apply_delta(self, dv: tuple) -> bool:
+    def _try_apply_delta(self, dv: tuple) -> bool:  # oryxlint: holds=_sync_lock
         """Apply a dirty-row delta to the device/host/unit views. Returns
         False when only a full rebuild can serve (drift overflow, growth
         past capacity, arena compaction). Call under _sync_lock. A
@@ -777,7 +779,7 @@ class ALSServingModel(ServingModel):
         )
         return True
 
-    def _try_partition_delta(self, pv: tuple) -> bool:
+    def _try_partition_delta(self, pv: tuple) -> bool:  # oryxlint: holds=_sync_lock
         """Reassign only dirty rows between LSH partitions instead of
         re-partitioning the whole store. Touched partitions get rebuilt
         contiguous blocks; untouched partitions share their arrays with

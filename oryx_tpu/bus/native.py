@@ -72,17 +72,37 @@ def _maybe_build() -> str | None:
         # up-to-date target would make it a no-op; the unique NAME is the
         # concurrency guard, not the inode
         os.unlink(tmp)
+        # WARNFLAGS without -Werror: this OPPORTUNISTIC import-time build
+        # runs on arbitrary operator toolchains, where a future compiler's
+        # new -Wall diagnostic must degrade to the Python fallback loudly
+        # below — not silently lose the native bus. The Makefile's default
+        # keeps -Werror for explicit/CI/sanitize builds, where a human
+        # sees the failure.
         if make is not None and (src_dir / "Makefile").exists():
-            cmd = [make, "-C", str(src_dir), f"SO={os.path.basename(tmp)}"]
+            cmd = [make, "-C", str(src_dir), f"SO={os.path.basename(tmp)}",
+                   "WARNFLAGS=-Wall -Wextra"]
         else:
-            cmd = [gxx, "-O2", "-Wall", "-fPIC", "-std=c++17", "-shared",
-                   "-o", tmp, str(src)]
-        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+            cmd = [gxx, "-O2", "-Wall", "-Wextra", "-fPIC",
+                   "-std=c++17", "-shared", "-o", tmp, str(src)]
+        # never inherit the sanitizer switch here: an ASan-instrumented
+        # auto-build cannot dlopen into this (uninstrumented) process —
+        # the sanitized library is built explicitly by the slow test /
+        # `make sanitize` under its own name and LD_PRELOAD
+        env = {k: v for k, v in os.environ.items() if k != "ORYX_NATIVE_SANITIZE"}
+        proc = subprocess.run(cmd, capture_output=True, timeout=120, env=env)
         if (
             proc.returncode != 0
             or not os.path.exists(tmp)
             or not os.path.getsize(tmp)
         ):
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "native oryxbus auto-build failed (rc=%s); using the "
+                "pure-Python bus paths. stderr tail: %s",
+                proc.returncode,
+                proc.stderr.decode("utf-8", "replace")[-500:],
+            )
             if os.path.exists(tmp):
                 os.unlink(tmp)
             return None

@@ -115,8 +115,8 @@ class Counter:
         # series the metric then renders no sample at all instead of a
         # bogus unlabeled `name 0`
         self.labeled = labeled
-        self._values: dict[tuple, float] = {}
-        self._fns: dict[tuple, object] = {}
+        self._values: dict[tuple, float] = {}  # guarded-by: _lock
+        self._fns: dict[tuple, object] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
@@ -205,8 +205,8 @@ class Gauge:
         self.name = name
         self.help = help
         self.labeled = labeled  # see Counter: suppress the zero-series sample
-        self._values: dict[tuple, float] = {}
-        self._fns: dict[tuple, object] = {}
+        self._values: dict[tuple, float] = {}  # guarded-by: _lock
+        self._fns: dict[tuple, object] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def set(self, value: float, **labels: str) -> None:
@@ -288,12 +288,12 @@ class Histogram:
         self.name = name
         self.help = help
         self.buckets = tuple(sorted(buckets))
-        self._counts: dict[tuple, list[int]] = {}
-        self._sums: dict[tuple, float] = {}
-        self._totals: dict[tuple, int] = {}
+        self._counts: dict[tuple, list[int]] = {}  # guarded-by: _lock
+        self._sums: dict[tuple, float] = {}  # guarded-by: _lock
+        self._totals: dict[tuple, int] = {}  # guarded-by: _lock
         # label-key -> {bucket index (len(buckets) = +Inf): (trace_id,
         # value, unix ts)} — newest observation wins per bucket
-        self._exemplars: dict[tuple, dict[int, tuple[str, float, float]]] = {}
+        self._exemplars: dict[tuple, dict[int, tuple[str, float, float]]] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def observe(
@@ -400,7 +400,7 @@ class MetricsRegistry:
     existing metric (so layer + resource modules can share by name)."""
 
     def __init__(self):
-        self._metrics: dict[str, object] = {}
+        self._metrics: dict[str, object] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def _get_or_create(self, cls, name: str, help: str, **kwargs):

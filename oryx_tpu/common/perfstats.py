@@ -156,8 +156,8 @@ class PerfStats:
         # pruned on every append/read — so the rolling numerator is exact
         # at any dispatch rate. The ring stays as the /debug/profile and
         # records_since substrate.
-        self._win: dict[str, "deque[tuple[float, float]]"] = {}
-        self._win_sum: dict[str, float] = {}
+        self._win: dict[str, "deque[tuple[float, float]]"] = {}  # guarded-by: _win_lock
+        self._win_sum: dict[str, float] = {}  # guarded-by: _win_lock
         self._win_lock = threading.Lock()
         # chip peak FLOP/s per kind; Ellipsis = not yet resolved. An
         # operator-configured assumed peak (oryx.monitoring.perf.
@@ -278,7 +278,7 @@ class PerfStats:
         self._c_fallback.inc(n)
         self._fallback_until[kind] = time.monotonic() + self.window_s
 
-    def _prune_window(self, kind: str, now: float) -> None:
+    def _prune_window(self, kind: str, now: float) -> None:  # oryxlint: holds=_win_lock
         """Drop window entries older than window_s (caller holds
         _win_lock)."""
         dq = self._win.get(kind)

@@ -144,8 +144,14 @@ class Tracer:
     def __init__(self, capacity: int = 2048):
         self.enabled = False
         self.slow_threshold: float | None = None
-        self._buf: list[Span | None] = [None] * max(16, capacity)
-        self._seq = itertools.count()
+        # the ring and its slot counter are REBOUND together (configure's
+        # capacity change, clear) under _cfg_lock so a concurrent
+        # reconfigure can't pair a fresh counter with the old buffer.
+        # Writes-only guarding: slot writes in _record and snapshot reads
+        # bind the list locally and are seq-claimed lock-free by design.
+        self._cfg_lock = threading.Lock()
+        self._buf: list[Span | None] = [None] * max(16, capacity)  # guarded-by: _cfg_lock (writes)
+        self._seq = itertools.count()  # guarded-by: _cfg_lock (writes)
 
     @property
     def capacity(self) -> int:
@@ -158,8 +164,9 @@ class Tracer:
         slow_threshold: float | None | type(...) = ...,
     ) -> None:
         if capacity is not None and capacity != len(self._buf):
-            self._buf = [None] * max(16, capacity)
-            self._seq = itertools.count()
+            with self._cfg_lock:
+                self._buf = [None] * max(16, capacity)
+                self._seq = itertools.count()
         if enabled is not None:
             self.enabled = bool(enabled)
         if slow_threshold is not ...:
@@ -239,7 +246,8 @@ class Tracer:
         return spans
 
     def clear(self) -> None:
-        self._buf = [None] * len(self._buf)
+        with self._cfg_lock:
+            self._buf = [None] * len(self._buf)
 
     # -- slow-request log --------------------------------------------------
 

@@ -210,8 +210,8 @@ class FleetFront(AsyncHTTPServer):
             (r.id for r in self.replicas),
             vnodes=config.get_int("oryx.fleet.front.vnodes", 64),
         )
-        self._rr = 0
         self._rr_lock = threading.Lock()
+        self._rr = 0  # guarded-by: _rr_lock
         # keep-alive connection pool, keyed per (event loop, replica):
         # asyncio streams are loop-bound, so loops never share sockets
         self._pools: dict[tuple[int, str], list] = {}
@@ -307,14 +307,16 @@ class FleetFront(AsyncHTTPServer):
 
     # -- health probing / ejection ----------------------------------------
 
-    def _probe_loop(self) -> None:
+    def _probe_loop(self) -> None:  # oryxlint: offloop (prober thread)
         while not self._prober_stop.is_set():
             for r in self.replicas:
                 self._probe_one(r)
             self._update_skew()
             self._prober_stop.wait(self.probe_interval)
 
-    def _probe_one(self, r: ReplicaInfo) -> None:
+    # blocking http.client exchanges are legal here because the prober is
+    # a dedicated thread — never one of the front's event loops
+    def _probe_one(self, r: ReplicaInfo) -> None:  # oryxlint: offloop (prober thread)
         import http.client
 
         status, body = 0, {}

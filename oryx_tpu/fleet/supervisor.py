@@ -125,16 +125,21 @@ class FleetSupervisor:
         self.env = dict(env if env is not None else os.environ)
         self._stdout = stdout
         self._stderr = stderr
-        self.procs: list[subprocess.Popen | None] = [None] * len(self.overlays)
-        self._spawned_at: list[float] = [0.0] * len(self.overlays)
+        # one lock serializes process-table mutation: poll()'s restart
+        # pass, kill()'s chaos signal, and stop()'s teardown all touch
+        # procs[i] from different threads, and an unserialized poll could
+        # even respawn a replica stop() had just terminated
+        self._op_lock = threading.Lock()
+        self.procs: list[subprocess.Popen | None] = [None] * len(self.overlays)  # guarded-by: _op_lock
+        self._spawned_at: list[float] = [0.0] * len(self.overlays)  # guarded-by: _op_lock
         # a death is CLASSIFIED (fast-fail accounting, backoff growth)
         # exactly once, when first observed — a corpse waiting out its
         # restart backoff must not be re-counted by every poll() tick, or
         # crash-loop detection counts supervision ticks instead of deaths
-        self._death_counted: list[bool] = [False] * len(self.overlays)
-        self._fast_fails = 0
-        self._backoff = 1.0
-        self._next_restart = 0.0
+        self._death_counted: list[bool] = [False] * len(self.overlays)  # guarded-by: _op_lock
+        self._fast_fails = 0  # guarded-by: _op_lock
+        self._backoff = 1.0  # guarded-by: _op_lock
+        self._next_restart = 0.0  # guarded-by: _op_lock
         self.crash_looping = False
         self._stopping = threading.Event()
 
@@ -152,7 +157,7 @@ class FleetSupervisor:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def _spawn(self, i: int) -> subprocess.Popen:
+    def _spawn(self, i: int) -> subprocess.Popen:  # oryxlint: holds=_op_lock
         prefix = self.exec_prefixes[i] if self.exec_prefixes else []
         cmd = [*prefix, sys.executable, "-m", "oryx_tpu.cli", "serving", *self.argv]
         for k, v in self.overlays[i].items():
@@ -170,8 +175,9 @@ class FleetSupervisor:
         return p
 
     def start(self) -> None:
-        for i in range(len(self.overlays)):
-            self.procs[i] = self._spawn(i)
+        with self._op_lock:
+            for i in range(len(self.overlays)):
+                self.procs[i] = self._spawn(i)
 
     def wait_listening(self, timeout: float = 90.0) -> None:
         """Block until every replica answers ``HEAD /healthz`` (pure
@@ -189,7 +195,8 @@ class FleetSupervisor:
                     f"{sorted(self.ports()[i] for i in pending)}"
                 )
             for i in sorted(pending):
-                p = self.procs[i]
+                with self._op_lock:
+                    p = self.procs[i]
                 if p is not None and p.poll() is not None:
                     raise RuntimeError(
                         f"replica {i} exited rc={p.returncode} before "
@@ -212,7 +219,14 @@ class FleetSupervisor:
 
     def poll(self) -> None:
         """One supervision pass: restart dead replicas (with backoff),
-        flag a crash loop. Call periodically, or let run() do it."""
+        flag a crash loop. Call periodically, or let run() do it. The
+        whole pass holds _op_lock so a concurrent stop() cannot terminate
+        the fleet between the death check and a respawn (the respawned
+        replica would be orphaned past stop's terminate loop)."""
+        with self._op_lock:
+            self._poll_locked()
+
+    def _poll_locked(self) -> None:  # oryxlint: holds=_op_lock
         if self._stopping.is_set() or not self.restart or self.crash_looping:
             return
         now = time.monotonic()
@@ -265,16 +279,21 @@ class FleetSupervisor:
         """Kill one replica (the chaos hook: ``fleet-kill`` sends SIGKILL
         mid update-storm). The next poll() restarts it unless restarts
         are off or stop() was called."""
-        p = self.procs[i]
+        with self._op_lock:
+            p = self.procs[i]
         if p is not None and p.poll() is None:
             p.send_signal(sig)
 
     def stop(self, timeout: float = 15.0) -> None:
         self._stopping.set()
-        for p in self.procs:
+        # _stopping is set, so no further poll() can spawn; snapshot the
+        # final process table under the lock, then wait outside it
+        with self._op_lock:
+            procs = list(self.procs)
+        for p in procs:
             if p is not None and p.poll() is None:
                 p.terminate()
-        for p in self.procs:
+        for p in procs:
             if p is None:
                 continue
             try:

@@ -227,7 +227,7 @@ def _scatter_donated(buf, rows, idx):
     return buf.at[idx].set(rows, mode="drop")
 
 
-def scatter_rows(buf, idx: np.ndarray, rows: np.ndarray, *, donate: bool = False):
+def scatter_rows(buf, idx: np.ndarray, rows: np.ndarray, *, donate: bool = False):  # oryxlint: donates=0 when donate
     """Write ``rows`` into device matrix ``buf`` at row indices ``idx``,
     returning the updated committed device array. Only the (bucket-padded)
     delta rows cross the host->device link; out-of-range pad indices drop

@@ -294,43 +294,46 @@ class TopKBatcher:
         self.compile_timeout = compile_timeout
         self.max_queue = max_queue
         self.retry_after_sec = retry_after_sec
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
         # dispatch shapes that have completed at least once: their XLA
         # compiles are done, so the wedge watchdog needs no compile grace
-        self._compiled_shapes: set[tuple] = set()
+        self._compiled_shapes: set[tuple] = set()  # guarded-by: _lock
         # shape_key -> grace deadline for NEVER-COMPILED shapes currently
         # in flight: entries are added at dispatch, removed when the
         # dispatch resolves, and cleared on failover — so grace exists
         # exactly while a cold compile may legitimately be running, and a
         # wedge on an already-compiled shape still trips at device_timeout
-        self._compiling: dict[tuple, float] = {}
+        self._compiling: dict[tuple, float] = {}  # guarded-by: _lock
         self._on_accel = False
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
-        self._queue: list[_Pending] = []
-        self._thread: threading.Thread | None = None
-        self._closed = False
+        self._queue: list[_Pending] = []  # guarded-by: _lock
+        self._thread: threading.Thread | None = None  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
         # watchdog state: _busy_since marks the start of the dispatcher's
         # current device cycle; _inflight holds every request the (possibly
         # wedged) dispatcher owns so the watchdog can fail them over
-        self._busy_since: float | None = None
-        self._inflight: dict[int, _Pending] = {}
+        self._busy_since: float | None = None  # guarded-by: _lock
+        self._inflight: dict[int, _Pending] = {}  # guarded-by: _lock
         self._device_down = threading.Event()
-        self._watchdog: threading.Thread | None = None
-        self._probe_at = 0.0
-        self._probing = False
-        self._probe_started = 0.0
-        self._last_y = None
+        self._watchdog: threading.Thread | None = None  # guarded-by: _lock
+        self._probe_at = 0.0  # guarded-by: _lock
+        self._probing = False  # guarded-by: _lock
+        self._probe_started = 0.0  # guarded-by: _lock
+        self._last_y = None  # guarded-by: _lock
         # observability: dispatch count + coalesced-request count let a
         # /metrics scrape compute the achieved mean batch size;
-        # host_fallbacks counts requests actually scored on the host
-        self.dispatches = 0
-        self.coalesced = 0
-        self.host_fallbacks = 0
-        self.device_failovers = 0
+        # host_fallbacks counts requests actually scored on the host.
+        # Counters are writes-guarded: scrape-path reads of a monotonic
+        # int are advisory by design, but concurrent unlocked increments
+        # (a superseded dispatcher racing its replacement) lose updates.
+        self.dispatches = 0  # guarded-by: _lock (writes)
+        self.coalesced = 0  # guarded-by: _lock (writes)
+        self.host_fallbacks = 0  # guarded-by: _lock (writes)
+        self.device_failovers = 0  # guarded-by: _lock (writes)
         # analytic FLOPs dispatched to the device (2·B·I·F per group,
         # ops/flops.py): rate(oryx_topk_flops_total) / oryx_device_peak_flops
         # is the serving MFU over any scrape interval
-        self.flops_scored = 0.0
+        self.flops_scored = 0.0  # guarded-by: _lock (writes)
         self._peak_flops = ...  # Ellipsis = not yet resolved (see _note_device)
         # tpu device_kind captured once at first dispatch; per-dtype peak
         # cache so a quantized (int8) dispatch divides by the int8 peak,
@@ -371,7 +374,11 @@ class TopKBatcher:
             ("oryx_topk_queue_depth",
              "requests waiting for a device dispatch right now; at "
              "oryx.serving.api.shed.max-queue new submits shed with 503",
-             lambda: float(len(self._queue))),
+             # len() is one GIL-atomic read and the depth gauge is
+             # advisory; taking the dispatch lock on every scrape would
+             # contend with the hot path for a number that is stale the
+             # moment it renders
+             lambda: float(len(self._queue))),  # oryxlint: disable=guarded-by
             ("oryx_topk_flops_total",
              "analytic FLOPs dispatched to device top-k scoring "
              "(rate over oryx_device_peak_flops = serving MFU)",
@@ -531,27 +538,29 @@ class TopKBatcher:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-        self._last_y = None
+            t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+        with self._lock:
+            self._last_y = None
 
     # -- dispatcher --------------------------------------------------------
 
-    def _ensure_thread(self) -> None:
+    def _ensure_thread(self) -> None:  # oryxlint: holds=_lock
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
                 target=self._run, name="oryx-topk-batcher", daemon=True
             )
             self._thread.start()
 
-    def _ensure_watchdog(self) -> None:
+    def _ensure_watchdog(self) -> None:  # oryxlint: holds=_lock
         if self._watchdog is None or not self._watchdog.is_alive():
             self._watchdog = threading.Thread(
                 target=self._watch, name="oryx-topk-watchdog", daemon=True
             )
             self._watchdog.start()
 
-    def _run(self) -> None:
+    def _run(self) -> None:  # oryxlint: offloop (dedicated dispatcher thread)
         # Depth-1 pipeline: launch batch N+1's device work (with async
         # device->host copies) BEFORE materializing batch N's results. A
         # blocking fetch without a prior copy_to_host_async costs a full
@@ -628,8 +637,12 @@ class TopKBatcher:
             kb = min(k_bucket(p.k), n)
             groups.setdefault((id(p.y), kb, p.recall), []).append(p)
 
-        self.dispatches += len(groups)
-        self.coalesced += len(batch)
+        # under the lock: a wedged-then-unwedged dispatcher can overlap
+        # its replacement here, and unlocked += loses updates
+        # [oryxlint guarded-by fix]
+        with self._lock:
+            self.dispatches += len(groups)
+            self.coalesced += len(batch)
 
         launched = []
         for (_, kb, recall), group in groups.items():
@@ -640,14 +653,12 @@ class TopKBatcher:
                 faults.fire("serving.device")
                 t0 = time.monotonic()
                 y = group[0].y
-                self._last_y = y  # recovery probes re-test against this
                 b = len(group)
                 # a capacity-padded serving view scores zero rows past
                 # valid_rows — they're HBM-cheap but not useful FLOPs, so
                 # the MFU figure counts only the real-data prefix
                 n_rows = group[0].valid_rows or y.shape[0]
                 group_flops = 2.0 * b * n_rows * y.shape[1]
-                self.flops_scored += group_flops
                 self._note_device(y)
                 # per-dtype peak: a quantized (int8) dispatch's MFU window
                 # divides by the int8 peak, an exact bf16 one by bf16
@@ -660,14 +671,19 @@ class TopKBatcher:
                     padded, kb, recall, tuple(y.shape),
                     str(getattr(y, "dtype", "")),
                 )
-                if shape_key not in self._compiled_shapes:
-                    # first dispatch of this shape may cold-compile for
-                    # minutes over a remote-compile tunnel: give the wedge
-                    # watchdog compile grace (for THIS shape, until it
-                    # resolves) so it doesn't misread the compile as a
-                    # wedged transport and permanently fail the device
-                    # path over to host scoring
-                    with self._cond:
+                with self._cond:
+                    # recovery probes re-test against the latest matrix;
+                    # the probe thread reads it under the same lock
+                    # [oryxlint guarded-by fix: these three were unlocked]
+                    self._last_y = y
+                    self.flops_scored += group_flops
+                    if shape_key not in self._compiled_shapes:
+                        # first dispatch of this shape may cold-compile for
+                        # minutes over a remote-compile tunnel: give the
+                        # wedge watchdog compile grace (for THIS shape,
+                        # until it resolves) so it doesn't misread the
+                        # compile as a wedged transport and permanently
+                        # fail the device path over to host scoring
                         self._compiling[shape_key] = (
                             time.monotonic() + self.compile_timeout
                         )
@@ -758,12 +774,13 @@ class TopKBatcher:
                 t_start=t0, score_mode=mode,
             )
             # the dispatch completed, so this shape's compile is done:
-            # drop its grace window and never grant it one again. Pop
+            # drop its grace window and never grant it one again. Both
             # under the lock — the watchdog iterates _compiling.values()
-            # holding it, and an unlocked pop mid-iteration kills the
-            # watchdog thread with RuntimeError
-            self._compiled_shapes.add(shape_key)
+            # holding it (an unlocked pop mid-iteration kills the watchdog
+            # thread with RuntimeError), and _launch's membership probe of
+            # _compiled_shapes reads under it too
             with self._cond:
+                self._compiled_shapes.add(shape_key)
                 self._compiling.pop(shape_key, None)
             for i, p in enumerate(group):
                 k_eff = min(p.k, kb)
@@ -785,7 +802,7 @@ class TopKBatcher:
 
     # -- watchdog: wedged-transport failover -------------------------------
 
-    def _watch(self) -> None:
+    def _watch(self) -> None:  # oryxlint: offloop (watchdog thread)
         while True:
             time.sleep(min(1.0, self.device_timeout / 4))
             with self._cond:
@@ -877,7 +894,7 @@ class TopKBatcher:
             self._probe_started = time.monotonic()
             y = self._last_y
 
-        def probe() -> None:
+        def probe() -> None:  # oryxlint: offloop (disposable probe thread)
             ok = False
             try:
                 from oryx_tpu.ops.als import topk_dot_batch

@@ -158,7 +158,10 @@ class ServingLayer:
         self._lag_sample: int | None = None
         self._lag_stop = threading.Event()
 
-        def sample_lag() -> None:
+        # the offloop proof: .lag() is broker I/O (the PR 7 bug class —
+        # blocking calls on the probe path), legal here only because this
+        # closure runs on the dedicated sampler thread below
+        def sample_lag() -> None:  # oryxlint: offloop (lag sampler thread)
             while not self._lag_stop.is_set():
                 try:
                     self._lag_sample = self._update_consumer.lag()

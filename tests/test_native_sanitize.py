@@ -1,0 +1,161 @@
+"""ASan+UBSan build of native/oryxbus, exercised over the native test
+corpus (slow; accel/nightly tier).
+
+The native appender/scanner/parser is the one component where a memory
+bug corrupts persisted history silently instead of raising — so its test
+corpus (appends, batch appends, boundary scans over torn writes, the CSV
+interaction parser's edge lines, CRC32C) runs under an
+``-fsanitize=address,undefined -fno-sanitize-recover=all`` build
+(``ORYX_NATIVE_SANITIZE=1`` in native/oryxbus/Makefile). Any sanitizer
+finding aborts the child process and fails the test.
+
+The instrumented .so loads into a stock python via LD_PRELOAD of the
+asan runtime; leak detection is off (CPython itself "leaks" by ASan's
+accounting), every other check is fatal.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+SRC_DIR = ROOT / "native" / "oryxbus"
+
+pytestmark = pytest.mark.slow
+
+
+def _toolchain():
+    gxx = shutil.which("g++") or shutil.which("c++")
+    make = shutil.which("make")
+    if gxx is None or make is None:
+        pytest.skip("no native toolchain")
+    asan = subprocess.run(
+        [gxx, "-print-file-name=libasan.so"], capture_output=True, text=True
+    ).stdout.strip()
+    if not asan or not os.path.isabs(asan) or not Path(asan).exists():
+        pytest.skip("libasan runtime not available")
+    return gxx, make, asan
+
+
+# The corpus the sanitized library is driven through — the same surface
+# tests/test_bus.py exercises, plus the parser edge lines that stress
+# bounds (overlong float tokens, missing trailing newline, torn records).
+_CORPUS = r"""
+import ctypes, os, struct, sys
+
+sys.path.insert(0, sys.argv[1])
+log_path = sys.argv[2]
+
+from oryx_tpu.bus.native import NativeAppender
+
+nat = NativeAppender.load()
+
+# -- append / append_batch -------------------------------------------------
+nat.append(log_path, "key1", "native message")
+nat.append(log_path, None, "null-key message")
+nat.append(log_path, "k", "")  # empty message body
+
+batch = b""
+for i in range(64):
+    k = f"bk{i}".encode(); m = (f"batch message {i}" * (i % 5 + 1)).encode()
+    batch += struct.pack("<i", len(k)) + k + struct.pack("<I", len(m)) + m
+nat.append_batch(log_path, batch)
+
+# -- scan (complete log, then a torn trailing write) -----------------------
+pos, scanned = nat.scan(log_path, 0)
+assert len(pos) == 3 + 64, len(pos)
+size = os.path.getsize(log_path)
+assert scanned == size, (scanned, size)
+with open(log_path, "ab") as f:
+    f.write(struct.pack("<i", 4) + b"ke")  # torn record: stop cleanly
+pos2, scanned2 = nat.scan(log_path, 0)
+assert len(pos2) == len(pos) and scanned2 == size
+pos3, _ = nat.scan(log_path, 0, max_records=5)
+assert len(pos3) == 5
+
+# -- interaction parser edge lines ----------------------------------------
+lines = [
+    b"1,2",                       # minimal
+    b"3,4,5.5",                   # strength
+    b"6,7,,",                     # empty strength = NaN delete marker
+    b"8,9,1.0,1700000000.25",     # float ts
+    b"07,9",                      # non-canonical id -> ok=0
+    b"-0,9",                      # non-canonical -0 -> ok=0
+    b'["json","line"]',           # JSON form -> ok=0
+    b'"q",1',                     # quoted CSV -> ok=0
+    b"10,11," + b"9" * 100,       # >63-char numeric token -> ok=0
+    b"12,13," + b"1" * 63,        # 63-char token: exact tmp-buffer edge
+    b"",                          # blank: no row
+    b"  14,15,2.0  \r",           # trimmed whitespace + CR
+    b"99999999999999999999,1",    # >18 digits: overflow guard -> ok=0
+]
+buf = b"\n".join(lines) + b"\n16,17"  # final line without newline
+users, items, vals, tss, ok = nat.parse_interactions(buf)
+rows = [ln for ln in lines if ln.strip()] + [b"16,17"]
+assert len(users) == len(rows), (len(users), len(rows))
+good = {(1, 2), (3, 4), (6, 7), (8, 9), (14, 15), (16, 17), (12, 13)}
+parsed = {(int(u), int(it)) for u, it, o in zip(users, items, ok) if o}
+assert good == parsed, parsed
+assert vals[2] != vals[2]  # NaN delete marker survived
+
+# -- crc32c (hw + sw paths share the dispatch entry) -----------------------
+lib = ctypes.CDLL(os.environ["ORYXBUS_LIB"])
+lib.oryxbus_crc32c.restype = ctypes.c_uint32
+lib.oryxbus_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32]
+data = bytes(range(256)) * 33 + b"tail"
+crc = lib.oryxbus_crc32c(data, len(data), 0)
+assert crc == lib.oryxbus_crc32c(data, len(data), 0)
+assert lib.oryxbus_crc32c(b"", 0, 0) == 0
+
+print("sanitized corpus ok")
+"""
+
+
+def test_sanitized_native_corpus(tmp_path):
+    gxx, make, asan = _toolchain()
+    so = tmp_path / "liboryxbus-san.so"
+    build = subprocess.run(
+        [make, "-C", str(SRC_DIR), "ORYX_NATIVE_SANITIZE=1", f"SO={so}"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert build.returncode == 0, build.stdout + build.stderr
+    assert so.exists() and so.stat().st_size > 0
+
+    script = tmp_path / "corpus.py"
+    script.write_text(_CORPUS, encoding="utf-8")
+    env = dict(os.environ)
+    env.update({
+        "LD_PRELOAD": asan,
+        "ORYXBUS_LIB": str(so),
+        # leaks off: CPython interns/arenas read as leaks to ASan; every
+        # other check stays fatal via -fno-sanitize-recover
+        "ASAN_OPTIONS": "detect_leaks=0",
+        "UBSAN_OPTIONS": "print_stacktrace=1",
+        "JAX_PLATFORMS": "cpu",
+    })
+    proc = subprocess.run(
+        [sys.executable, str(script), str(ROOT), str(tmp_path / "p0.log")],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "sanitized corpus ok" in proc.stdout
+
+
+def test_default_build_is_warning_clean(tmp_path):
+    """The default (unsanitized) build compiles clean under the Makefile's
+    -Wall -Wextra -Werror default — warnings stop accumulating."""
+    gxx, make, _asan = _toolchain()
+    so = tmp_path / "liboryxbus.so"
+    build = subprocess.run(
+        [make, "-C", str(SRC_DIR), f"SO={so}"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert build.returncode == 0, build.stdout + build.stderr
+    assert "-Werror" in build.stdout
+    assert so.exists() and so.stat().st_size > 0

@@ -1,0 +1,503 @@
+"""oryxlint: per-rule positive/negative fixtures + the tier-1 whole-tree
+gate (zero unsuppressed findings on the current tree).
+
+Each checker is proven in both directions: a small fixture snippet that
+MUST produce the finding, and the adjacent compliant form that must not.
+The whole-tree run is the ratchet — new code that blocks an event loop,
+touches guarded state without its lock, side-effects inside a jitted
+function, or drifts config/metric/ratchet vocabulary fails tier-1.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+from tools.oryxlint.core import Project, run_lint  # noqa: E402
+from tools.oryxlint.checkers.eventloop import EventLoopChecker  # noqa: E402
+from tools.oryxlint.checkers.jaxpurity import JaxPurityChecker  # noqa: E402
+from tools.oryxlint.checkers.lockdiscipline import LockDisciplineChecker  # noqa: E402
+
+
+def _lint_fixture(tmp_path, source: str, checkers) -> tuple[list, list]:
+    pkg = tmp_path / "oryx_tpu"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "mod.py").write_text(textwrap.dedent(source), encoding="utf-8")
+    return run_lint(tmp_path, checkers=checkers)
+
+
+def _rules(findings) -> list[str]:
+    return [f.rule for f in findings]
+
+
+# -- event-loop blocking-call detector ---------------------------------------
+
+
+def test_blocking_call_in_async_def_caught(tmp_path):
+    active, _ = _lint_fixture(tmp_path, """
+        import time
+
+        async def handler():
+            time.sleep(1)
+    """, [EventLoopChecker()])
+    assert _rules(active) == ["blocking-call-on-loop"]
+    assert "time.sleep" in active[0].message
+
+
+def test_blocking_call_reached_transitively(tmp_path):
+    active, _ = _lint_fixture(tmp_path, """
+        import subprocess
+
+        def helper():
+            subprocess.run(["true"])
+
+        async def handler():
+            helper()
+    """, [EventLoopChecker()])
+    assert _rules(active) == ["blocking-call-on-loop"]
+    assert "handler -> helper" in active[0].message
+
+
+def test_nonblocking_route_handler_is_a_root(tmp_path):
+    active, _ = _lint_fixture(tmp_path, """
+        def register(app):
+            @app.route("GET", "/x", nonblocking=True)
+            def handler(a, req):
+                a.input_producer.send("k", "line")
+
+            @app.route("POST", "/y")
+            def worker_handler(a, req):
+                a.input_producer.send("k", "line")  # worker pool: legal
+    """, [EventLoopChecker()])
+    assert len(active) == 1
+    assert active[0].rule == "blocking-call-on-loop"
+    assert "producer" in active[0].message
+
+
+def test_offloop_annotation_honored(tmp_path):
+    active, _ = _lint_fixture(tmp_path, """
+        import time
+
+        def sampler():  # oryxlint: offloop (dedicated thread)
+            time.sleep(2)
+
+        async def handler():
+            sampler()
+    """, [EventLoopChecker()])
+    assert active == []
+
+
+# -- lock discipline ----------------------------------------------------------
+
+
+_LOCK_FIXTURE = """
+    import threading
+
+
+    class Shared:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+            self.n = 0  # guarded-by: _lock
+            self.view = None  # guarded-by: _lock (writes)
+
+        def locked_write(self):
+            with self._lock:
+                self.n += 1
+
+        def cond_alias_write(self):
+            with self._cond:
+                self.n += 1
+
+        def lockfree_snapshot_read(self):
+            return self.view
+
+        def contract(self):  # oryxlint: holds=_lock
+            return self.n
+"""
+
+
+def test_with_lock_and_alias_and_writes_qualifier_pass(tmp_path):
+    active, _ = _lint_fixture(tmp_path, _LOCK_FIXTURE, [LockDisciplineChecker()])
+    assert active == []
+
+
+def test_guarded_by_violation_caught(tmp_path):
+    active, _ = _lint_fixture(tmp_path, _LOCK_FIXTURE + """
+        def racy(self):
+            self.n += 1
+
+    Shared.racy = racy
+    """, [LockDisciplineChecker()])
+    # note: module-level function attached post-hoc is outside the class —
+    # the in-class violation form is what we assert on below
+    active2, _ = _lint_fixture(tmp_path, _LOCK_FIXTURE.replace(
+        "def contract(self):  # oryxlint: holds=_lock",
+        "def racy(self):\n            self.n += 1\n\n        def contract(self):  # oryxlint: holds=_lock",
+    ), [LockDisciplineChecker()])
+    assert _rules(active2) == ["guarded-by"]
+    assert "self.n" in active2[0].message
+
+
+def test_closure_does_not_inherit_held_lock(tmp_path):
+    active, _ = _lint_fixture(tmp_path, _LOCK_FIXTURE.replace(
+        "def contract(self):  # oryxlint: holds=_lock",
+        "def leak(self):\n"
+        "            with self._lock:\n"
+        "                return lambda: self.n\n\n"
+        "        def contract(self):  # oryxlint: holds=_lock",
+    ), [LockDisciplineChecker()])
+    assert _rules(active) == ["guarded-by"]
+
+
+def test_writes_qualifier_still_checks_stores(tmp_path):
+    active, _ = _lint_fixture(tmp_path, _LOCK_FIXTURE.replace(
+        "def contract(self):  # oryxlint: holds=_lock",
+        "def unlocked_swap(self):\n            self.view = ()\n\n"
+        "        def contract(self):  # oryxlint: holds=_lock",
+    ), [LockDisciplineChecker()])
+    assert _rules(active) == ["guarded-by"]
+    assert "self.view" in active[0].message
+
+
+# -- jax purity / donation ----------------------------------------------------
+
+
+def test_jit_side_effect_caught(tmp_path):
+    active, _ = _lint_fixture(tmp_path, """
+        import jax
+
+        @jax.jit
+        def impure(x):
+            print("tracing")
+            return x
+    """, [JaxPurityChecker()])
+    assert _rules(active) == ["jit-side-effect"]
+
+
+def test_jit_closed_over_mutation_and_rng_caught(tmp_path):
+    active, _ = _lint_fixture(tmp_path, """
+        import numpy as np
+        import jax
+
+        hits = []
+
+        @jax.jit
+        def impure(x):
+            hits.append(1)
+            return x + np.random.rand()
+    """, [JaxPurityChecker()])
+    assert sorted(_rules(active)) == ["jit-side-effect", "jit-side-effect"]
+
+
+def test_pure_jit_and_pallas_kernel_pass(tmp_path):
+    active, _ = _lint_fixture(tmp_path, """
+        from functools import partial
+
+        import jax
+        import jax.numpy as jnp
+
+        @partial(jax.jit, static_argnames=("k",))
+        def pure(x, k):
+            local = []
+            local.append(k)  # local mutation is fine
+            return jnp.sum(x) + len(local)
+
+        def _kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2
+
+        def build(pl):
+            return pl.pallas_call(_kernel)
+    """, [JaxPurityChecker()])
+    assert active == []
+
+
+def test_donation_reuse_caught_and_rebind_allowed(tmp_path):
+    active, _ = _lint_fixture(tmp_path, """
+        from functools import partial
+
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def donated(buf, row):
+            return buf + row
+
+        def bug(a, b):
+            out = donated(a, b)
+            return out + a
+
+        def carry_ok(a, b):
+            a = donated(a, b)
+            return a + b
+    """, [JaxPurityChecker()])
+    assert _rules(active) == ["donation-reuse"]
+    assert "'a'" in active[0].message
+
+
+def test_donates_annotation_conditional_wrapper(tmp_path):
+    """`donates=0 when donate` (the scatter_rows contract): reuse after a
+    donate=True call is flagged; the non-donating form is free."""
+    active, _ = _lint_fixture(tmp_path, """
+        def scatter(buf, rows, *, donate=False):  # oryxlint: donates=0 when donate
+            return buf
+
+        def serving_path_bug(view, rows):
+            out = scatter(view, rows, donate=True)
+            return out, view  # in-flight dispatches read a deleted buffer
+
+        def double_buffer_ok(view, rows):
+            out = scatter(view, rows)
+            return out, view
+    """, [JaxPurityChecker()])
+    assert _rules(active) == ["donation-reuse"]
+    assert "'view'" in active[0].message
+
+
+def test_donated_wrapper_assignment_form_detected(tmp_path):
+    active, _ = _lint_fixture(tmp_path, """
+        from functools import partial
+
+        import jax
+
+        def _train(x, y, carry):
+            return carry + x + y
+
+        train_donated = partial(jax.jit, donate_argnums=(2,))(_train)
+
+        def bug(x, y, c):
+            out = train_donated(x, y, c)
+            return out + c
+    """, [JaxPurityChecker()])
+    assert _rules(active) == ["donation-reuse"]
+
+
+# -- suppression syntax -------------------------------------------------------
+
+
+def test_suppression_comment_honored(tmp_path):
+    active, suppressed = _lint_fixture(tmp_path, """
+        import time
+
+        async def handler():
+            time.sleep(1)  # oryxlint: disable=blocking-call-on-loop
+    """, [EventLoopChecker()])
+    assert active == []
+    assert _rules(suppressed) == ["blocking-call-on-loop"]
+
+
+def test_unknown_rule_suppression_rejected(tmp_path):
+    active, _ = _lint_fixture(tmp_path, """
+        x = 1  # oryxlint: disable=no-such-rule
+    """, [EventLoopChecker()])
+    assert _rules(active) == ["unknown-rule"]
+    assert "no-such-rule" in active[0].message
+
+
+def test_unknown_rule_finding_is_not_suppressible(tmp_path):
+    active, _ = _lint_fixture(tmp_path, """
+        x = 1  # oryxlint: disable=unknown-rule,bogus-rule
+    """, [EventLoopChecker()])
+    assert "unknown-rule" in _rules(active)
+
+
+# -- consistency rules through oryxlint ---------------------------------------
+
+
+def test_config_rule_catches_undeclared_key(tmp_path):
+    from tools.oryxlint.checkers import consistency
+
+    ref_dir = tmp_path / "oryx_tpu" / "common"
+    ref_dir.mkdir(parents=True)
+    (ref_dir / "reference.conf").write_text(
+        "oryx { id = \"x\" }\n", encoding="utf-8"
+    )
+    (tmp_path / "oryx_tpu" / "mod.py").write_text(
+        'v = config.get_int("oryx.not.declared", 1)\n', encoding="utf-8"
+    )
+    findings = consistency.config_findings(tmp_path)
+    assert ["config-keys"] == [f.rule for f in findings]
+    assert "oryx.not.declared" in findings[0].message
+
+
+def test_metric_rule_catches_undocumented_name(tmp_path):
+    from tools.oryxlint.checkers import consistency
+
+    (tmp_path / "oryx_tpu").mkdir()
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "oryx_tpu" / "mod.py").write_text(
+        'NAME = "oryx_undocumented_total"\n', encoding="utf-8"
+    )
+    (tmp_path / "docs" / "observability.md").write_text(
+        "| `oryx_ghost_metric` | gone |\nscore_mode\n", encoding="utf-8"
+    )
+    (tmp_path / "bench.py").write_text(
+        '"qps_quantized" "approx_recall_at_10" "quantized_recall_at_10" '
+        '"lsh_measured_recall_at_10"\n', encoding="utf-8"
+    )
+    findings = consistency.metric_findings(tmp_path)
+    msgs = " | ".join(f.message for f in findings)
+    assert "oryx_undocumented_total" in msgs  # code -> docs direction
+    assert "oryx_ghost_metric" in msgs        # docs -> code reverse rule
+
+
+# -- check_bench stale-pending ------------------------------------------------
+
+
+def _bank(tmp_path, name: str, payload: dict) -> None:
+    (tmp_path / name).write_text(json.dumps(payload), encoding="utf-8")
+
+
+def test_stale_pending_fails_once_banked_artifact_measures_it(tmp_path):
+    from tools import check_bench
+
+    rows = [{
+        "name": "qps_quantized", "platform": "tpu", "baseline": 1.0,
+        "direction": "up", "pending": True, "pending_since": 8,
+    }]
+    # artifact OLDER than the declaration: flag is legitimate
+    _bank(tmp_path, "BENCH_TPU_WINDOW_r05.json",
+          {"final": {"platform": "tpu", "qps_quantized": 5.0}})
+    assert check_bench.stale_pending_problems(rows, root=str(tmp_path)) == []
+    # artifact from the declaring round or later measuring it: stale
+    _bank(tmp_path, "BENCH_TPU_WINDOW_r09.json",
+          {"final": {"platform": "tpu", "qps_quantized": 5.0}})
+    problems = check_bench.stale_pending_problems(rows, root=str(tmp_path))
+    assert len(problems) == 1 and "remove the pending flag" in problems[0]
+
+
+def test_stale_pending_reads_parsed_shape_round_artifacts(tmp_path):
+    """Driver round artifacts (BENCH_r{N}.json) nest their metrics under
+    a `parsed` key — the scan must see them, or a CPU pending row could
+    float forever."""
+    from tools import check_bench
+
+    rows = [{
+        "name": "some_cpu_metric", "platform": "cpu", "baseline": 1.0,
+        "direction": "up", "pending": True, "pending_since": 8,
+    }]
+    _bank(tmp_path, "BENCH_r09.json", {
+        "n": 9, "rc": 0,
+        "parsed": {"platform": "cpu", "some_cpu_metric": 2.5},
+    })
+    problems = check_bench.stale_pending_problems(rows, root=str(tmp_path))
+    assert len(problems) == 1 and "round-9 cpu artifact" in problems[0]
+
+
+def test_stale_pending_tolerates_malformed_rows(tmp_path):
+    """A nameless pending row (already reported by the vocabulary check)
+    or an unparseable pending_since must degrade, not traceback."""
+    from tools import check_bench
+
+    _bank(tmp_path, "BENCH_TPU_WINDOW_r09.json",
+          {"final": {"platform": "tpu", "x": 1.0}})
+    rows = [
+        {"pending": True},  # nameless
+        {"name": "x", "platform": "tpu", "baseline": 1.0, "direction": "up",
+         "pending": True, "pending_since": "not-a-round"},
+    ]
+    problems = check_bench.stale_pending_problems(rows, root=str(tmp_path))
+    # nameless row skipped; bad since falls back to the strict reading
+    assert len(problems) == 1 and problems[0].startswith("x:")
+
+
+def test_pending_survives_artifacts_that_do_not_measure_it(tmp_path):
+    from tools import check_bench
+
+    rows = [{
+        "name": "qps_quantized", "platform": "tpu", "baseline": 1.0,
+        "direction": "up", "pending": True, "pending_since": 8,
+    }]
+    # right platform, metric absent
+    _bank(tmp_path, "BENCH_TPU_WINDOW_r09.json", {"final": {"platform": "tpu"}})
+    # wrong platform, metric present
+    _bank(tmp_path, "BENCH_r10.json",
+          {"final": {"platform": "cpu", "qps_quantized": 5.0}})
+    assert check_bench.stale_pending_problems(rows, root=str(tmp_path)) == []
+
+
+def test_committed_ratchet_has_no_stale_pending_rows():
+    from tools import check_bench
+
+    metrics = check_bench.load_baseline(str(ROOT / "BASELINE_RATCHET.json"))
+    assert check_bench.stale_pending_problems(metrics, root=str(ROOT)) == []
+    for m in metrics:
+        if m.get("pending"):
+            assert "pending_since" in m, (
+                f"{m['name']}: pending rows must record the declaring round"
+            )
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def test_cli_json_and_changed_modes():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.oryxlint", "--json"],
+        cwd=ROOT, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["findings"] == []
+    assert "blocking-call-on-loop" in doc["rules"]
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.oryxlint", "--list-rules"],
+        cwd=ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+    for rule in ("guarded-by", "jit-side-effect", "donation-reuse",
+                 "config-keys", "metric-docs", "bench-ratchet"):
+        assert rule in proc.stdout
+
+
+# -- the tier-1 whole-tree gate ----------------------------------------------
+
+
+def test_whole_tree_is_clean():
+    """`python -m tools.oryxlint` on the tree: zero unsuppressed findings.
+
+    This is the ratchet the new checkers hold: event-loop discipline,
+    guarded-by lock discipline, jit purity/donation, and the
+    config/metric/ratchet consistency contracts, all at once. Suppressed
+    findings are allowed (each carries an in-source justification), but
+    every suppression must name a real rule (unknown-rule is active)."""
+    active, suppressed = run_lint(ROOT)
+    rendered = "\n".join(f.render() for f in active)
+    assert active == [], f"oryxlint findings on the tree:\n{rendered}"
+    # the tree currently carries a known, justified suppression budget;
+    # growing it should be a conscious review decision, not drift
+    assert len(suppressed) <= 8, [f.render() for f in suppressed]
+
+
+def test_production_annotations_are_load_bearing():
+    """The annotation seeding is real, not decorative: the threaded core
+    declares guarded attributes, holds-contracts, and offloop proofs the
+    checkers actually consume."""
+    project = Project.load(ROOT)
+    by_path = {m.relpath: m for m in project.modules}
+    guarded_files = [
+        "oryx_tpu/common/metrics.py",
+        "oryx_tpu/common/perfstats.py",
+        "oryx_tpu/common/tracing.py",
+        "oryx_tpu/serving/batcher.py",
+        "oryx_tpu/fleet/front.py",
+        "oryx_tpu/fleet/supervisor.py",
+        "oryx_tpu/apps/als/serving.py",
+    ]
+    for rel in guarded_files:
+        assert by_path[rel].guarded_lines, f"{rel}: no guarded-by seeds"
+    assert by_path["oryx_tpu/serving/server.py"].offloop_lines, (
+        "the lag-sampler offloop proof (PR 7 bug class) is gone"
+    )
+    assert by_path["oryx_tpu/apps/als/serving.py"].holds_lines, (
+        "the 'call under _sync_lock' contracts lost their holds= form"
+    )

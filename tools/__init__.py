@@ -1,0 +1,7 @@
+"""Repo tooling package (enables ``python -m tools.oryxlint`` and friends).
+
+The scripts in this directory remain directly runnable
+(``python tools/check_config.py``); this package init exists so the
+oryxlint static-analysis framework can be invoked as a module and
+imported by tests.
+"""

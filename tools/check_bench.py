@@ -34,6 +34,13 @@ must keep accepting the previously banked artifacts. The PR that banks
 the first artifact measuring a pending row REMOVES the flag (and
 corrects the baseline to the measured number), at which point the row
 enforces like any other.
+
+A pending row also records ``"pending_since": <round>`` — the bench
+round at which the bar was declared. ``stale_pending_problems`` (run by
+this CLI and by the oryxlint ``bench-ratchet`` rule) fails a pending row
+once a banked artifact of the row's platform from that round or later
+MEASURES the metric: the flag has outlived its purpose, and keeping it
+would let the bar float unenforced forever.
 """
 
 from __future__ import annotations
@@ -102,6 +109,88 @@ def extract_current(raw: str) -> dict:
             return final["detail"]
         return final
     raise SystemExit("no parseable JSON metrics found in the current input")
+
+
+def banked_artifacts(root: str = ROOT) -> list[tuple[int, str, dict]]:
+    """(round, platform, metric dict) for every banked bench artifact:
+    ``BENCH_TPU_WINDOW_r{N}.json`` and ``BENCH_r{N}.json``. Unparseable
+    files are skipped — a stale-pending verdict must rest on artifacts
+    that actually decode."""
+    import glob
+    import re
+
+    out: list[tuple[int, str, dict]] = []
+    for path in sorted(
+        glob.glob(os.path.join(root, "BENCH_TPU_WINDOW_r*.json"))
+        + glob.glob(os.path.join(root, "BENCH_r*.json"))
+    ):
+        m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        # banked artifact shapes: window artifacts wrap {final, detail}
+        # (detail carries the full vocabulary; final alone still counts —
+        # bank_window.py can bank a detail-less capture), and the driver's
+        # round artifacts nest the same dicts under "parsed"
+        parsed = doc.get("parsed") if isinstance(doc.get("parsed"), dict) else {}
+        current: dict = {}
+        for part in (
+            doc, parsed,
+            doc.get("final"), doc.get("detail"),
+            parsed.get("final"), parsed.get("detail"),
+        ):
+            if isinstance(part, dict):
+                current.update({
+                    k: v for k, v in part.items() if not isinstance(v, dict)
+                })
+        platform = current.get("platform")
+        if isinstance(platform, str):
+            out.append((int(m.group(1)), platform, current))
+    return out
+
+
+def stale_pending_problems(
+    metrics: list[dict], root: str = ROOT
+) -> list[str]:
+    """Pending rows whose flag has outlived a banked artifact of the right
+    platform: an artifact from the row's declaration round or later
+    measures the metric, so the PR that banked it should have removed the
+    flag and locked the measured number. Rows without ``pending_since``
+    are held to the strict reading (any measuring artifact counts)."""
+    problems: list[str] = []
+    artifacts = None
+    for m in metrics:
+        if not m.get("pending") or not m.get("name"):
+            # nameless rows are already reported by the vocabulary check;
+            # crashing here would turn one malformed row into a traceback
+            continue
+        if artifacts is None:
+            artifacts = banked_artifacts(root)
+        try:
+            since = int(m.get("pending_since", 0))
+        except (TypeError, ValueError):
+            since = 0  # unparseable: strict reading, any artifact counts
+        for rnd, platform, current in artifacts:
+            if rnd < since:
+                continue
+            if m.get("platform") and platform != m["platform"]:
+                continue
+            if current.get(m["name"]) is None:
+                continue
+            problems.append(
+                f"{m['name']}: pending (since round {since or '?'}) but the "
+                f"banked round-{rnd} {platform} artifact measures it "
+                f"({current.get(m['name'])!r}) — remove the pending flag "
+                "and lock the measured baseline"
+            )
+            break
+    return problems
 
 
 def check(
@@ -213,8 +302,22 @@ def main(argv: list[str] | None = None) -> int:
         return 2  # unreachable; argparse exits
 
     current = extract_current(raw)
+    # banked artifacts live next to the ratchet file: a tmp-dir baseline
+    # (tests, ad-hoc experiments) is judged against its own directory,
+    # never against this repo's banked windows
+    stale = stale_pending_problems(
+        metrics, root=os.path.dirname(os.path.abspath(args.baseline)) or ROOT
+    )
     rows, failed, checked = check(metrics, current)
     print(render_table(rows))
+    if stale:
+        for p in stale:
+            print(p, file=sys.stderr)
+        print(
+            f"\nRATCHET FAILED: {len(stale)} pending row(s) outlived a "
+            "banked artifact that measures them", file=sys.stderr,
+        )
+        return 1
     if checked == 0:
         print(
             "\nno ratcheted metric applies to this run's platform "

@@ -1,31 +1,28 @@
 #!/usr/bin/env python
-"""Static config-key consistency check (wired as a tier-1 test).
+"""Static config-key consistency check — thin wrapper (DEPRECATED entry
+point; the logic now lives in the oryxlint ``config-keys`` rule,
+tools/oryxlint/checkers/consistency.py, and runs with the rest of the
+static-analysis suite via ``python -m tools.oryxlint``).
 
-Every ``oryx.*`` key the code reads through a ``Config`` accessor
-(``get``/``get_string``/``get_int``/``get_float``/``get_bool``/
-``get_list``/``get_config``/``has``) must be declared in
-``common/reference.conf`` — the contract the reference enforced by
-layering every read over packaged defaults. Without this, a new
-``oryx.batch.train.*``-style knob can silently drift: read in code,
-undocumented in the defaults, invisible to ``cmd_config`` and operators.
+Kept as a CLI because operators and older docs invoke it directly. The
+collector functions (``code_config_keys``, ``reference_config``) are
+defined here and stay monkeypatchable as before — ``main`` reads them
+through this module's globals. ``ACCESSOR``/``STRICT_BLOCKS`` are
+read-only re-exports of the rule's constants (rebinding them here does
+not change the rule's behavior).
 
-Keys composed with f-string interpolation (``f"oryx.als.{k}"``) cannot be
-resolved statically and are skipped; fully dynamic reads should go
-through such a composition on purpose.
-
-The robustness blocks (``oryx.monitoring.faults`` / ``retry`` /
-``quarantine`` and ``oryx.serving.api.shed``) are additionally checked in
-REVERSE: every key declared there must be read somewhere in code. These
-knobs gate failure-handling behavior — a declared-but-never-read retry or
-quarantine key would let an operator believe a recovery path is
-configured when nothing consumes it.
+Contract (unchanged): every ``oryx.*`` key the code reads through a
+``Config`` accessor must be declared in ``common/reference.conf``, and
+every key declared under a strict robustness block (faults / retry /
+quarantine / shed) must be read somewhere — a dead recovery knob
+misleads operators. Keys composed with f-string interpolation cannot be
+resolved statically and are skipped on purpose.
 
 Exit status 0 = consistent; 1 = drift (each problem printed on stderr).
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
@@ -33,63 +30,34 @@ ROOT = Path(__file__).resolve().parent.parent
 PACKAGE = ROOT / "oryx_tpu"
 REFERENCE = PACKAGE / "common" / "reference.conf"
 
-# A Config accessor taking a literal oryx.* key as its first argument.
-# \s* spans newlines, so wrapped call sites resolve too. Keys containing
-# "{" are f-string compositions and excluded by the character class.
-ACCESSOR = re.compile(
-    r"\.(?:get|get_string|get_int|get_float|get_bool|get_list|get_config|has)"
-    r"\(\s*[bru]?[\"'](oryx\.[A-Za-z0-9_.\-]+)[\"']"
-)
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+from tools.oryxlint.checkers import consistency as _rule  # noqa: E402
+
+# re-exported for callers/tests that reach into this module
+ACCESSOR = _rule.ACCESSOR
+STRICT_BLOCKS = _rule.STRICT_BLOCKS
 
 
 def code_config_keys() -> dict[str, str]:
     """key -> first file reading it, for every literal oryx.* accessor."""
-    keys: dict[str, str] = {}
-    for py in sorted(PACKAGE.rglob("*.py")):
-        text = py.read_text(encoding="utf-8")
-        for m in ACCESSOR.finditer(text):
-            keys.setdefault(m.group(1), str(py.relative_to(ROOT)))
-    return keys
+    return {
+        key: where
+        for key, (where, _line) in _rule.code_config_keys(PACKAGE, ROOT).items()
+    }
 
 
 def reference_config():
-    from oryx_tpu.common.config import parse_config
-
-    return parse_config(REFERENCE.read_text(encoding="utf-8"))
-
-
-# Blocks whose declared keys must each be READ by code (reverse check).
-STRICT_BLOCKS = (
-    "oryx.monitoring.faults",
-    "oryx.monitoring.retry",
-    "oryx.monitoring.quarantine",
-    "oryx.serving.api.shed",
-)
+    return _rule.reference_config(REFERENCE)
 
 
 def main() -> int:
-    problems: list[str] = []
     if not REFERENCE.exists():
         print(f"missing {REFERENCE.relative_to(ROOT)}", file=sys.stderr)
         return 1
-    sys.path.insert(0, str(ROOT))
-    ref = reference_config()
     code = code_config_keys()
-    for key in sorted(code):
-        if not ref.has(key):
-            problems.append(
-                f"{key} ({code[key]}): read in code but not declared in "
-                "common/reference.conf"
-            )
-    flat = ref.flatten()
-    for block in STRICT_BLOCKS:
-        for key in sorted(k for k in flat if k.startswith(block + ".")):
-            if key not in code:
-                problems.append(
-                    f"{key}: declared in common/reference.conf but never "
-                    "read by any Config accessor — a dead robustness knob "
-                    "misleads operators about what recovery is configured"
-                )
+    problems = _rule.config_problems(code, reference_config())
     for p in problems:
         print(p, file=sys.stderr)
     if not problems:

@@ -1,66 +1,66 @@
 #!/usr/bin/env python
-"""Static metric-name consistency check (wired as a tier-1 test).
+"""Static metric-name consistency check — thin wrapper (DEPRECATED entry
+point; the logic now lives in the oryxlint ``metric-docs`` and
+``bench-ratchet`` rules, tools/oryxlint/checkers/consistency.py, and
+runs with the rest of the static-analysis suite via
+``python -m tools.oryxlint``).
 
-Three invariants, so metric docs and the bench ratchet cannot drift from
-the code:
+Kept as a CLI because operators and older docs invoke it directly. The
+collector functions (``code_metric_names``, ``doc_metric_names``) are
+defined here and stay monkeypatchable as before — ``main`` reads them
+through this module's globals. ``VALID_NAME`` and friends are read-only
+re-exports of the rule's constants (rebinding them here does not change
+the rule's behavior).
 
-1. Every metric name used under ``oryx_tpu/`` (any string literal that is
-   exactly an ``oryx_``-prefixed identifier) matches the naming contract
-   ``^oryx_[a-z0-9_]+$``.
-2. Every such name appears in the reference table of
-   ``docs/observability.md`` (a row whose first column is the backticked
-   name) — and every name in the table exists in code.
-3. Every metric name ratcheted in ``BASELINE_RATCHET.json``
-   (tools/check_bench.py) still exists in ``bench.py``'s output
-   vocabulary — a renamed bench field would otherwise make the ratchet
-   fail every future run as "missing" (or, worse, silently skip on a
-   platform filter) long after the measurement it locks moved on.
-
-Histogram series suffixes (``_bucket``/``_sum``/``_count``) are derived by
-the exposition layer and are documented under the base name only.
+Contract (unchanged): every ``oryx_``-prefixed string literal under
+``oryx_tpu/`` matches ``^oryx_[a-z0-9_]+$`` and has a reference-table
+row in ``docs/observability.md`` (and vice versa); every metric name
+ratcheted in ``BASELINE_RATCHET.json`` still exists in ``bench.py``'s
+output vocabulary; the score-mode bench/doc vocabulary is present.
 
 Exit status 0 = consistent; 1 = drift (each problem printed on stderr).
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
 PACKAGE = ROOT / "oryx_tpu"
 DOC = ROOT / "docs" / "observability.md"
-BENCH = ROOT / "bench.py"
-RATCHET = ROOT / "BASELINE_RATCHET.json"
 
-VALID_NAME = re.compile(r"^oryx_[a-z0-9_]+$")
-# A whole string literal that is an oryx_-prefixed identifier. Literals
-# with any other characters (spaces, braces, dots) are scrape patterns or
-# prose, not metric registrations, and are skipped on purpose.
-CODE_LITERAL = re.compile(r"""["'](oryx_[A-Za-z0-9_]+)["']""")
-# A reference-table row whose first cell is the backticked metric name.
-DOC_ROW = re.compile(r"^\|\s*`(oryx_[^`]+)`", re.M)
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
 
-# Not metrics: the package's own name appears as a string in a few places.
-IGNORE = {"oryx_tpu"}
+from tools.oryxlint.checkers import consistency as _rule  # noqa: E402
 
-# Score-mode vocabulary (PR 8): bench fields the serving-mode claims ride
-# on, and the label key the batcher's dispatch records carry. A rename in
-# bench.py or docs would otherwise silently orphan the recall gate's and
-# the per-mode dashboards' names.
-REQUIRED_BENCH_FIELDS = (
-    "qps_quantized",
-    "approx_recall_at_10",
-    "quantized_recall_at_10",
-    "lsh_measured_recall_at_10",
-)
-REQUIRED_DOC_TOKENS = ("score_mode",)
+# re-exported for callers/tests that reach into this module
+VALID_NAME = _rule.VALID_METRIC_NAME
+CODE_LITERAL = _rule.METRIC_LITERAL
+DOC_ROW = _rule.DOC_ROW
+IGNORE = _rule.METRIC_IGNORE
+REQUIRED_BENCH_FIELDS = _rule.REQUIRED_BENCH_FIELDS
+REQUIRED_DOC_TOKENS = _rule.REQUIRED_DOC_TOKENS
+
+
+def code_metric_names() -> dict[str, str]:
+    """name -> first file using it, for every metric-shaped literal."""
+    return {
+        name: where
+        for name, (where, _line) in _rule.code_metric_names(PACKAGE, ROOT).items()
+    }
+
+
+def doc_metric_names() -> set[str]:
+    return _rule.doc_metric_names(DOC)
 
 
 def vocabulary_problems() -> list[str]:
+    import re
+
     problems = []
-    bench_text = BENCH.read_text(encoding="utf-8")
+    bench_text = (ROOT / "bench.py").read_text(encoding="utf-8")
     for name in REQUIRED_BENCH_FIELDS:
         if not re.search(rf'"{re.escape(name)}"', bench_text):
             problems.append(
@@ -75,46 +75,13 @@ def vocabulary_problems() -> list[str]:
     return problems
 
 
-def code_metric_names() -> dict[str, str]:
-    """name -> first file using it, for every metric-shaped literal."""
-    names: dict[str, str] = {}
-    for py in sorted(PACKAGE.rglob("*.py")):
-        text = py.read_text(encoding="utf-8")
-        for m in CODE_LITERAL.finditer(text):
-            name = m.group(1)
-            if name not in IGNORE:
-                names.setdefault(name, str(py.relative_to(ROOT)))
-    return names
-
-
-def doc_metric_names() -> set[str]:
-    return set(DOC_ROW.findall(DOC.read_text(encoding="utf-8")))
-
-
 def ratchet_problems() -> list[str]:
-    """Every ratcheted metric name must appear as a quoted key literal in
-    bench.py — the static stand-in for 'bench.py output emits it'."""
-    if not RATCHET.exists():
-        return [f"missing {RATCHET.relative_to(ROOT)}"]
-    import json
-
-    try:
-        metrics = json.loads(RATCHET.read_text(encoding="utf-8"))["metrics"]
-    except (json.JSONDecodeError, KeyError, TypeError) as e:
-        return [f"{RATCHET.name}: unparseable ({e})"]
-    bench_text = BENCH.read_text(encoding="utf-8")
-    problems = []
-    for m in metrics:
-        name = m.get("name")
-        if not name:
-            problems.append(f"{RATCHET.name}: metric entry without a name: {m}")
-        elif not re.search(rf'"{re.escape(name)}"', bench_text):
-            problems.append(
-                f"{name}: ratcheted in {RATCHET.name} but bench.py never "
-                "emits a field of that name — the ratchet would fail every "
-                "run as 'missing'"
-            )
-    return problems
+    """Ratcheted names must exist in bench.py; stale pending rows fail
+    (tools/check_bench.stale_pending_problems) — rendered through the
+    oryxlint rule so both CLIs and the tier-1 lint agree."""
+    return [
+        f.message for f in _rule.ratchet_findings(ROOT)
+    ]
 
 
 def main() -> int:
@@ -122,30 +89,15 @@ def main() -> int:
     if not DOC.exists():
         print(f"missing {DOC.relative_to(ROOT)}", file=sys.stderr)
         return 1
-    code = code_metric_names()
-    doc = doc_metric_names()
-    for name in sorted(code):
-        where = code[name]
-        if not VALID_NAME.match(name):
-            problems.append(
-                f"{name} ({where}): does not match ^oryx_[a-z0-9_]+$"
-            )
-        elif name not in doc:
-            problems.append(
-                f"{name} ({where}): missing from the docs/observability.md "
-                "metric reference table"
-            )
-    for name in sorted(doc - set(code)):
-        problems.append(
-            f"{name}: documented in docs/observability.md but not found "
-            "anywhere under oryx_tpu/"
-        )
+    problems.extend(
+        _rule.metric_doc_problems(code_metric_names(), doc_metric_names())
+    )
     problems.extend(ratchet_problems())
     problems.extend(vocabulary_problems())
     for p in problems:
         print(p, file=sys.stderr)
     if not problems:
-        print(f"ok: {len(code)} metric names consistent with docs")
+        print("ok: metric names consistent with docs")
     return 1 if problems else 0
 
 

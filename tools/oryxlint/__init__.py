@@ -1,0 +1,34 @@
+"""oryxlint — project-aware static analysis for the oryx_tpu tree.
+
+The framework is a small checker SPI (tools/oryxlint/core.py): each
+checker visits the parsed module ASTs of the whole project through
+shared resolution helpers (tools/oryxlint/callgraph.py) and emits
+findings with file:line and a rule id. Findings are suppressible with a
+trailing comment naming the rule; functions carry machine-readable
+annotations the checkers honor (off-loop proofs, lock-held contracts,
+guarded attributes).
+
+Checkers shipped (tools/oryxlint/checkers/):
+
+- ``blocking-call-on-loop``  broker/file/subprocess I/O reachable from
+  an event-loop root (async defs, nonblocking route handlers)
+- ``guarded-by``             reads/writes of lock-annotated shared
+  attributes outside their lock
+- ``jit-side-effect``        Python side effects inside jax.jit / pjit /
+  Pallas-traced functions
+- ``donation-reuse``         use of a buffer after it was passed at a
+  ``donate_argnums`` position
+- ``config-keys``            oryx.* config keys vs common/reference.conf
+  (both directions; absorbed tools/check_config.py)
+- ``metric-docs``            oryx_* metric names vs docs/observability.md
+  (both directions; absorbed tools/check_metrics.py)
+- ``bench-ratchet``          BASELINE_RATCHET.json vocabulary + stale
+  ``pending`` rows vs banked bench artifacts
+
+Run ``python -m tools.oryxlint`` (``--changed`` for a git-diff-scoped
+fast pass, ``--json`` for machine consumption). The whole-tree run is
+wired as a tier-1 test (tests/test_oryxlint.py); docs/development.md
+documents the rule catalog and annotation syntax.
+"""
+
+from tools.oryxlint.core import Finding, Project, run_lint  # noqa: F401

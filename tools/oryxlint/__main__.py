@@ -1,0 +1,98 @@
+"""oryxlint CLI: ``python -m tools.oryxlint [--changed] [--json]``.
+
+Exit status 0 = clean, 1 = findings (each printed as file:line: [rule]
+message), 2 = usage/internal error. ``--changed`` scopes per-file rules
+to files touched per git (staged, unstaged, and untracked) for fast
+pre-commit runs; whole-tree consistency rules always run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.oryxlint.core import known_rules, run_lint  # noqa: E402
+
+
+def _changed_files(root: str) -> set[str]:
+    """Repo-relative paths touched per git status (staged + unstaged +
+    untracked). Falls back to the empty set outside a work tree."""
+    try:
+        proc = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return set()
+    if proc.returncode != 0:
+        return set()
+    out: set[str] = set()
+    for ln in proc.stdout.splitlines():
+        if len(ln) < 4:
+            continue
+        path = ln[3:].strip()
+        if " -> " in path:  # rename: take the new side
+            path = path.split(" -> ", 1)[1]
+        out.add(path.strip('"'))
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="oryxlint", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument(
+        "--root", default=ROOT, help="repo root to lint (default: this repo)"
+    )
+    ap.add_argument(
+        "--changed", action="store_true",
+        help="scope per-file rules to git-changed files (fast pre-commit)",
+    )
+    ap.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable output: {findings, suppressed, rules}",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, desc in sorted(known_rules().items()):
+            print(f"{rid}: {desc}")
+        return 0
+
+    changed = _changed_files(args.root) if args.changed else None
+    if changed is not None and not changed:
+        print("oryxlint --changed: no modified files; per-file rules skipped")
+    active, suppressed = run_lint(args.root, changed=changed)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in active],
+            "suppressed": [f.as_dict() for f in suppressed],
+            "rules": known_rules(),
+        }, indent=2))
+        return 1 if active else 0
+
+    for f in active:
+        print(f.render(), file=sys.stderr)
+    if active:
+        print(
+            f"\noryxlint: {len(active)} finding(s) "
+            f"({len(suppressed)} suppressed)", file=sys.stderr,
+        )
+        return 1
+    print(f"oryxlint: clean ({len(suppressed)} suppressed finding(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

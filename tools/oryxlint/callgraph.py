@@ -1,0 +1,359 @@
+"""Shared resolution helpers: imports, classes, methods, call edges.
+
+Checkers need the same project-level questions answered — "what does
+this call resolve to", "what type is this receiver", "which functions
+does this class define" — so the index is built once per lint run and
+shared. Resolution is deliberately *confident-only*: an edge is followed
+when the target is unambiguous (module-local function, ``self`` method,
+import-resolved symbol, annotation-typed receiver, or a method name
+defined by exactly one project class). Anything else returns no
+candidates rather than guessing — a project linter that guesses wrong
+trains people to ignore it.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.oryxlint.core import Project, SourceModule
+
+# method names too generic for the unique-definition fallback: many
+# stdlib/third-party objects define them, so "only one project class has
+# it" proves nothing about the receiver
+COMMON_METHOD_NAMES = frozenset({
+    "get", "set", "put", "add", "pop", "run", "read", "write", "close",
+    "open", "send", "recv", "start", "stop", "join", "wait", "notify",
+    "items", "keys", "values", "update", "clear", "copy", "append",
+    "extend", "insert", "remove", "submit", "result", "acquire",
+    "release", "next", "flush", "seek", "tell", "encode", "decode",
+    "split", "strip", "match", "search", "format", "count", "index",
+    "sort", "reverse", "load", "save", "check", "render", "observe",
+    "inc", "dec", "snapshot", "commit", "request", "connect", "shutdown",
+})
+
+
+@dataclass
+class FunctionInfo:
+    module: SourceModule
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    name: str
+    cls: str | None  # enclosing class name, if a method
+    parent: str | None  # qualname of the enclosing function, if nested
+    qualname: str = ""
+    is_async: bool = False
+    offloop: bool = False
+    holds: tuple[str, ...] = ()
+    nonblocking_route: bool = False
+
+    @property
+    def where(self) -> str:
+        return f"{self.module.relpath}:{self.node.lineno}"
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: SourceModule
+    node: ast.ClassDef
+    bases: list[str] = field(default_factory=list)
+    methods: dict[str, FunctionInfo] = field(default_factory=dict)
+    # self.<attr> -> project class name, inferred from annotated-param
+    # copies and direct constructions in method bodies
+    attr_types: dict[str, str] = field(default_factory=dict)
+    # self.<alias> -> self.<lock>: threading.Condition(self.<lock>)
+    # assignments make `with self.<alias>` hold <lock>
+    lock_aliases: dict[str, str] = field(default_factory=dict)
+
+
+def _module_dotted(relpath: str) -> str:
+    return relpath[:-3].replace("/", ".") if relpath.endswith(".py") else relpath
+
+
+def _base_name(expr: ast.AST) -> str | None:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+class ProjectIndex:
+    """Symbol index over a loaded Project."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.functions: list[FunctionInfo] = []
+        self.top_level: dict[tuple[str, str], FunctionInfo] = {}
+        self.nested: dict[tuple[str, str, str], FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self._ambiguous_classes: set[str] = set()
+        self.methods_by_name: dict[str, list[FunctionInfo]] = {}
+        # module relpath -> local name -> ("mod", dotted) | ("sym", dotted, symbol)
+        self.imports: dict[str, dict[str, tuple]] = {}
+        self._dotted_to_rel = {
+            _module_dotted(m.relpath): m.relpath for m in project.modules
+        }
+        for mod in project.modules:
+            self._index_module(mod)
+        for ci in self.classes.values():
+            self._infer_attr_types(ci)
+
+    # -- indexing ------------------------------------------------------------
+
+    def _index_module(self, mod: SourceModule) -> None:
+        imports: dict[str, tuple] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    imports[a.asname or a.name.split(".")[0]] = ("mod", a.name)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    imports[a.asname or a.name] = ("sym", node.module, a.name)
+        self.imports[mod.relpath] = imports
+        self._index_body(mod, mod.tree.body, cls=None, parent=None, prefix="")
+
+    def _index_body(self, mod, body, cls, parent, prefix) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{node.name}"
+                fi = FunctionInfo(
+                    module=mod, node=node, name=node.name, cls=cls,
+                    parent=parent, qualname=qual,
+                    is_async=isinstance(node, ast.AsyncFunctionDef),
+                    offloop=mod.fn_offloop(node), holds=mod.fn_holds(node),
+                    nonblocking_route=_is_nonblocking_route(node),
+                )
+                self.functions.append(fi)
+                if cls is None and parent is None:
+                    self.top_level[(mod.relpath, node.name)] = fi
+                if parent is not None:
+                    self.nested[(mod.relpath, parent, node.name)] = fi
+                if cls is not None and parent is None:
+                    ci = self.classes.get(cls)
+                    if ci is not None and ci.module is mod:
+                        ci.methods[node.name] = fi
+                        self.methods_by_name.setdefault(node.name, []).append(fi)
+                self._index_body(
+                    mod, node.body, cls=cls, parent=qual, prefix=f"{qual}.",
+                )
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(
+                    name=node.name, module=mod, node=node,
+                    bases=[b for b in map(_base_name, node.bases) if b],
+                )
+                key = node.name
+                if key in self.classes:
+                    # duplicate bare name: name-based RESOLUTION becomes
+                    # ambiguous (conservative, no guessing), but the class
+                    # itself stays indexed under a synthetic key so the
+                    # lock-discipline checker still enforces its
+                    # guarded-by annotations — shadowing must never
+                    # silently drop coverage
+                    self._ambiguous_classes.add(node.name)
+                    key = f"{node.name}@{mod.relpath}:{node.lineno}"
+                self.classes[key] = ci
+                self._index_body(
+                    mod, node.body, cls=key, parent=None,
+                    prefix=f"{node.name}.",
+                )
+
+    def _infer_attr_types(self, ci: ClassInfo) -> None:
+        """self.<attr> types from __init__-style assignments: a parameter
+        annotated with a project class, or a direct construction."""
+        for fi in ci.methods.values():
+            ann: dict[str, str] = {}
+            for a in list(fi.node.args.args) + list(fi.node.args.kwonlyargs):
+                t = _base_name(a.annotation) if a.annotation else None
+                if t and t in self.classes:
+                    ann[a.arg] = t
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                    continue
+                t = node.targets[0]
+                if not (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    continue
+                v = node.value
+                if isinstance(v, ast.Name) and v.id in ann:
+                    ci.attr_types.setdefault(t.attr, ann[v.id])
+                elif (
+                    isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Name)
+                    and v.func.id in self.classes
+                    and v.func.id not in self._ambiguous_classes
+                ):
+                    ci.attr_types.setdefault(t.attr, v.func.id)
+                elif (
+                    isinstance(v, ast.Call)
+                    and self.dotted_name(fi.module, v.func)
+                    == "threading.Condition"
+                    and v.args
+                    and isinstance(v.args[0], ast.Attribute)
+                    and isinstance(v.args[0].value, ast.Name)
+                    and v.args[0].value.id == "self"
+                ):
+                    ci.lock_aliases[t.attr] = v.args[0].attr
+
+    # -- resolution ------------------------------------------------------------
+
+    def dotted_name(self, mod: SourceModule, expr: ast.AST) -> str | None:
+        """Fully-qualified dotted name of a Name/Attribute expression via
+        the module's imports: ``sleep`` (from time import sleep) and
+        ``time.sleep`` both resolve to ``"time.sleep"``."""
+        parts: list[str] = []
+        node = expr
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        imp = self.imports.get(mod.relpath, {}).get(node.id)
+        if imp is None:
+            return None
+        if imp[0] == "mod":
+            head = imp[1]
+        else:
+            head = f"{imp[1]}.{imp[2]}"
+        return ".".join([head] + list(reversed(parts)))
+
+    def class_of(self, fi: FunctionInfo, expr: ast.AST) -> str | None:
+        """Project class name of a receiver expression, or None."""
+        if isinstance(expr, ast.Name):
+            if expr.id == "self" and fi.cls:
+                return fi.cls
+            # annotated parameter of this function
+            for a in list(fi.node.args.args) + list(fi.node.args.kwonlyargs):
+                if a.arg == expr.id and a.annotation is not None:
+                    t = _base_name(a.annotation)
+                    if t in self.classes and t not in self._ambiguous_classes:
+                        return t
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = self.class_of(fi, expr.value)
+            if base is None:
+                return None
+            for cls in self._mro(base):
+                t = self.classes[cls].attr_types.get(expr.attr)
+                if t is not None:
+                    return t
+            return None
+        if isinstance(expr, ast.Call):
+            # ClassName(...) or Class.shared()-style constructor
+            if isinstance(expr.func, ast.Name) and expr.func.id in self.classes:
+                return expr.func.id
+        return None
+
+    def _mro(self, cls: str) -> list[str]:
+        out, queue = [], [cls]
+        while queue:
+            c = queue.pop(0)
+            if c in out or c not in self.classes:
+                continue
+            out.append(c)
+            queue.extend(self.classes[c].bases)
+        return out
+
+    def method_on(self, cls: str, name: str) -> FunctionInfo | None:
+        for c in self._mro(cls):
+            fi = self.classes[c].methods.get(name)
+            if fi is not None:
+                return fi
+        return None
+
+    def resolve_call(self, fi: FunctionInfo, call: ast.Call) -> list[FunctionInfo]:
+        """Confident candidate targets of a call made inside ``fi``."""
+        func = call.func
+        mod = fi.module
+        if isinstance(func, ast.Name):
+            # nested sibling (a closure defined in this or an enclosing fn)
+            parent = fi.qualname
+            while parent:
+                hit = self.nested.get((mod.relpath, parent, func.id))
+                if hit is not None:
+                    return [hit]
+                parent = parent.rsplit(".", 1)[0] if "." in parent else ""
+            hit = self.top_level.get((mod.relpath, func.id))
+            if hit is not None:
+                return [hit]
+            imp = self.imports.get(mod.relpath, {}).get(func.id)
+            if imp is not None and imp[0] == "sym":
+                rel = self._dotted_to_rel.get(imp[1])
+                if rel is not None:
+                    tgt = self.top_level.get((rel, imp[2]))
+                    if tgt is not None:
+                        return [tgt]
+                    # symbol may be a class: follow into __init__
+                    ci = self.classes.get(imp[2])
+                    if ci is not None and imp[2] not in self._ambiguous_classes:
+                        init = ci.methods.get("__init__")
+                        return [init] if init is not None else []
+            if func.id in self.classes and func.id not in self._ambiguous_classes:
+                ci = self.classes[func.id]
+                if ci.module is mod:
+                    init = ci.methods.get("__init__")
+                    return [init] if init is not None else []
+            return []
+        if isinstance(func, ast.Attribute):
+            # module.function via imports
+            dotted = self.dotted_name(mod, func)
+            if dotted is not None:
+                head, _, tail = dotted.rpartition(".")
+                rel = self._dotted_to_rel.get(head)
+                if rel is not None:
+                    tgt = self.top_level.get((rel, tail))
+                    if tgt is not None:
+                        return [tgt]
+            cls = self.class_of(fi, func.value)
+            if cls is not None:
+                tgt = self.method_on(cls, func.attr)
+                return [tgt] if tgt is not None else []
+            # unique-definition fallback: exactly one project class defines
+            # this method name, and the name is specific enough to trust
+            if (
+                func.attr not in COMMON_METHOD_NAMES
+                and len(func.attr) >= 3
+                and not func.attr.startswith("__")
+            ):
+                cands = self.methods_by_name.get(func.attr, [])
+                if len(cands) == 1:
+                    return list(cands)
+            return []
+        return []
+
+
+def _is_nonblocking_route(node) -> bool:
+    """True for handlers registered with route(..., nonblocking=True) —
+    the async frontend dispatches these inline on the event loop."""
+    for dec in getattr(node, "decorator_list", []):
+        if not isinstance(dec, ast.Call):
+            continue
+        name = dec.func.attr if isinstance(dec.func, ast.Attribute) else (
+            dec.func.id if isinstance(dec.func, ast.Name) else None
+        )
+        if name != "route":
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "nonblocking" and isinstance(kw.value, ast.Constant):
+                if kw.value.value is True:
+                    return True
+    return False
+
+
+def body_calls(node) -> list[ast.Call]:
+    """Call nodes at this function's own level — nested function/lambda
+    bodies are excluded (they run when *called*, which resolve_call models
+    as its own edge)."""
+    out: list[ast.Call] = []
+    stack = list(getattr(node, "body", []))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(n, ast.Call):
+            out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out

@@ -1,0 +1,13 @@
+"""Checker registry: every shipped checker, in report order."""
+
+from tools.oryxlint.checkers.consistency import ConsistencyChecker
+from tools.oryxlint.checkers.eventloop import EventLoopChecker
+from tools.oryxlint.checkers.jaxpurity import JaxPurityChecker
+from tools.oryxlint.checkers.lockdiscipline import LockDisciplineChecker
+
+ALL_CHECKERS = [
+    EventLoopChecker,
+    LockDisciplineChecker,
+    JaxPurityChecker,
+    ConsistencyChecker,
+]

@@ -1,0 +1,327 @@
+"""Project-consistency checkers (rules ``config-keys``, ``metric-docs``,
+``bench-ratchet``).
+
+These absorb the one-off tools this repo grew over PRs 4-8 into the
+checker SPI — the old entry points (tools/check_config.py,
+tools/check_metrics.py) remain as thin CLI wrappers:
+
+- ``config-keys``: every ``oryx.*`` key read through a Config accessor
+  is declared in common/reference.conf, and every key declared under a
+  strict robustness block (faults/retry/quarantine/shed) is read
+  somewhere — a dead recovery knob misleads operators.
+- ``metric-docs``: every ``oryx_*`` metric name in code matches the
+  naming contract and has a row in docs/observability.md, and every
+  documented row still exists in code (the reverse docs rule) — plus the
+  score-mode bench/doc vocabulary.
+- ``bench-ratchet``: every metric locked in BASELINE_RATCHET.json still
+  exists in bench.py's output vocabulary, and no ``pending`` row has
+  outlived a banked artifact of its platform that measures it
+  (tools/check_bench.py owns that artifact scan).
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from tools.oryxlint.core import Checker, Finding, Project
+
+# A Config accessor taking a literal oryx.* key as its first argument.
+# \s* spans newlines, so wrapped call sites resolve too. Keys containing
+# "{" are f-string compositions and excluded by the character class.
+ACCESSOR = re.compile(
+    r"\.(?:get|get_string|get_int|get_float|get_bool|get_list|get_config|has)"
+    r"\(\s*[bru]?[\"'](oryx\.[A-Za-z0-9_.\-]+)[\"']"
+)
+
+# Blocks whose declared keys must each be READ by code (reverse check).
+STRICT_BLOCKS = (
+    "oryx.monitoring.faults",
+    "oryx.monitoring.retry",
+    "oryx.monitoring.quarantine",
+    "oryx.serving.api.shed",
+)
+
+VALID_METRIC_NAME = re.compile(r"^oryx_[a-z0-9_]+$")
+# A whole string literal that is an oryx_-prefixed identifier. Literals
+# with any other characters (spaces, braces, dots) are scrape patterns or
+# prose, not metric registrations, and are skipped on purpose.
+METRIC_LITERAL = re.compile(r"""["'](oryx_[A-Za-z0-9_]+)["']""")
+# A reference-table row whose first cell is the backticked metric name.
+DOC_ROW = re.compile(r"^\|\s*`(oryx_[^`]+)`", re.M)
+
+# Not metrics: the package's own name appears as a string in a few places.
+METRIC_IGNORE = {"oryx_tpu"}
+
+# Score-mode vocabulary (PR 8): bench fields the serving-mode claims ride
+# on, and the label key the batcher's dispatch records carry.
+REQUIRED_BENCH_FIELDS = (
+    "qps_quantized",
+    "approx_recall_at_10",
+    "quantized_recall_at_10",
+    "lsh_measured_recall_at_10",
+)
+REQUIRED_DOC_TOKENS = ("score_mode",)
+
+
+# -- collectors (shared with the thin CLI wrappers) --------------------------
+
+
+def _package_texts(
+    package: Path, root: Path, texts: dict[str, str] | None
+) -> list[tuple[str, str]]:
+    """(relpath, source) pairs under oryx_tpu/, from an already-loaded
+    text cache (the lint run's Project) or from disk (the CLI wrappers)."""
+    prefix = str(package.relative_to(root))
+    if texts is not None:
+        return sorted(
+            (rel, t) for rel, t in texts.items()
+            if rel.startswith(prefix + "/") or rel.startswith(prefix + "\\")
+        )
+    return [
+        (str(py.relative_to(root)), py.read_text(encoding="utf-8"))
+        for py in sorted(package.rglob("*.py"))
+    ]
+
+
+def code_config_keys(
+    package: Path, root: Path, texts: dict[str, str] | None = None
+) -> dict[str, tuple[str, int]]:
+    """key -> (relpath, line) of the first literal oryx.* accessor read."""
+    keys: dict[str, tuple[str, int]] = {}
+    for rel, text in _package_texts(package, root, texts):
+        for m in ACCESSOR.finditer(text):
+            line = text.count("\n", 0, m.start()) + 1
+            keys.setdefault(m.group(1), (rel, line))
+    return keys
+
+
+def code_metric_names(
+    package: Path, root: Path, texts: dict[str, str] | None = None
+) -> dict[str, tuple[str, int]]:
+    """name -> (relpath, line) of the first metric-shaped literal."""
+    names: dict[str, tuple[str, int]] = {}
+    for rel, text in _package_texts(package, root, texts):
+        for m in METRIC_LITERAL.finditer(text):
+            name = m.group(1)
+            if name not in METRIC_IGNORE:
+                line = text.count("\n", 0, m.start()) + 1
+                names.setdefault(name, (rel, line))
+    return names
+
+
+def doc_metric_names(doc: Path) -> set[str]:
+    return set(DOC_ROW.findall(doc.read_text(encoding="utf-8")))
+
+
+def reference_config(reference: Path):
+    from oryx_tpu.common.config import parse_config
+
+    return parse_config(reference.read_text(encoding="utf-8"))
+
+
+# -- problem builders ---------------------------------------------------------
+
+
+def config_problems(code: dict[str, str], ref) -> list[str]:
+    """Key-level drift messages from a key->where map and a parsed
+    reference config — the shared core the thin CLI wrapper
+    (tools/check_config.py) and the rule both render from."""
+    problems: list[str] = []
+    for key in sorted(code):
+        if not ref.has(key):
+            problems.append(
+                f"{key} ({code[key]}): read in code but not declared in "
+                "common/reference.conf"
+            )
+    flat = ref.flatten()
+    for block in STRICT_BLOCKS:
+        for key in sorted(k for k in flat if k.startswith(block + ".")):
+            if key not in code:
+                problems.append(
+                    f"{key}: declared in common/reference.conf but never "
+                    "read by any Config accessor — a dead robustness knob "
+                    "misleads operators about what recovery is configured"
+                )
+    return problems
+
+
+def metric_doc_problems(
+    code: dict[str, str], doc_names: set[str]
+) -> list[str]:
+    """Name-level drift messages from a name->where map and the doc-table
+    names — shared by tools/check_metrics.py and the rule."""
+    problems: list[str] = []
+    for name in sorted(code):
+        where = code[name]
+        if not VALID_METRIC_NAME.match(name):
+            problems.append(
+                f"{name} ({where}): does not match ^oryx_[a-z0-9_]+$"
+            )
+        elif name not in doc_names:
+            problems.append(
+                f"{name} ({where}): missing from the docs/observability.md "
+                "metric reference table"
+            )
+    for name in sorted(doc_names - set(code)):
+        problems.append(
+            f"{name}: documented in docs/observability.md but not found "
+            "anywhere under oryx_tpu/"
+        )
+    return problems
+
+
+def config_findings(
+    root: Path, texts: dict[str, str] | None = None
+) -> list[Finding]:
+    package = root / "oryx_tpu"
+    reference = package / "common" / "reference.conf"
+    ref_rel = str(reference.relative_to(root))
+    if not reference.exists():
+        return [Finding(ref_rel, 1, "config-keys", "missing reference.conf")]
+    ref = reference_config(reference)
+    code = code_config_keys(package, root, texts)
+    out: list[Finding] = []
+    for key in sorted(code):
+        where, line = code[key]
+        if not ref.has(key):
+            out.append(Finding(
+                where, line, "config-keys",
+                f"{key} read in code but not declared in {ref_rel}",
+            ))
+    flat = ref.flatten()
+    for block in STRICT_BLOCKS:
+        for key in sorted(k for k in flat if k.startswith(block + ".")):
+            if key not in code:
+                out.append(Finding(
+                    ref_rel, 1, "config-keys",
+                    f"{key} declared in {ref_rel} but never read by any "
+                    "Config accessor — a dead robustness knob misleads "
+                    "operators about what recovery is configured",
+                ))
+    return out
+
+
+def metric_findings(
+    root: Path, texts: dict[str, str] | None = None
+) -> list[Finding]:
+    package = root / "oryx_tpu"
+    doc = root / "docs" / "observability.md"
+    doc_rel = str(doc.relative_to(root))
+    if not doc.exists():
+        return [Finding(doc_rel, 1, "metric-docs", "missing observability.md")]
+    code = code_metric_names(package, root, texts)
+    doc_names = doc_metric_names(doc)
+    out: list[Finding] = []
+    for name in sorted(code):
+        where, line = code[name]
+        if not VALID_METRIC_NAME.match(name):
+            out.append(Finding(
+                where, line, "metric-docs",
+                f"{name} does not match ^oryx_[a-z0-9_]+$",
+            ))
+        elif name not in doc_names:
+            out.append(Finding(
+                where, line, "metric-docs",
+                f"{name} missing from the {doc_rel} metric reference table",
+            ))
+    for name in sorted(doc_names - set(code)):
+        out.append(Finding(
+            doc_rel, 1, "metric-docs",
+            f"{name} documented in {doc_rel} but not found anywhere under "
+            "oryx_tpu/",
+        ))
+    bench = root / "bench.py"
+    bench_text = bench.read_text(encoding="utf-8") if bench.exists() else ""
+    for name in REQUIRED_BENCH_FIELDS:
+        if not re.search(rf'"{re.escape(name)}"', bench_text):
+            out.append(Finding(
+                "bench.py", 1, "metric-docs",
+                f"{name}: required bench vocabulary missing from bench.py",
+            ))
+    doc_text = doc.read_text(encoding="utf-8")
+    for tok in REQUIRED_DOC_TOKENS:
+        if tok not in doc_text:
+            out.append(Finding(
+                doc_rel, 1, "metric-docs",
+                f"{tok}: required label name missing from {doc_rel}",
+            ))
+    return out
+
+
+def ratchet_findings(root: Path) -> list[Finding]:
+    import json
+
+    ratchet = root / "BASELINE_RATCHET.json"
+    bench = root / "bench.py"
+    out: list[Finding] = []
+    if not ratchet.exists():
+        return [Finding("BASELINE_RATCHET.json", 1, "bench-ratchet", "missing")]
+    try:
+        metrics = json.loads(ratchet.read_text(encoding="utf-8"))["metrics"]
+    except (json.JSONDecodeError, KeyError, TypeError) as e:
+        return [Finding(
+            "BASELINE_RATCHET.json", 1, "bench-ratchet", f"unparseable ({e})"
+        )]
+    bench_text = bench.read_text(encoding="utf-8") if bench.exists() else ""
+    for m in metrics:
+        name = m.get("name")
+        if not name:
+            out.append(Finding(
+                "BASELINE_RATCHET.json", 1, "bench-ratchet",
+                f"metric entry without a name: {m}",
+            ))
+        elif not re.search(rf'"{re.escape(name)}"', bench_text):
+            out.append(Finding(
+                "BASELINE_RATCHET.json", 1, "bench-ratchet",
+                f"{name}: ratcheted but bench.py never emits a field of "
+                "that name — the ratchet would fail every run as 'missing'",
+            ))
+    # every pending row must record its declaring round, or the stale
+    # check below could never age it out
+    for m in metrics:
+        if m.get("pending") and not m.get("pending_since"):
+            out.append(Finding(
+                "BASELINE_RATCHET.json", 1, "bench-ratchet",
+                f"{m.get('name')}: pending row without pending_since — "
+                "record the declaring bench round so the flag can be "
+                "aged out once an artifact measures it",
+            ))
+    # stale `pending` rows: a banked artifact of the right platform now
+    # measures the metric, so the flag should have been removed by the PR
+    # that banked it (tools/check_bench.py owns the artifact scan)
+    from tools import check_bench
+
+    for problem in check_bench.stale_pending_problems(metrics, root=str(root)):
+        out.append(Finding("BASELINE_RATCHET.json", 1, "bench-ratchet", problem))
+    return out
+
+
+class ConsistencyChecker(Checker):
+    name = "consistency"
+    rules = {
+        "config-keys": (
+            "oryx.* config keys read in code must be declared in "
+            "reference.conf; robustness-block keys must be read somewhere"
+        ),
+        "metric-docs": (
+            "oryx_* metric names must match the naming contract and stay "
+            "in lockstep with docs/observability.md (both directions)"
+        ),
+        "bench-ratchet": (
+            "BASELINE_RATCHET.json rows must exist in bench.py's output "
+            "vocabulary, and pending rows must not outlive a banked "
+            "artifact that measures them"
+        ),
+    }
+
+    def check(self, project: Project) -> list[Finding]:
+        root = project.root
+        # reuse the lint run's already-loaded sources instead of a second
+        # and third full-tree read
+        texts = {m.relpath: m.text for m in project.modules}
+        out: list[Finding] = []
+        out.extend(config_findings(root, texts))
+        out.extend(metric_findings(root, texts))
+        out.extend(ratchet_findings(root))
+        return out

@@ -1,0 +1,149 @@
+"""Event-loop blocking-call detector (rule ``blocking-call-on-loop``).
+
+The serving tier multiplexes thousands of connections over a handful of
+asyncio event loops; ONE blocking call on a loop stalls every connection
+that loop owns. This repo has already shipped (and hand-fixed) the bug
+class twice: broker I/O on the /healthz probe path (PR 7 moved
+``ConsumeDataIterator.lag()`` to a dedicated sampling thread) and the
+general rule that handlers may run inline on the loop only when declared
+``nonblocking=True``.
+
+The checker walks the call graph from every event-loop root:
+
+- ``async def`` functions (coroutines execute on a loop by definition)
+- route handlers registered ``nonblocking=True`` (``ServingApp``
+  dispatches these inline on the loop)
+
+following confident edges only (module-local, ``self``/typed-receiver
+methods, imported symbols — tools/oryxlint/callgraph.py), and flags
+blocking sinks: ``time.sleep``, ``subprocess.*``, ``os.fsync``, raw
+``socket``/``http.client`` exchanges, broker I/O
+(``ConsumeDataIterator`` reads/commits, ``TopicProducer.send*``), and
+blocking ``Future.result`` waits.
+
+A function that provably runs on a worker thread (a ``threading.Thread``
+target, an executor task) breaks the walk with an ``oryxlint: offloop``
+annotation on its ``def`` line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.oryxlint.callgraph import FunctionInfo, ProjectIndex, body_calls
+from tools.oryxlint.core import Checker, Finding, Project
+
+# fully-qualified callables that block the calling thread
+BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep",
+    "os.fsync": "os.fsync",
+    "os.fdatasync": "os.fdatasync",
+    "socket.create_connection": "socket.create_connection",
+}
+# any attribute under these module prefixes blocks (process spawns, raw
+# HTTP exchanges)
+BLOCKING_PREFIXES = ("subprocess.", "http.client.")
+
+# method names whose only project definition is broker/consumer I/O —
+# specific enough that a bare receiver is still a confident match
+BLOCKING_METHOD_NAMES = {
+    "lag": "ConsumeDataIterator.lag (broker I/O)",
+    "poll_available": "ConsumeDataIterator.poll_available (broker I/O)",
+    "send_batch": "TopicProducer.send_batch (broker I/O)",
+    "getresponse": "http.client getresponse (blocking socket read)",
+}
+# generic method names that block only on particular receivers: matched
+# when the receiver's source text carries one of the hint substrings
+BLOCKING_METHOD_HINTS = {
+    "send": ("producer", "broker"),
+    "commit": ("consumer", "iterator"),
+    "request": ("conn",),
+    "result": ("fut", "future"),
+}
+
+MAX_DEPTH = 24
+
+
+def _sink_description(idx: ProjectIndex, fi: FunctionInfo, call: ast.Call) -> str | None:
+    func = call.func
+    dotted = idx.dotted_name(fi.module, func)
+    if dotted is not None:
+        if dotted in BLOCKING_DOTTED:
+            return BLOCKING_DOTTED[dotted]
+        for p in BLOCKING_PREFIXES:
+            if dotted.startswith(p) or dotted + "." == p:
+                return dotted
+    if isinstance(func, ast.Attribute):
+        if func.attr in BLOCKING_METHOD_NAMES:
+            return BLOCKING_METHOD_NAMES[func.attr]
+        hints = BLOCKING_METHOD_HINTS.get(func.attr)
+        if hints:
+            try:
+                recv = ast.unparse(func.value).lower()
+            except Exception:  # pragma: no cover - unparse is total on 3.9+
+                recv = ""
+            if any(h in recv for h in hints):
+                return f"{recv}.{func.attr} (blocking call)"
+    return None
+
+
+class EventLoopChecker(Checker):
+    name = "eventloop"
+    rules = {
+        "blocking-call-on-loop": (
+            "blocking I/O (sleep, subprocess, broker, socket, Future.result) "
+            "reachable from an event-loop root; prove worker-thread "
+            "execution with an offloop annotation"
+        ),
+    }
+
+    def check(self, project: Project) -> list[Finding]:
+        idx = ProjectIndex(project)
+        roots = [
+            fi for fi in idx.functions
+            if (fi.is_async or fi.nonblocking_route) and not fi.offloop
+        ]
+        findings: list[Finding] = []
+        seen_sites: set[tuple[str, int, str]] = set()
+        for root in roots:
+            self._walk(idx, root, root, [], set(), findings, seen_sites, 0)
+        return findings
+
+    def _walk(
+        self,
+        idx: ProjectIndex,
+        root: FunctionInfo,
+        fi: FunctionInfo,
+        chain: list[str],
+        visited: set[int],
+        findings: list[Finding],
+        seen_sites: set[tuple[str, int, str]],
+        depth: int,
+    ) -> None:
+        if depth > MAX_DEPTH or id(fi) in visited:
+            return
+        visited.add(id(fi))
+        chain = chain + [fi.qualname]
+        for call in body_calls(fi.node):
+            desc = _sink_description(idx, fi, call)
+            if desc is not None:
+                site = (fi.module.relpath, call.lineno, desc)
+                if site not in seen_sites:
+                    seen_sites.add(site)
+                    via = " -> ".join(chain)
+                    findings.append(Finding(
+                        fi.module.relpath, call.lineno,
+                        "blocking-call-on-loop",
+                        f"{desc} runs on an event loop: reachable from "
+                        f"loop root {root.qualname} ({root.where}) via "
+                        f"{via}; offload it or annotate the worker-thread "
+                        "function with `oryxlint: offloop`",
+                    ))
+                continue
+            for tgt in idx.resolve_call(fi, call):
+                if tgt.offloop:
+                    continue
+                self._walk(
+                    idx, root, tgt, chain, visited, findings, seen_sites,
+                    depth + 1,
+                )

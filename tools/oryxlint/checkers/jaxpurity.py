@@ -1,0 +1,338 @@
+"""JAX purity / donation checker (rules ``jit-side-effect``,
+``donation-reuse``).
+
+Functions traced by ``jax.jit`` / ``pjit`` / Pallas run ONCE at trace
+time; Python side effects inside them (prints, metrics increments,
+``time.*`` reads, host RNG, mutation of closed-over containers) execute
+at compile time, not per call — silently wrong, and invisible until
+someone wonders why a counter stopped moving. Buffer donation has the
+dual hazard: an array passed at a ``donate_argnums`` position is
+invalidated by the call, and any later use of that name reads a deleted
+buffer (PR 3's hand-enforced "never donate the serving view" rule).
+
+Jitted functions are discovered from decorators (``@jax.jit``,
+``@partial(jax.jit, ...)``), wrapper assignments
+(``f_jit = jax.jit(f, ...)``, ``f_jit = partial(jax.jit, ...)(f)``) and
+Pallas kernels (first argument of ``pl.pallas_call``). Donated argument
+positions ride the same discovery, so a call to a donated wrapper
+invalidates the names it consumed for the rest of the function — unless
+the call's own statement rebinds them (``y = f_donated(..., y, ...)``,
+the supported carry idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.oryxlint.callgraph import ProjectIndex
+from tools.oryxlint.core import Checker, Finding, Project, SourceModule
+
+MUTATOR_METHODS = frozenset({
+    "append", "extend", "insert", "add", "update", "setdefault",
+    "pop", "popitem", "remove", "discard", "clear",
+})
+
+LOG_METHODS = frozenset({"debug", "info", "warning", "error", "exception", "critical"})
+
+METRICS_MODULE = "oryx_tpu/common/metrics.py"
+# method names unambiguous enough to treat as metrics calls when every
+# project definer lives in common/metrics.py. "set" is deliberately
+# absent: jitted code uses the `.at[idx].set(...)` idiom everywhere, and
+# other project classes define set too — a rename there would flip the
+# all-definers-in-metrics test and mass-flag functional updates.
+METRIC_METHODS = frozenset({"inc", "dec", "observe"})
+
+
+def _is_jit_dotted(dotted: str | None) -> bool:
+    return dotted is not None and (
+        dotted == "jax.jit" or dotted == "jit" or dotted.endswith(".pjit")
+        or dotted == "pjit"
+    )
+
+
+def _is_partial_dotted(dotted: str | None) -> bool:
+    return dotted in ("functools.partial", "partial")
+
+
+def _donate_positions(call: ast.Call) -> tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for e in v.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.append(e.value)
+            return tuple(out)
+    return ()
+
+
+class JaxPurityChecker(Checker):
+    name = "jaxpurity"
+    rules = {
+        "jit-side-effect": (
+            "Python side effect (print/log/metrics/time/host-RNG/"
+            "closed-over mutation) inside a jax.jit/pjit/Pallas-traced "
+            "function — it runs at trace time, not per call"
+        ),
+        "donation-reuse": (
+            "a buffer passed at a donate_argnums position is used again "
+            "after the donating call invalidated it"
+        ),
+    }
+
+    def check(self, project: Project) -> list[Finding]:
+        idx = ProjectIndex(project)
+        findings: list[Finding] = []
+        jitted, donated = self._discover(idx)
+        for mod, fn in jitted:
+            self._check_purity(idx, mod, fn, findings)
+        for fi in idx.functions:
+            self._check_donation(idx, fi, donated, findings)
+        return findings
+
+    # -- discovery -----------------------------------------------------------
+
+    def _discover(self, idx: ProjectIndex):
+        """(jitted function defs, donated-callable registry). The registry
+        maps (module relpath, local name) -> donated arg positions."""
+        jitted: list[tuple[SourceModule, ast.AST]] = []
+        # (module relpath, local name) -> ((arg position, condition-kwarg
+        # or None for unconditional), ...)
+        donated: dict[tuple[str, str], tuple[tuple[int, str | None], ...]] = {}
+
+        def jit_call_info(mod, call):
+            """(is_jit_wrapper, donate_positions) of a Call expression."""
+            d = idx.dotted_name(mod, call.func)
+            if _is_jit_dotted(d):
+                return True, _donate_positions(call)
+            # partial(jax.jit, ...): the partial itself carries the kwargs
+            if (
+                _is_partial_dotted(d)
+                and call.args
+                and _is_jit_dotted(idx.dotted_name(mod, call.args[0]))
+            ):
+                return True, _donate_positions(call)
+            return False, ()
+
+        for fi in idx.functions:
+            mod = fi.module
+            for dec in getattr(fi.node, "decorator_list", []):
+                if _is_jit_dotted(idx.dotted_name(mod, dec)):
+                    jitted.append((mod, fi.node))
+                    break
+                if isinstance(dec, ast.Call):
+                    is_jit, pos = jit_call_info(mod, dec)
+                    # @partial(jax.jit, ...) decorates the def directly
+                    wraps_def = is_jit and (
+                        _is_partial_dotted(idx.dotted_name(mod, dec.func))
+                        or _is_jit_dotted(idx.dotted_name(mod, dec.func))
+                    )
+                    if wraps_def:
+                        jitted.append((mod, fi.node))
+                        if pos:
+                            donated[(mod.relpath, fi.node.name)] = tuple(
+                                (i, None) for i in pos
+                            )
+                        break
+
+        for mod in idx.project.modules:
+            for node in ast.walk(mod.tree):
+                # X = jax.jit(f, ...) and X = partial(jax.jit, ...)(f)
+                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                    call = node.value
+                    inner = None
+                    is_jit, pos = jit_call_info(mod, call)
+                    if is_jit and call.args and isinstance(call.args[0], ast.Name):
+                        maybe_fn = idx.top_level.get(
+                            (mod.relpath, call.args[0].id)
+                        )
+                        if maybe_fn is not None and not _is_jit_dotted(
+                            idx.dotted_name(mod, call.args[0])
+                        ):
+                            inner = maybe_fn
+                    elif isinstance(call.func, ast.Call):
+                        outer_jit, pos = jit_call_info(mod, call.func)
+                        if outer_jit and call.args and isinstance(
+                            call.args[0], ast.Name
+                        ):
+                            inner = idx.top_level.get(
+                                (mod.relpath, call.args[0].id)
+                            )
+                    if inner is not None:
+                        jitted.append((mod, inner.node))
+                        if pos:
+                            for t in node.targets:
+                                if isinstance(t, ast.Name):
+                                    donated[(mod.relpath, t.id)] = tuple(
+                                        (i, None) for i in pos
+                                    )
+                # pl.pallas_call(kernel, ...): the kernel is traced
+                if isinstance(node, ast.Call):
+                    d = idx.dotted_name(mod, node.func)
+                    if d is not None and (
+                        d.endswith(".pallas_call") or d == "pallas_call"
+                    ):
+                        if node.args and isinstance(node.args[0], ast.Name):
+                            k = idx.top_level.get((mod.relpath, node.args[0].id))
+                            if k is not None:
+                                jitted.append((mod, k.node))
+        # hand-written wrappers declaring a donation contract by
+        # annotation (`donates=<pos> [when <kwarg>]`) join the registry —
+        # e.g. ops/transfer.scatter_rows, whose donate=True form consumes
+        # the serving-view buffer exactly like donate_argnums would
+        for fi in idx.functions:
+            ann = fi.module.fn_donates(fi.node)
+            if ann is not None and fi.cls is None and fi.parent is None:
+                key = (fi.module.relpath, fi.name)
+                donated[key] = donated.get(key, ()) + (ann,)
+        # dedupe by node identity
+        seen: set[int] = set()
+        uniq = []
+        for mod, fn in jitted:
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                uniq.append((mod, fn))
+        return uniq, donated
+
+    # -- purity --------------------------------------------------------------
+
+    def _check_purity(self, idx, mod, fn, findings: list[Finding]) -> None:
+        local: set[str] = {a.arg for a in fn.args.args}
+        local.update(a.arg for a in fn.args.kwonlyargs)
+        local.update(a.arg for a in fn.args.posonlyargs)
+        if fn.args.vararg:
+            local.add(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            local.add(fn.args.kwarg.arg)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                local.add(node.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local.add(node.name)
+
+        def flag(line, what):
+            findings.append(Finding(
+                mod.relpath, line, "jit-side-effect",
+                f"{what} inside jitted function {fn.name!r} "
+                f"({mod.relpath}:{fn.lineno}): it executes at trace time, "
+                "not per call — hoist it out of the traced function",
+            ))
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Name) and f.id == "print":
+                    flag(node.lineno, "print()")
+                    continue
+                dotted = idx.dotted_name(mod, f)
+                if dotted is not None:
+                    if dotted.startswith("time."):
+                        flag(node.lineno, f"{dotted}() wall-clock read")
+                        continue
+                    if dotted.startswith(("numpy.random.", "random.")):
+                        flag(
+                            node.lineno,
+                            f"{dotted}() host RNG (use an explicit "
+                            "jax.random key)",
+                        )
+                        continue
+                if isinstance(f, ast.Attribute):
+                    recv = f.value
+                    if f.attr in LOG_METHODS and isinstance(recv, ast.Name) and (
+                        "log" in recv.id.lower()
+                    ):
+                        flag(node.lineno, f"logging call .{f.attr}()")
+                        continue
+                    if f.attr in METRIC_METHODS:
+                        definers = idx.methods_by_name.get(f.attr, [])
+                        if definers and all(
+                            d.module.relpath == METRICS_MODULE for d in definers
+                        ):
+                            flag(node.lineno, f"metrics call .{f.attr}()")
+                            continue
+                    if (
+                        f.attr in MUTATOR_METHODS
+                        and isinstance(recv, ast.Name)
+                        and recv.id not in local
+                    ):
+                        flag(
+                            node.lineno,
+                            f"mutation of closed-over {recv.id!r} "
+                            f"(.{f.attr}())",
+                        )
+                        continue
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id not in local
+                    ):
+                        flag(
+                            node.lineno,
+                            f"item assignment into closed-over "
+                            f"{t.value.id!r}",
+                        )
+
+    # -- donation -------------------------------------------------------------
+
+    def _check_donation(self, idx, fi, donated, findings: list[Finding]) -> None:
+        mod = fi.module
+        if not donated:
+            return
+        # name -> sorted store line numbers (rebinds revive a donated name)
+        stores: dict[str, list[int]] = {}
+        loads: dict[str, list[int]] = {}
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Name):
+                d = stores if isinstance(node.ctx, ast.Store) else loads
+                d.setdefault(node.id, []).append(node.lineno)
+        for call in ast.walk(fi.node):
+            if not isinstance(call, ast.Call):
+                continue
+            fname = None
+            if isinstance(call.func, ast.Name):
+                fname = call.func.id
+            if fname is None:
+                continue
+            pos = donated.get((mod.relpath, fname))
+            if pos is None:
+                # imported donated wrapper
+                imp = idx.imports.get(mod.relpath, {}).get(fname)
+                if imp is not None and imp[0] == "sym":
+                    rel = imp[1].replace(".", "/") + ".py"
+                    pos = donated.get((rel, imp[2]))
+            if not pos:
+                continue
+            for i, cond in pos:
+                if cond is not None and not any(
+                    kw.arg == cond
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                    for kw in call.keywords
+                ):
+                    continue  # conditional donation not taken at this site
+                if i >= len(call.args):
+                    continue
+                arg = call.args[i]
+                if not isinstance(arg, ast.Name):
+                    continue
+                line = call.lineno
+                later_stores = [l for l in stores.get(arg.id, []) if l >= line]
+                for use in sorted(loads.get(arg.id, [])):
+                    if use <= line:
+                        continue
+                    if any(line <= s <= use for s in later_stores):
+                        break  # rebound before (or at) the use: revived
+                    findings.append(Finding(
+                        mod.relpath, use, "donation-reuse",
+                        f"{arg.id!r} was donated to {fname}() at line "
+                        f"{line} (donate_argnums position {i}) and is "
+                        "used again here — the donated buffer is "
+                        "invalidated by the call",
+                    ))
+                    break

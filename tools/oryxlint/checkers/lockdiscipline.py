@@ -1,0 +1,164 @@
+"""Lock-discipline checker (rule ``guarded-by``).
+
+Shared mutable attributes of threaded classes declare their lock with a
+trailing comment on the attribute's declaration (normally in
+``__init__``). Every other access of that attribute inside the class
+must then sit lexically inside a matching ``with self.<lock>:`` block —
+the statically checkable form of the invariant PR 2 fixed by hand when
+metric read paths raced their writers.
+
+Conventions the checker understands:
+
+- alternatives: a declaration may name several acceptable locks
+  separated by ``|`` (rare; prefer one lock per attribute).
+- condition aliases: ``self._cond = threading.Condition(self._lock)``
+  makes ``with self._cond:`` hold ``_lock`` — detected automatically
+  from the constructor call, no annotation needed.
+- write-only guarding: a ``(writes)`` qualifier checks only stores.
+  This is the contract of snapshot-swap state (e.g. the ALS serving
+  view tuples): mutation is serialized under the lock, readers take a
+  consistent reference lock-free by design.
+- held-by-contract: a method whose callers all hold the lock (the
+  "call under _lock" docstring idiom) declares it with an
+  ``oryxlint: holds=<lock>`` annotation on its ``def`` line; accesses
+  inside are treated as locked. The annotation is trust, not proof —
+  but it is grep-able, uniform, and the call sites stay checked.
+- ``__init__`` is exempt: construction precedes sharing.
+- nested functions and lambdas reset the held-lock set — a closure
+  created under a lock does not *run* under it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.oryxlint.callgraph import ClassInfo, ProjectIndex
+from tools.oryxlint.core import Checker, Finding, Project
+
+
+class _Guard:
+    __slots__ = ("attr", "alts", "writes_only", "decl_line")
+
+    def __init__(self, attr, alts, writes_only, decl_line):
+        self.attr = attr
+        self.alts = alts
+        self.writes_only = writes_only
+        self.decl_line = decl_line
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class LockDisciplineChecker(Checker):
+    name = "lockdiscipline"
+    rules = {
+        "guarded-by": (
+            "an attribute declared `guarded-by: <lock>` is accessed "
+            "outside a `with self.<lock>:` block (and outside any "
+            "`holds=` contract)"
+        ),
+    }
+
+    def check(self, project: Project) -> list[Finding]:
+        idx = ProjectIndex(project)
+        findings: list[Finding] = []
+        for ci in idx.classes.values():
+            guards = self._collect_guards(ci)
+            if guards:
+                self._check_class(ci, guards, findings)
+        return findings
+
+    # -- declaration collection --------------------------------------------
+
+    def _collect_guards(self, ci: ClassInfo) -> dict[str, _Guard]:
+        mod = ci.module
+        guards: dict[str, _Guard] = {}
+        for node in ast.walk(ci.node):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            else:
+                continue
+            ann = mod.guarded_lines.get(node.lineno)
+            if ann is None:
+                continue
+            alts, writes_only = ann
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    guards[attr] = _Guard(attr, alts, writes_only, node.lineno)
+        return guards
+
+    # -- access checking ----------------------------------------------------
+
+    def _norm(self, ci: ClassInfo, lock: str) -> str:
+        """Condition aliases resolve to their underlying lock."""
+        return ci.lock_aliases.get(lock, lock)
+
+    def _check_class(
+        self, ci: ClassInfo, guards: dict[str, _Guard], findings: list[Finding]
+    ) -> None:
+        for name, fi in ci.methods.items():
+            if name == "__init__":
+                continue  # construction precedes sharing
+            held = frozenset(self._norm(ci, l) for l in fi.holds)
+            self._visit(ci, guards, list(fi.node.body), held, findings)
+
+    def _visit(self, ci, guards, body, held, findings) -> None:
+        for node in body:
+            self._visit_node(ci, guards, node, held, findings)
+
+    def _visit_node(self, ci, guards, node, held, findings) -> None:
+        mod = ci.module
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a closure created here runs later, without these locks —
+            # only its own holds= contract applies
+            inner = frozenset(self._norm(ci, l) for l in mod.fn_holds(node))
+            self._visit(ci, guards, list(node.body), inner, findings)
+            return
+        if isinstance(node, ast.Lambda):
+            self._visit_expr(ci, guards, node.body, frozenset(), findings)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            newly = set()
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None:
+                    newly.add(self._norm(ci, attr))
+                self._visit_expr(
+                    ci, guards, item.context_expr, held, findings
+                )
+            self._visit(ci, guards, list(node.body), held | newly, findings)
+            return
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            g = guards.get(attr) if attr is not None else None
+            if g is not None and node.lineno != g.decl_line:
+                is_store = isinstance(node.ctx, (ast.Store, ast.Del))
+                if (is_store or not g.writes_only) and not (
+                    held & {self._norm(ci, a) for a in g.alts}
+                ):
+                    lock = "|".join(g.alts)
+                    kind = "write to" if is_store else "read of"
+                    findings.append(Finding(
+                        mod.relpath, node.lineno, "guarded-by",
+                        f"{kind} self.{attr} outside `with self.{lock}:` "
+                        f"(declared guarded-by {lock} at "
+                        f"{mod.relpath}:{g.decl_line}); hold the lock, or "
+                        "mark the whole function with `oryxlint: "
+                        f"holds={lock}` if every caller already does",
+                    ))
+            # still recurse: the receiver chain may hold guarded reads
+        for child in ast.iter_child_nodes(node):
+            self._visit_node(ci, guards, child, held, findings)
+
+    def _visit_expr(self, ci, guards, expr, held, findings) -> None:
+        self._visit_node(ci, guards, expr, held, findings)
