@@ -1912,6 +1912,113 @@ def _bench_speed_body() -> None:
     )
 
 
+def _bench_seq_body() -> None:
+    """The fourth packaged app's three numbers (ISSUE 10): windowed-
+    sequence ingest throughput (parse -> sessionize -> fixed-length
+    next-item examples, the tf.data-style pipeline-of-windows), next-item
+    serving qps (GRU encode + top-k over the item-embedding matrix — the
+    exact matmul shape the serving batcher dispatches), and hit-rate@10
+    on held-out final transitions via the SAME harness as nightly quality
+    gate 5 (ml/quality.py build_and_evaluate_seq)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from oryx_tpu.bus.api import KeyMessage
+    from oryx_tpu.common.rng import RandomManager
+    from oryx_tpu.ml.quality import build_and_evaluate_seq, synthesize_sessions
+    from oryx_tpu.ops.als import topk_dot_batch
+    from oryx_tpu.ops.seq import GRU_PARAM_NAMES, encode_vectors, train_gru
+    from oryx_tpu.apps.seq.common import (
+        parse_session_events, sessionize, item_sequences, windowed_examples,
+    )
+
+    RandomManager.use_test_seed(9)
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+
+    # ---- stage 1: windowed ingest throughput ----------------------------
+    n_items, n_sessions, session_len = (
+        (50_000, 40_000, 12) if on_accel else (5_000, 10_000, 10)
+    )
+    sessions = synthesize_sessions(n_items, n_sessions, session_len, seed=5)
+    lines = []
+    for j, s in enumerate(sessions):
+        for t, it in enumerate(s):
+            lines.append(
+                KeyMessage(None, f"u{j % 997},s{j},i{it},{1000 + j * 100 + t}")
+            )
+    n_events = len(lines)
+    t0 = time.perf_counter()
+    users, sess, items, tss = parse_session_events(lines)
+    by_session = item_sequences(sessionize(users, sess, items, tss))
+    vocab = {f"i{i}": i for i in range(n_items)}
+    contexts, mask, targets = windowed_examples(by_session, vocab, window=8)
+    ingest_s = time.perf_counter() - t0
+    window_eps = n_events / ingest_s
+    print(
+        f"seq ingest: {n_events} events -> {len(targets)} examples in "
+        f"{ingest_s:.2f}s ({window_eps:.0f} events/s)", file=sys.stderr,
+    )
+
+    # ---- stage 2: quality harness (build seconds + hit-rate@10) ---------
+    rep = build_and_evaluate_seq(
+        **(dict(n_items=20_000, n_sessions=20_000, session_len=10, epochs=10)
+           if on_accel else
+           dict(n_items=2_000, n_sessions=3_000, session_len=10, epochs=10))
+    )
+    print(
+        f"seq build: {rep.build_s:.1f}s hit@10 {rep.hit_rate:.3f} "
+        f"({rep.examples} examples, chance {rep.chance:.4f})", file=sys.stderr,
+    )
+
+    # ---- stage 3: next-item qps (encode + top-k over E) -----------------
+    dim = 32
+    qv = n_items if on_accel else 5_000
+    model, _ = train_gru(
+        contexts[:4096], mask[:4096], targets[:4096],
+        n_items=n_items, dim=dim, item_ids=[str(j) for j in range(n_items)],
+        epochs=1, seed_key=jax.random.PRNGKey(0),
+    )
+    e_dev = jnp.asarray(model.e[:qv], dtype=jnp.bfloat16)
+    params_j = {k: jnp.asarray(model.params[k]) for k in GRU_PARAM_NAMES}
+    batch = 4096 if on_accel else 256
+    ctx_b = jnp.asarray(contexts[:batch] % qv)
+    mask_b = jnp.asarray(mask[:batch])
+
+    def serve_round():
+        h = encode_vectors(params_j, e_dev.astype(jnp.float32)[ctx_b], mask_b)
+        return topk_dot_batch(h.astype(jnp.bfloat16), e_dev, k=10)
+
+    jax.block_until_ready(serve_round())  # compile
+    n, t0, pending = 0, time.perf_counter(), None
+    while time.perf_counter() - t0 < 3.0:
+        _, idx = serve_round()
+        idx.copy_to_host_async()
+        if pending is not None:
+            np.asarray(pending)
+            n += batch
+        pending = idx
+    np.asarray(pending)
+    qps = (n + batch) / (time.perf_counter() - t0)
+    print(f"seq next-item qps: {qps:.0f} at {qv} items", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "seq_next_qps",
+        "value": round(qps, 1),
+        "unit": "qps",
+        "platform": platform,
+        "seq_window_events_per_sec": round(window_eps, 1),
+        "seq_window_events": n_events,
+        "seq_window_examples": int(targets.shape[0]),
+        "seq_hit_rate_at_10": round(rep.hit_rate, 4),
+        "seq_hit_rate_chance": round(rep.chance, 4),
+        "seq_build_seconds": round(rep.build_s, 1),
+        "seq_items": qv,
+        "seq_batch": batch,
+    }))
+
+
 # models above _CHUNK_OVER_BYTES score through topk_dot_batch_chunked in
 # ~_CHUNK_TARGET_BYTES row chunks — the SAME thresholds production
 # serving uses (ops/transfer.py), re-exported as module attributes so
@@ -2377,6 +2484,27 @@ def _merge_fleet(result: dict, row: dict) -> None:
         result["fleet_scaling_efficiency"] = row["fleet_scaling_efficiency"]
 
 
+def _merge_seq(result: dict, row: dict) -> None:
+    """Seq-app block lands nested, with the three ratchetable numbers
+    promoted to the compact final line."""
+    result["seq"] = {
+        key: row[key]
+        for key in (
+            "seq_window_events_per_sec", "seq_window_events",
+            "seq_window_examples", "seq_hit_rate_at_10",
+            "seq_hit_rate_chance", "seq_build_seconds", "seq_items",
+            "seq_batch", "platform",
+        )
+        if key in row
+    }
+    result["seq"]["seq_next_qps"] = row.get("value")
+    result["seq_next_qps"] = row.get("value")
+    if row.get("seq_window_events_per_sec") is not None:
+        result["seq_window_events_per_sec"] = row["seq_window_events_per_sec"]
+    if row.get("seq_hit_rate_at_10") is not None:
+        result["seq_hit_rate_at_10"] = row["seq_hit_rate_at_10"]
+
+
 def _merge_lsh(result: dict, row: dict) -> None:
     result["lsh_qps"] = row.get("value")
     result["lsh_vs_baseline"] = row.get("vs_baseline")
@@ -2418,6 +2546,7 @@ _SUITE_STAGES = (
     # bus publish and ~1.5 min of replica assemble/JIT before the
     # measured windows even start
     ("_bench_fleet_body", 480, False, _merge_fleet, True),
+    ("_bench_seq_body", 300, False, _merge_seq, False),
     ("_bench_scale_body", 900, True, _merge_scaling, False),
 )
 
@@ -2432,8 +2561,8 @@ _ACCEL_STAGE_ORDER = (
     "_bench_body", "_bench_scale_body", "_bench_http_body",
     "_bench_update_storm_body", "_bench_train_body",
     "_bench_generations_body", "_bench_speed_body",
-    "_bench_kmeans_rdf_body", "_bench_http_lsh_body",
-    "_bench_fleet_body",
+    "_bench_kmeans_rdf_body", "_bench_seq_body",
+    "_bench_http_lsh_body", "_bench_fleet_body",
 )
 
 

@@ -1,0 +1,5 @@
+"""Packaged apps. The registry of their wiring lives in
+oryx_tpu/apps/spi.py (AppSpec / get_app / app_overlay) — imported
+lazily by the CLI's --app lookup so `import oryx_tpu.apps` stays free of
+app code.
+"""

@@ -462,32 +462,14 @@ class ALSUpdate(MLUpdate):
 
     def split_train_test(self, data: Sequence[KeyMessage]):
         """Temporal split: newest test-fraction of events held out
-        (ALSUpdate.java:325-342 sorts by timestamp). Timestamps are read
-        per-line in place (unparseable lines get -1 and stay in train) so
-        indices always align with `data` even when lines are skipped."""
-        if self.test_fraction <= 0 or len(data) == 0:
-            return data, []
-        from oryx_tpu.common.text import parse_input_line
+        (ALSUpdate.java:325-342 sorts by timestamp) — the shared
+        split_by_time helper (ml/update.py), falling back to the random
+        split when no line carries a usable timestamp."""
+        from oryx_tpu.ml.update import split_by_time
 
-        ts = np.full(len(data), -1, dtype=np.int64)
-        for j, km in enumerate(data):
-            try:
-                tok = parse_input_line(km.message)
-                if len(tok) > 3 and tok[3] != "":
-                    ts[j] = int(float(tok[3]))
-            except (ValueError, IndexError):
-                pass
-        valid = ts[ts >= 0]
-        if len(valid) == 0 or np.all(valid == valid[0]):
-            return super().split_train_test(data)
-        order = np.argsort(ts, kind="stable")
-        n_test = int(len(data) * self.test_fraction)
-        if n_test == 0:
-            return data, []
-        test_set = set(order[-n_test:].tolist())
-        train = [d for j, d in enumerate(data) if j not in test_set]
-        test = [d for j, d in enumerate(data) if j in test_set]
-        return train, test
+        return split_by_time(
+            data, self.test_fraction, super().split_train_test
+        )
 
     def _aggregate(self, data: Sequence[KeyMessage]):
         users, items, vals, tss = parse_events(data)
@@ -618,6 +600,10 @@ class ALSUpdate(MLUpdate):
                 producer, serialized, model_path, self.max_message_size,
                 transfer=self.artifact_transfer,
             )
+        # freshness stamp (SPI contract: every publish_model override ends
+        # with this) — before the PR 10 SPI split, ALS generations were
+        # invisible to oryx_model_generation / update-to-serve freshness
+        self.send_publish_stamp(model_path, producer)
 
     def publish_additional_model_data(
         self, model: ModelArtifact, model_path: str, producer: TopicProducer

@@ -10,7 +10,6 @@ ALSSpeedModelManager.buildUpdates payload shapes).
 
 from __future__ import annotations
 
-import json
 from dataclasses import dataclass
 
 import numpy as np
@@ -120,7 +119,9 @@ def valid_event_line(line: str) -> bool:
             float(tok[2])
         if len(tok) > 3 and tok[3] != "":
             int(float(tok[3]))
-    except (ValueError, IndexError, TypeError):
+    except (ValueError, IndexError, TypeError, OverflowError):
+        # OverflowError: int(float("1e400")) — an exception escaping this
+        # hook would bypass the layers' quarantine sweep entirely
         return False
     return True
 
@@ -206,67 +207,20 @@ def parse_events(data) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     )
 
 
-# UP-message float precision, shared by the single-message and batched
-# builders so their payloads stay byte-identical (pinned by
-# tests/test_als_state.py::test_batch_update_messages_byte_parity)
-_ROUND_DECIMALS = 6
-
-
-def _round6(vector) -> list:
-    # vectorized: a per-element Python round() dominates UP-message cost
-    # at speed-tier rates (two messages per folded event)
-    return np.round(np.asarray(vector, dtype=np.float64), _ROUND_DECIMALS).tolist()
+# UP-message codec: the generic builders/parser moved to
+# oryx_tpu/apps/updates.py (the app-SPI split — the seq app shares them
+# with kind "E"); these ALS-named wrappers keep every existing call site
+# and the byte-parity pin (tests/test_als_state.py) working unchanged.
+from oryx_tpu.apps.updates import (  # noqa: F401 - re-exported API
+    batch_update_messages,
+    parse_update_message,
+    vector_update_message,
+)
 
 
 def x_update_message(user_id: str, vector, known_items) -> tuple[str, str]:
-    return "UP", json.dumps(
-        ["X", user_id, _round6(vector), sorted(known_items)],
-        separators=(",", ":"),
-    )
+    return vector_update_message("X", user_id, vector, known=known_items)
 
 
 def y_update_message(item_id: str, vector) -> tuple[str, str]:
-    return "UP", json.dumps(
-        ["Y", item_id, _round6(vector)], separators=(",", ":")
-    )
-
-
-def batch_update_messages(
-    kind: str, ids, vectors, known_lists=None
-) -> list[tuple[str, str]]:
-    """Batch of UP messages, byte-identical to the single-message path:
-    ONE json.dumps serializes the whole [N,K] rounded block through the C
-    encoder, and the blob splits on "],[" into per-row number strings
-    (rows contain only numbers and commas, so the separator is
-    unambiguous). Per-message dumps of the vector floats — 120k Python
-    encoder invocations per 20k-event micro-batch — was ~45% of speed-tier
-    build time. Callers must pre-filter non-finite rows (NaN/Infinity are
-    not valid JSON)."""
-    n = len(ids)
-    if n == 0:
-        return []
-    vecs = np.round(np.asarray(vectors, dtype=np.float64), _ROUND_DECIMALS)
-    blob = json.dumps(vecs.tolist(), separators=(",", ":"))
-    rows = blob[2:-2].split("],[")
-    assert len(rows) == n
-    out = []
-    for j, ident in enumerate(ids):
-        if known_lists is not None:
-            out.append((
-                "UP",
-                f'["{kind}",{json.dumps(ident)},[{rows[j]}],'
-                f'{json.dumps(sorted(known_lists[j]), separators=(",", ":"))}]',
-            ))
-        else:
-            out.append((
-                "UP", f'["{kind}",{json.dumps(ident)},[{rows[j]}]]',
-            ))
-    return out
-
-
-def parse_update_message(message: str):
-    """-> (kind 'X'|'Y', id, np vector, known_ids list)."""
-    arr = json.loads(message)
-    kind, ident, vec = arr[0], str(arr[1]), np.asarray(arr[2], dtype=np.float32)
-    known = [str(k) for k in arr[3]] if len(arr) > 3 and arr[3] else []
-    return kind, ident, vec, known
+    return vector_update_message("Y", item_id, vector)

@@ -24,7 +24,6 @@ import jax.numpy as jnp
 
 from oryx_tpu.api import AbstractServingModelManager, ServingModel
 from oryx_tpu.common.config import Config
-from oryx_tpu.common.metrics import MICROBATCH_BUCKETS, get_registry
 from oryx_tpu.common.tracing import get_tracer
 from oryx_tpu.ops.als import compute_updated_xu
 from oryx_tpu.apps.als.common import ALSConfig
@@ -109,92 +108,20 @@ class SyncConfig:
         return SyncConfig(mode, headroom, frac)
 
 
-_SYNC_METRICS = None
-_SYNC_METRICS_LOCK = threading.Lock()
+# Sync metric families + dirty-delta id extension moved to the shared
+# serving/viewsync.py (the app-SPI split: the seq device view reports
+# into the same oryx_device_sync_* vocabulary). ALS-local aliases keep
+# every internal call site unchanged.
+from oryx_tpu.serving.viewsync import (  # noqa: E402 - after module setup
+    extend_view_ids as _extend_ids,
+    view_sync_metrics as _sync_metrics,
+)
 
-
-def _sync_metrics():
-    """(bytes counter, seconds histogram, resync counter, lsh histogram) —
-    process-wide, lazily registered so importing this module never touches
-    the registry."""
-    global _SYNC_METRICS
-    if _SYNC_METRICS is None:
-        with _SYNC_METRICS_LOCK:
-            if _SYNC_METRICS is None:
-                reg = get_registry()
-                _SYNC_METRICS = (
-                    reg.counter(
-                        "oryx_device_sync_bytes",
-                        "host->device bytes moved keeping serving views in "
-                        "sync (delta scatters move dirty rows; full "
-                        "resyncs move the whole matrix)",
-                    ),
-                    reg.histogram(
-                        "oryx_device_sync_seconds",
-                        "wall-clock per serving view resync (delta or full)",
-                        buckets=MICROBATCH_BUCKETS,
-                    ),
-                    reg.counter(
-                        "oryx_view_resync_total",
-                        "serving view resyncs by kind (delta = dirty-row "
-                        "scatter; full = snapshot rebuild, including the "
-                        "initial load)",
-                        labeled=True,
-                    ),
-                    reg.histogram(
-                        "oryx_lsh_rebuild_seconds",
-                        "wall-clock per full LSH partition-index rebuild "
-                        "(delta reassignments ride oryx_device_sync_seconds)",
-                        buckets=MICROBATCH_BUCKETS,
-                    ),
-                )
-    return _SYNC_METRICS
-
-_POST_POOL = None
-_POST_POOL_LOCK = threading.Lock()
-_POST_POOL_WORKERS = 8  # overridden from config by the serving manager
-
-
-def configure_post_pool(workers: int) -> None:
-    """Size the post-processing pool (oryx.serving.api.post-workers) —
-    takes effect at first use; an already-created pool keeps its size."""
-    global _POST_POOL_WORKERS
-    _POST_POOL_WORKERS = max(1, int(workers))
-
-
-def _post_pool():
-    """Shared pool for per-request post-processing chained off batcher
-    futures (sized for trim/render work; a rescorer that blocks holds one
-    of these threads, never the batcher dispatcher — and blocking top_n()
-    callers post-process on their own thread, so nested rescorer queries
-    cannot exhaust this pool into a deadlock)."""
-    global _POST_POOL
-    if _POST_POOL is None:
-        with _POST_POOL_LOCK:
-            if _POST_POOL is None:
-                from concurrent.futures import ThreadPoolExecutor
-
-                _POST_POOL = ThreadPoolExecutor(
-                    max_workers=_POST_POOL_WORKERS,
-                    thread_name_prefix="oryx-topn-post",
-                )
-    return _POST_POOL
-
-
-def _extend_ids(ids: list, delta) -> list | None:
-    """Extend a view's id list with the delta's appended rows, in row
-    order. Every index in [len(ids), delta.n) was dirty-logged by the
-    write that created it, so the delta must carry its id; None (with a
-    warning — the caller falls back to a full resync) if that invariant
-    ever breaks."""
-    if delta.n <= len(ids):
-        return ids
-    by_row = dict(zip((int(r) for r in delta.rows), delta.ids))
-    try:
-        return ids + [by_row[r] for r in range(len(ids), delta.n)]
-    except KeyError:  # pragma: no cover - log invariant broken
-        log.warning("delta missing ids for appended rows; full resync")
-        return None
+# Post-processing pool moved to serving/app.py (post_pool /
+# configure_post_pool) in the app-SPI split: every app whose endpoints
+# chain work off batcher futures shares it. ALS-local aliases kept for
+# existing importers.
+from oryx_tpu.serving.app import configure_post_pool, post_pool as _post_pool  # noqa: F401,E402
 
 
 class _LshPartitions:

@@ -1,0 +1,9 @@
+"""Streaming session next-item recommender — the fourth packaged app.
+
+Schema: CSV lines ``user,session,item,ts``. The batch tier windows
+session event streams into fixed-length next-item examples (tf.data's
+pipeline-of-windows pattern) and trains a compact GRU (ops/seq.py); the
+speed tier folds new/extended sessions into the item-embedding state as
+UP row deltas; serving answers ``GET /recommend-next/...`` over the
+item-embedding matrix through the shared top-k micro-batcher.
+"""

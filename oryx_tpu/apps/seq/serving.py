@@ -1,0 +1,245 @@
+"""Seq serving tier: GRU session encoder + top-k over item embeddings.
+
+The request path is the ALS shape on purpose: encode the session's item
+history into a hidden state (the "user vector"), then score the whole
+catalog with ONE matmul + top-k through the shared micro-batcher
+(serving/batcher.py) — so coalesced dispatch, shedding, host fallback,
+and perfstats MFU all apply unchanged. The device view is a
+capacity-padded bf16 matrix kept in step with the live FactorStore by
+dirty-row deltas (PR 3's delta_since + scatter_rows): a speed-layer UP
+storm re-uploads only the touched rows, and growth within the headroom
+scatters into reserved padding rows without changing the batcher's
+compiled dispatch shape.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent.futures import Future
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from oryx_tpu.api import AbstractServingModelManager, ServingModel
+from oryx_tpu.common.config import Config
+from oryx_tpu.serving.app import chain_future, configure_post_pool, post_pool
+from oryx_tpu.serving.batcher import TopKBatcher
+from oryx_tpu.apps.seq.common import SeqConfig
+from oryx_tpu.apps.seq.state import SeqState, apply_seq_update
+from oryx_tpu.ops.seq import encode_sessions
+
+log = logging.getLogger(__name__)
+
+
+class SeqServingModel(ServingModel):
+    def __init__(self, state: SeqState, sync=None):
+        from oryx_tpu.apps.als.serving import SyncConfig
+
+        self.state = state
+        self.sync = sync or SyncConfig()
+        self._sync_lock = threading.Lock()
+        # (device E [capacity,d] bf16, ids [n], version, host f32 mirror)
+        # swapped as ONE tuple — readers take the snapshot lock-free
+        self._device_view: tuple | None = None
+
+    def fraction_loaded(self) -> float:
+        return self.state.fraction_loaded()
+
+    def served_version(self) -> int | None:
+        view = self._device_view
+        return None if view is None else view[2]
+
+    # -- device view (FactorStore delta sync) ------------------------------
+
+    def _view(self) -> tuple:
+        view = self._device_view
+        if view is not None and view[2] == self.state.items.get_version():
+            return view
+        with self._sync_lock:
+            view = self._device_view
+            if view is not None and view[2] == self.state.items.get_version():
+                return view
+            if view is not None and self._try_apply_delta(view):
+                return self._device_view
+            return self._build_view_full()
+
+    def _try_apply_delta(self, view: tuple) -> bool:
+        """Catch the device view up by dirty-row scatter. Call under
+        _sync_lock. Returns False when only a full rebuild can serve
+        (drift overflow, growth past capacity, arena compaction after a
+        model swap). NOT donated: in-flight coalesced dispatches still
+        score the old buffer — the functional scatter IS the double
+        buffer (ops/transfer.py scatter_rows contract)."""
+        from oryx_tpu.ops.transfer import scatter_rows
+
+        from oryx_tpu.serving.viewsync import extend_view_ids, view_sync_metrics
+        import time as _time
+
+        t0 = _time.monotonic()
+        y_dev, ids, _version, host_mat = view
+        n_old = len(ids)
+        capacity = int(host_mat.shape[0])
+        delta = self.state.items.delta_since(
+            view[2],
+            max_rows=max(1, int(self.sync.max_delta_fraction * max(n_old, 1))),
+        )
+        if delta is None or delta.n > capacity:
+            return False
+        if delta.rows.size == 0:
+            return True
+        ids = extend_view_ids(ids, delta)
+        if ids is None:
+            return False
+        host_mat[delta.rows] = delta.mat
+        y_new = scatter_rows(y_dev, delta.rows, delta.mat)
+        self._device_view = (y_new, ids, delta.version, host_mat)
+        from oryx_tpu.ops.transfer import scatter_transfer_bytes
+
+        m_bytes, m_secs, m_total, _ = view_sync_metrics()
+        n_bytes = scatter_transfer_bytes(delta.rows.size, 2, self.state.dim)
+        m_bytes.inc(n_bytes)
+        m_secs.observe(_time.monotonic() - t0)
+        m_total.inc(kind="delta")
+        return True
+
+    def _build_view_full(self) -> tuple:
+        """Initial load / delta-overflow fallback: one capacity-padded
+        bf16 upload. Call under _sync_lock."""
+        from oryx_tpu.ops.transfer import (
+            device_put_maybe_chunked, row_capacity,
+        )
+        from oryx_tpu.serving.viewsync import view_sync_metrics
+        import time as _time
+
+        t0 = _time.monotonic()
+        mat, ids, version = self.state.items.snapshot()
+        mat = np.asarray(mat, dtype=np.float32)
+        n = len(ids)
+        cap = row_capacity(n, self.sync.capacity_headroom)
+        if cap > n:
+            host = np.zeros((cap, self.state.dim), dtype=np.float32)
+            host[:n] = mat
+        else:
+            host = mat
+        y_dev = device_put_maybe_chunked(host, dtype=jnp.bfloat16)
+        view = (y_dev, ids, version, host)
+        self._device_view = view
+        m_bytes, m_secs, m_total, _ = view_sync_metrics()
+        m_bytes.inc(cap * self.state.dim * 2)
+        m_secs.observe(_time.monotonic() - t0)
+        m_total.inc(kind="full")
+        return view
+
+    # -- queries -----------------------------------------------------------
+
+    def encode(self, context_items: list[str]) -> np.ndarray | None:
+        """Session item history (oldest -> newest) -> hidden state, or
+        None when no context item is known to the model."""
+        if not context_items or self.state.params is None:
+            return None
+        ctx = context_items[-self.state.window:]
+        vecs, have = self.state.items.get_many(ctx)
+        if not have.any():
+            return None
+        # left-pad to the fixed window so the jitted encoder compiles ONE
+        # (1, window, d) program for every context length (an unpadded
+        # call would compile per distinct session length on the hot path)
+        w = self.state.window
+        mat = np.zeros((1, w, self.state.dim), dtype=np.float32)
+        mask = np.zeros((1, w), dtype=np.float32)
+        mat[0, w - len(ctx):] = vecs
+        mask[0, w - len(ctx):] = have.astype(np.float32)
+        return encode_sessions(self.state.params, mat, mask)[0]
+
+    def next_items_async(
+        self,
+        context_items: list[str],
+        how_many: int,
+        exclude: set[str] = frozenset(),
+    ) -> Future:
+        """Top next items for a session context, excluding the session's
+        own history — a Future so the deferred endpoint holds no worker
+        thread while the coalesced device dispatch is in flight."""
+        out: Future = Future()
+        try:
+            h = self.encode(context_items)
+        except BaseException as e:  # noqa: BLE001 - carried to caller
+            out.set_exception(e)
+            return out
+        if h is None:
+            out.set_result(None)  # no known context item: 404 at the route
+            return out
+        y_dev, ids, _version, host_mat = self._view()
+        n = len(ids)
+        if n == 0:
+            out.set_result([])
+            return out
+        k = min(n, how_many + len(exclude) + 8)
+        fut = TopKBatcher.shared().submit_nowait(
+            h, k, y_dev, host_mat=host_mat, valid_rows=n,
+        )
+
+        def _post(result):
+            from oryx_tpu.serving.batcher import host_topk
+
+            vals, idx = np.asarray(result[0]), np.asarray(result[1])
+            keep = idx < n  # capacity-padding rows never reach callers
+            if not keep.all():
+                vals, idx = vals[keep], idx[keep]
+                # pads score 0.0 and displace real NEGATIVE-scoring rows:
+                # when the kept set can no longer fill the request after
+                # exclusions, rescore exactly on the host (the ALS pad
+                # backstop, apps/als/serving.py _post)
+                if len(idx) < min(n, how_many + len(exclude)):
+                    vals, idx = host_topk(
+                        np.asarray(h, dtype=np.float32), k, host_mat[:n], False, None
+                    )
+                    vals, idx = np.asarray(vals), np.asarray(idx)
+            # exact f32 re-rank against the row-aligned host mirror (the
+            # device scan selects in bf16)
+            rows = host_mat[idx]
+            vals = rows @ np.asarray(h, dtype=np.float32)
+            order = np.argsort(-vals, kind="stable")
+            pairs = []
+            for j in order:
+                ident = ids[int(idx[j])]
+                if ident in exclude:
+                    continue
+                pairs.append([ident, float(vals[j])])
+                if len(pairs) == how_many:
+                    break
+            return pairs
+
+        return chain_future(fut, _post, executor=post_pool())
+
+    def next_items(
+        self,
+        context_items: list[str],
+        how_many: int,
+        exclude: set[str] = frozenset(),
+    ):
+        return self.next_items_async(context_items, how_many, exclude).result()
+
+
+class SeqServingModelManager(AbstractServingModelManager):
+    def __init__(self, config: Config):
+        super().__init__(config)
+        from oryx_tpu.apps.als.serving import SyncConfig
+
+        self.seq = SeqConfig.from_config(config)
+        self.sync = SyncConfig.from_config(config)
+        self.model: SeqServingModel | None = None
+        configure_post_pool(
+            config.get_int("oryx.serving.api.post-workers", 8)
+        )
+
+    def get_model(self) -> SeqServingModel | None:
+        return self.model
+
+    def consume_key_message(self, key: str | None, message: str) -> None:
+        prev = self.model.state if self.model is not None else None
+        state = apply_seq_update(prev, key, message)
+        if state is not None and state is not prev:
+            self.model = SeqServingModel(state, sync=self.sync)

@@ -14,6 +14,11 @@ Where spark-submit/YARN flags would go, there is nothing: processes are
 plain Python; multi-chip scale comes from the in-process jax mesh, not a
 cluster scheduler. -D-style overrides are --set key=value (the
 -Dconfig.file / ConfigToProperties path, oryx-run.sh:90-101,138-139).
+
+`--app <name>` wires a packaged app (als | kmeans | rdf | example |
+seq) by registry lookup (oryx_tpu/apps/spi.py): it overlays the app's
+batch/speed/serving classes and serving resources underneath any
+explicit --set, for every layer subcommand plus fleet/pod.
 """
 
 from __future__ import annotations
@@ -37,6 +42,14 @@ def _parse_args(argv):
             "batch", "speed", "serving", "setup", "tail", "input",
             "import-pmml", "loadtest", "config", "pod", "fleet",
         ],
+    )
+    p.add_argument(
+        "--app", default=None, metavar="NAME",
+        help="packaged app to run (registry lookup, oryx_tpu/apps/spi.py):"
+        " als | kmeans | rdf | example | seq. Overlays the app's"
+        " batch/speed/serving classes and serving resources underneath any"
+        " explicit --set, so `batch|speed|serving|fleet|pod --app seq` all"
+        " wire the same app without spelling four class paths",
     )
     p.add_argument(
         "--replicas", type=int, default=None,
@@ -480,7 +493,7 @@ _VALUE_OPTS = {
     "--compute", "--local-start", "--local-count", "--coordinator",
     "--conf", "--url", "--paths", "--rate", "--duration", "--workers",
     "--pmml", "--set", "--loops", "--sync-mode", "--sync-headroom",
-    "--replicas", "--front-port", "--policy",
+    "--replicas", "--front-port", "--policy", "--app",
 }
 
 
@@ -1047,6 +1060,18 @@ def main(argv=None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    if args.app is not None:
+        # app-registry lookup (apps/spi.py): PREPEND the app's class/
+        # resource wiring so any explicit --set still wins, and keep the
+        # --app flag itself in argv so replica/fleet/pod children rebuild
+        # the same wiring (_child_flags passes value opts through)
+        from oryx_tpu.apps.spi import app_overlay
+
+        try:
+            overlay = app_overlay(args.app)
+        except ValueError as e:
+            raise SystemExit(str(e))
+        args.set[:0] = [f"{k}={json.dumps(v)}" for k, v in overlay.items()]
     if args.loops is not None:
         # plain config sugar: rides args.set so replica children and pod
         # spawns inherit it like any other override

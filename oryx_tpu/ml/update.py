@@ -35,6 +35,45 @@ from oryx_tpu.ml.hyperparams import choose_combos
 log = logging.getLogger(__name__)
 
 
+def split_by_time(
+    data: Sequence[KeyMessage],
+    test_fraction: float,
+    fallback,
+    ts_token: int = 3,
+) -> tuple[Sequence[KeyMessage], Sequence[KeyMessage]]:
+    """Temporal holdout split shared by the timestamped apps (ALS event
+    lines and seq session lines both carry the timestamp as CSV token
+    ``ts_token``): the newest ``test_fraction`` of records is held out
+    (the reference's ALSUpdate.java:325-342 sort-by-time split).
+    Timestamps are read per line in place — unparseable lines get -1 and
+    stay in train, so indices always align with ``data``. When no line
+    carries a usable timestamp (or all are equal), ``fallback(data)``
+    decides (usually the random split)."""
+    if test_fraction <= 0 or len(data) == 0:
+        return data, []
+    from oryx_tpu.common.text import parse_input_line
+
+    ts = np.full(len(data), -1, dtype=np.int64)
+    for j, km in enumerate(data):
+        try:
+            tok = parse_input_line(km.message)
+            if len(tok) > ts_token and tok[ts_token] != "":
+                ts[j] = int(float(tok[ts_token]))
+        except (ValueError, IndexError, OverflowError):
+            pass
+    valid = ts[ts >= 0]
+    if len(valid) == 0 or np.all(valid == valid[0]):
+        return fallback(data)
+    order = np.argsort(ts, kind="stable")
+    n_test = int(len(data) * test_fraction)
+    if n_test == 0:
+        return data, []
+    test_set = set(order[-n_test:].tolist())
+    train = [d for j, d in enumerate(data) if j not in test_set]
+    test = [d for j, d in enumerate(data) if j in test_set]
+    return train, test
+
+
 class MLUpdate(BatchLayerUpdate):
     def __init__(self, config: Config):
         self.config = config
@@ -467,7 +506,6 @@ class MLUpdate(BatchLayerUpdate):
         filesystem (common/artifact.py ArtifactRelay; the reference leans
         on a shared Hadoop FileSystem instead, AppPMMLUtils.java:261-275)."""
         from oryx_tpu.common.artifact import publish_model_ref
-        from oryx_tpu.common.freshness import publish_stamp
 
         serialized = model.to_string()
         if len(serialized.encode("utf-8")) <= self.max_message_size:
@@ -477,11 +515,21 @@ class MLUpdate(BatchLayerUpdate):
                 producer, serialized, model_path, self.max_message_size,
                 transfer=self.artifact_transfer,
             )
-        # publish-time stamp AFTER the model message (app-visible record
-        # order is unchanged; consumers claim the stamp for the model that
-        # just loaded): feeds oryx_update_to_serve_seconds and
-        # oryx_model_staleness_seconds on every consuming tier, and
-        # carries the batch generation's trace context when tracing is on
+        self.send_publish_stamp(model_path, producer)
+
+    def send_publish_stamp(
+        self, model_path: str, producer: TopicProducer
+    ) -> None:
+        """Publish-time freshness stamp, sent AFTER the model message
+        (app-visible record order is unchanged; consumers claim the stamp
+        for the model that just loaded): feeds
+        oryx_update_to_serve_seconds / oryx_model_staleness_seconds on
+        every consuming tier and carries the generation's trace context.
+        An SPI contract point: apps overriding publish_model (the ALS/seq
+        skeleton pattern) call this at the end of their override so every
+        packaged app's generations stay observable the same way."""
+        from oryx_tpu.common.freshness import publish_stamp
+
         try:
             generation = int(Path(model_path).name)
         except (TypeError, ValueError):

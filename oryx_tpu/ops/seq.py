@@ -1,0 +1,269 @@
+"""Next-item sequence model kernels: a compact GRU over item embeddings.
+
+The fourth packaged app's device math (ROADMAP item 4). One recurrent
+cell, embedding-tied output — logits for "which item comes next" are
+``h @ E.T`` over the SAME item-embedding matrix the inputs gather from —
+so the serving layer scores the whole catalog with exactly the top-k
+matmul shape the ALS path already dispatches through the micro-batcher
+(serving/batcher.py): the hidden state is the "user vector", E is the
+"item matrix", and score modes / shedding / perfstats all come for free.
+
+Training is minibatched softmax cross-entropy with an Adagrad step,
+``lax.scan`` over the window inside one jitted step function, and the
+same prediction-convergence early stop discipline ALS warm starts use
+(ml/update.py lineage): relative change of sampled next-item scores, not
+parameter norms — embeddings keep drifting along directions the
+predictions no longer care about.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# Non-embedding GRU parameter names, in artifact/tensor order. The
+# embedding matrix "E" rides separately: it is also the serving catalog
+# (streamed row-by-row as UP messages, like ALS factor rows).
+GRU_PARAM_NAMES = ("Wx", "Wh", "b")
+
+
+class GruModel(NamedTuple):
+    """A trained next-item model: item embeddings + recurrent weights."""
+
+    e: np.ndarray          # [V, d] item embeddings (also the output table)
+    params: dict           # Wx [d,3d], Wh [d,3d], b [3d]
+    item_ids: list         # [V] row-aligned item id strings
+
+
+def init_gru_params(key, dim: int) -> dict:
+    """Recurrent weights at 1/sqrt(d) scale; gate order is (z, r, n)."""
+    kx, kh = jax.random.split(key)
+    s = 1.0 / math.sqrt(dim)
+    return {
+        "Wx": np.array(jax.random.normal(kx, (dim, 3 * dim)) * s, dtype=np.float32),
+        "Wh": np.array(jax.random.normal(kh, (dim, 3 * dim)) * s, dtype=np.float32),
+        "b": np.zeros(3 * dim, dtype=np.float32),
+    }
+
+
+def _gru_cell(params, x, h):
+    """One GRU step: x [B,d] inputs, h [B,d] state -> new state."""
+    d = h.shape[-1]
+    gx = x @ params["Wx"] + params["b"]
+    gh = h @ params["Wh"]
+    z = jax.nn.sigmoid(gx[:, :d] + gh[:, :d])
+    r = jax.nn.sigmoid(gx[:, d : 2 * d] + gh[:, d : 2 * d])
+    n = jnp.tanh(gx[:, 2 * d :] + r * gh[:, 2 * d :])
+    return (1.0 - z) * n + z * h
+
+
+def _encode_embedded(params, xs, mask):
+    """Scan the cell over time: xs [B,L,d] embedded inputs, mask [B,L]
+    (1 = real event, 0 = left padding); returns final h [B,d]. Masked
+    steps carry the state through unchanged, so short sessions and full
+    windows share one compiled program."""
+
+    def step(h, xm):
+        x, m = xm
+        h2 = _gru_cell(params, x, h)
+        return jnp.where(m[:, None] > 0, h2, h), None
+
+    h0 = jnp.zeros((xs.shape[0], xs.shape[2]), dtype=xs.dtype)
+    h, _ = jax.lax.scan(step, h0, (jnp.swapaxes(xs, 0, 1), mask.T))
+    return h
+
+
+@jax.jit
+def encode_vectors(params, xs, mask):
+    """Jitted session encoder over pre-gathered embedding vectors —
+    the serving path's form: the request carries item ids, the caller
+    gathers their rows from the factor store, no vocab table needed."""
+    return _encode_embedded(params, xs, mask)
+
+
+def _encode_idx(params, e, idx, mask):
+    return _encode_embedded(params, e[idx], mask)
+
+
+def _nll(weights, idx, mask, targets):
+    """Mean next-item negative log-likelihood of a minibatch under the
+    embedding-tied softmax (logits = h @ E.T)."""
+    e, params = weights["E"], weights
+    h = _encode_idx(params, e, idx, mask)
+    logits = h @ e.T
+    return -jnp.mean(
+        jax.nn.log_softmax(logits, axis=-1)[jnp.arange(idx.shape[0]), targets]
+    )
+
+
+@jax.jit
+def _adagrad_step(weights, accum, idx, mask, targets, lr):
+    """One minibatch step; returns (weights, accum, loss). Adagrad keeps
+    the per-parameter scale adaptive with only the accumulator as state
+    — which train_gru seeds at 0 for cold starts and at 1.0 for warm
+    resumes (see the accum_0 comment there: a zero restart takes
+    lr-sized sign steps that re-shock a converged model)."""
+    loss, grads = jax.value_and_grad(_nll)(weights, idx, mask, targets)
+    new_w, new_a = {}, {}
+    for k in weights:
+        g = grads[k]
+        a = accum[k] + g * g
+        new_w[k] = weights[k] - lr * g / jnp.sqrt(a + 1e-8)
+        new_a[k] = a
+    return new_w, new_a, loss
+
+
+@jax.jit
+def _sampled_scores(weights, idx, mask, targets):
+    """Predicted scores of the true next items on a fixed probe sample —
+    the convergence signal (prediction space, not parameter space)."""
+    h = _encode_idx(weights, weights["E"], idx, mask)
+    return jnp.sum(h * weights["E"][targets], axis=-1)
+
+
+def train_gru(
+    contexts: np.ndarray,
+    mask: np.ndarray,
+    targets: np.ndarray,
+    n_items: int,
+    dim: int,
+    item_ids,
+    epochs: int = 30,
+    lr: float = 0.5,
+    batch: int = 1024,
+    seed_key=None,
+    resume_e: np.ndarray | None = None,
+    resume_params: dict | None = None,
+    tol: float = 0.0,
+    min_epochs: int = 2,
+    check_every: int = 2,
+    probe: int = 512,
+) -> tuple[GruModel, int]:
+    """Train the next-item GRU; returns (model, epochs actually run).
+
+    contexts [N,L] int32 item rows (left-padded), mask [N,L], targets [N]
+    item rows. resume_e/resume_params warm-start from the previous
+    generation (ids already aligned by the caller via ops/als.py
+    align_factors); tol > 0 enables the prediction-convergence early stop
+    checked every ``check_every`` epochs after ``min_epochs``.
+    """
+    n = int(contexts.shape[0])
+    if n == 0 or n_items == 0:
+        raise ValueError("no training examples")
+    key = seed_key
+    if key is None:
+        from oryx_tpu.common.rng import RandomManager
+
+        key = RandomManager.get_key()
+    k_e, k_p, k_s = jax.random.split(key, 3)
+    if resume_e is not None and resume_e.shape == (n_items, dim):
+        e0 = np.asarray(resume_e, dtype=np.float32)
+    else:
+        e0 = np.array(
+            jax.random.normal(k_e, (n_items, dim)) * (1.0 / math.sqrt(dim)),
+            dtype=np.float32,
+        )
+    params = (
+        {k: np.asarray(v, dtype=np.float32) for k, v in resume_params.items()}
+        if resume_params is not None
+        and all(k in resume_params for k in GRU_PARAM_NAMES)
+        and np.shape(resume_params.get("Wh")) == (dim, 3 * dim)
+        else init_gru_params(k_p, dim)
+    )
+    weights = {"E": jnp.asarray(e0), **{k: jnp.asarray(params[k]) for k in GRU_PARAM_NAMES}}
+    # Warm resumes seed the Adagrad accumulator at 1.0 instead of 0: a
+    # zero accumulator makes every first step lr-sized REGARDLESS of the
+    # gradient (sign steps), which re-shocks a converged model for
+    # several epochs before the prediction-convergence stop can fire;
+    # with the floor, steps near convergence are ~lr·g — small where the
+    # model is already right, full-sized where the new window disagrees.
+    accum_0 = 1.0 if resume_e is not None and resume_params is not None else 0.0
+    accum = {k: jnp.full_like(v, accum_0) for k, v in weights.items()}
+
+    batch = max(1, min(batch, n))
+    # fixed probe sample for the convergence signal (deterministic)
+    rng = np.random.default_rng(int(jax.random.randint(k_s, (), 0, 1 << 30)))
+    probe_rows = rng.choice(n, size=min(probe, n), replace=False)
+    p_idx = jnp.asarray(contexts[probe_rows])
+    p_mask = jnp.asarray(mask[probe_rows])
+    p_tgt = jnp.asarray(targets[probe_rows])
+
+    lr_j = jnp.float32(lr)
+    prev_scores = None
+    ran = 0
+    for epoch in range(max(1, int(epochs))):
+        order = rng.permutation(n)
+        for lo in range(0, n, batch):
+            rows = order[lo : lo + batch]
+            if len(rows) < batch:  # pad to the compiled batch shape
+                rows = np.concatenate([rows, order[: batch - len(rows)]])
+            weights, accum, _ = _adagrad_step(
+                weights, accum,
+                jnp.asarray(contexts[rows]), jnp.asarray(mask[rows]),
+                jnp.asarray(targets[rows]), lr_j,
+            )
+        ran = epoch + 1
+        if tol > 0 and ran >= min_epochs and ran % max(1, check_every) == 0:
+            scores = np.asarray(_sampled_scores(weights, p_idx, p_mask, p_tgt))
+            if prev_scores is not None:
+                denom = float(np.linalg.norm(prev_scores)) or 1.0
+                rel = float(np.linalg.norm(scores - prev_scores)) / denom
+                if rel < tol:
+                    break
+            prev_scores = scores
+    model = GruModel(
+        e=np.asarray(weights["E"], dtype=np.float32),
+        params={k: np.asarray(weights[k], dtype=np.float32) for k in GRU_PARAM_NAMES},
+        item_ids=list(item_ids),
+    )
+    return model, ran
+
+
+def next_item_hit_rate(
+    e: np.ndarray,
+    params: dict,
+    contexts: np.ndarray,
+    mask: np.ndarray,
+    targets: np.ndarray,
+    k: int = 10,
+    chunk: int = 2048,
+) -> float:
+    """Mean hit-rate@k over next-item examples: the fraction whose true
+    next item lands in the model's top-k — the ONE definition the batch
+    eval, the quality gate, and the bench's seq stage all share. NaN when
+    there is nothing to evaluate."""
+    n = int(contexts.shape[0])
+    if n == 0:
+        return float("nan")
+    e_j = jnp.asarray(np.asarray(e, dtype=np.float32))
+    jp = {name: jnp.asarray(np.asarray(params[name], dtype=np.float32))
+          for name in GRU_PARAM_NAMES}
+    k = min(k, int(e.shape[0]))
+    hits = 0
+    for lo in range(0, n, chunk):
+        h = encode_vectors(
+            jp, e_j[jnp.asarray(contexts[lo : lo + chunk])],
+            jnp.asarray(mask[lo : lo + chunk]),
+        )
+        logits = np.asarray(h @ e_j.T)
+        top = np.argpartition(-logits, k - 1, axis=1)[:, :k]
+        hits += int((top == targets[lo : lo + chunk, None]).any(axis=1).sum())
+    return hits / n
+
+
+def encode_sessions(params: dict, item_vectors: np.ndarray, mask: np.ndarray) -> np.ndarray:
+    """Host-friendly wrapper: encode pre-gathered [B,L,d] item vectors
+    (zeros on padded steps) into [B,d] hidden states."""
+    jp = {k: jnp.asarray(np.asarray(params[k], dtype=np.float32)) for k in GRU_PARAM_NAMES}
+    return np.asarray(
+        encode_vectors(
+            jp,
+            jnp.asarray(np.asarray(item_vectors, dtype=np.float32)),
+            jnp.asarray(np.asarray(mask, dtype=np.float32)),
+        )
+    )

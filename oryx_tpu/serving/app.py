@@ -98,6 +98,38 @@ def deferred_map(future: "Future", fn: Callable[[Any], Any]) -> Deferred:
     return Deferred(chain_future(future, fn))
 
 
+_POST_POOL = None
+_POST_POOL_LOCK = threading.Lock()
+_POST_POOL_WORKERS = 8  # overridden from config by the serving managers
+
+
+def configure_post_pool(workers: int) -> None:
+    """Size the post-processing pool (oryx.serving.api.post-workers) —
+    takes effect at first use; an already-created pool keeps its size."""
+    global _POST_POOL_WORKERS
+    _POST_POOL_WORKERS = max(1, int(workers))
+
+
+def post_pool():
+    """Shared pool for per-request post-processing chained off batcher
+    futures (sized for trim/render work; a rescorer that blocks holds one
+    of these threads, never the batcher dispatcher — and blocking top_n()
+    callers post-process on their own thread, so nested rescorer queries
+    cannot exhaust this pool into a deadlock). Shared across apps: the
+    ALS recommend family and the seq /recommend-next chain through it."""
+    global _POST_POOL
+    if _POST_POOL is None:
+        with _POST_POOL_LOCK:
+            if _POST_POOL is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                _POST_POOL = ThreadPoolExecutor(
+                    max_workers=_POST_POOL_WORKERS,
+                    thread_name_prefix="oryx-topn-post",
+                )
+    return _POST_POOL
+
+
 class OryxServingException(Exception):
     """HTTP-status-carrying error (reference OryxServingException).
     ``headers`` ride the response (e.g. Retry-After on a load shed)."""

@@ -1,0 +1,77 @@
+"""Shared device-view sync helpers (the app-SPI split, PR 10).
+
+Any app serving a FactorStore-backed device matrix keeps it in step
+with the live store by dirty-row delta (PR 3's `delta_since` +
+`ops/transfer.scatter_rows`). The pieces that are identical across apps
+live here — the dirty-delta id-list extension and the process-wide sync
+metric families — so the ALS and seq serving models report into ONE
+`oryx_device_sync_*` vocabulary and a fix to either helper reaches both.
+(The view-tuple state machines themselves stay per-app: ALS carries
+unit/LSH/quantized views the seq model has no use for.)
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from oryx_tpu.common.metrics import MICROBATCH_BUCKETS, get_registry
+
+log = logging.getLogger(__name__)
+
+_SYNC_METRICS = None
+_SYNC_METRICS_LOCK = threading.Lock()
+
+
+def view_sync_metrics():
+    """(bytes counter, seconds histogram, resync counter, lsh histogram) —
+    process-wide, lazily registered so importing this module never touches
+    the registry."""
+    global _SYNC_METRICS
+    if _SYNC_METRICS is None:
+        with _SYNC_METRICS_LOCK:
+            if _SYNC_METRICS is None:
+                reg = get_registry()
+                _SYNC_METRICS = (
+                    reg.counter(
+                        "oryx_device_sync_bytes",
+                        "host->device bytes moved keeping serving views in "
+                        "sync (delta scatters move dirty rows; full "
+                        "resyncs move the whole matrix)",
+                    ),
+                    reg.histogram(
+                        "oryx_device_sync_seconds",
+                        "wall-clock per serving view resync (delta or full)",
+                        buckets=MICROBATCH_BUCKETS,
+                    ),
+                    reg.counter(
+                        "oryx_view_resync_total",
+                        "serving view resyncs by kind (delta = dirty-row "
+                        "scatter; full = snapshot rebuild, including the "
+                        "initial load)",
+                        labeled=True,
+                    ),
+                    reg.histogram(
+                        "oryx_lsh_rebuild_seconds",
+                        "wall-clock per full LSH partition-index rebuild "
+                        "(delta reassignments ride oryx_device_sync_seconds)",
+                        buckets=MICROBATCH_BUCKETS,
+                    ),
+                )
+    return _SYNC_METRICS
+
+
+def extend_view_ids(ids: list, delta) -> list | None:
+    """Extend a view's id list with the delta's appended rows, in row
+    order. Every index in [len(ids), delta.n) was dirty-logged by the
+    write that created it, so the delta must carry its id; None (with a
+    warning — the caller falls back to a full resync) if that invariant
+    ever breaks."""
+    if delta.n <= len(ids):
+        return ids
+    by_row = dict(zip((int(r) for r in delta.rows), delta.ids))
+    try:
+        return ids + [by_row[r] for r in range(len(ids), delta.n)]
+    except KeyError:  # pragma: no cover - log invariant broken
+        log.warning("delta missing ids for appended rows; full resync")
+        return None

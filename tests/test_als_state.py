@@ -162,7 +162,7 @@ def test_nested_rescorer_query_does_not_deadlock_post_pool():
     post-processes on the caller's thread) or a 1-thread pool deadlocks."""
     from concurrent.futures import ThreadPoolExecutor
 
-    import oryx_tpu.apps.als.serving as srv
+    import oryx_tpu.serving.app as srv  # owns the shared post pool
     from oryx_tpu.apps.als.serving import ALSServingModel
     from oryx_tpu.apps.als.state import ALSState
 

@@ -1,0 +1,126 @@
+"""SPI-conformance suite: every registered packaged app walks the SAME
+contract checks (docs/apps.md), so a new app cannot silently skip a
+hook or drift from the wiring the framework layers expect. Apps enter
+via the registry (oryx_tpu/apps/spi.py) — adding a fifth app means
+adding an AppSpec, and this suite picks it up automatically.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+import pytest
+
+from oryx_tpu.api import (
+    BatchLayerUpdate,
+    ServingModelManager,
+    SpeedModelManager,
+)
+from oryx_tpu.apps.spi import all_apps, app_overlay, get_app
+from oryx_tpu.bus.api import KeyMessage
+from oryx_tpu.common.classutil import load_instance_of
+from oryx_tpu.common.config import load_config
+
+APPS = sorted(all_apps())
+
+
+def _cfg(spec):
+    return load_config(overlay={**app_overlay(spec.name), **spec.example_overlay})
+
+
+def test_registry_names_and_lookup():
+    assert {"als", "kmeans", "rdf", "example", "seq"} <= set(APPS)
+    with pytest.raises(ValueError):
+        get_app("nosuchapp")
+    for name in APPS:
+        assert get_app(name).name == name
+
+
+@pytest.mark.parametrize("name", APPS)
+def test_overlay_wires_the_framework_keys(name):
+    overlay = app_overlay(name)
+    assert set(overlay) == {
+        "oryx.batch.update-class",
+        "oryx.speed.model-manager-class",
+        "oryx.serving.model-manager-class",
+        "oryx.serving.application-resources",
+    }
+    resources = overlay["oryx.serving.application-resources"]
+    # every app serves the shared resource module plus at least its own
+    assert "oryx_tpu.serving.resources.common" in resources
+
+
+@pytest.mark.parametrize("name", APPS)
+def test_classes_resolve_and_subclass_the_spi(name):
+    spec = get_app(name)
+    cfg = _cfg(spec)
+    batch = load_instance_of(spec.batch_update, BatchLayerUpdate, cfg)
+    speed = load_instance_of(spec.speed_manager, SpeedModelManager, cfg)
+    serving = load_instance_of(spec.serving_manager, ServingModelManager, cfg)
+    assert isinstance(batch, BatchLayerUpdate)
+    assert isinstance(speed, SpeedModelManager)
+    assert isinstance(serving, ServingModelManager)
+
+
+@pytest.mark.parametrize("name", APPS)
+def test_resource_modules_register(name):
+    for mod_name in get_app(name).serving_resources:
+        mod = importlib.import_module(mod_name)
+        assert callable(getattr(mod, "register", None)), (
+            f"{mod_name} lacks the register(app) entry point"
+        )
+
+
+@pytest.mark.parametrize("name", APPS)
+def test_validate_records_contract(name):
+    """validate_records must return one verdict per record, agree with
+    validate_record element-wise, and accept everything when the app
+    does not override the hooks (the layers skip the sweep then)."""
+    spec = get_app(name)
+    cfg = _cfg(spec)
+    records = [
+        KeyMessage(None, "u1,s1,i1,1000"),
+        KeyMessage(None, "definitely,not,every,apps,format"),
+        KeyMessage(None, ""),
+    ]
+    for cls_name, base in (
+        (spec.batch_update, BatchLayerUpdate),
+        (spec.speed_manager, SpeedModelManager),
+    ):
+        inst = load_instance_of(cls_name, base, cfg)
+        verdicts = list(inst.validate_records(records))
+        assert len(verdicts) == len(records)
+        assert verdicts == [inst.validate_record(km) for km in records]
+
+
+@pytest.mark.parametrize("name", APPS)
+def test_speed_manager_contract(name):
+    """build_updates on an empty micro-batch is a cheap no-op (the speed
+    layer polls empty constantly), and close() is callable."""
+    spec = get_app(name)
+    inst = load_instance_of(spec.speed_manager, SpeedModelManager, _cfg(spec))
+    assert list(inst.build_updates([])) == []
+    inst.close()
+
+
+@pytest.mark.parametrize("name", APPS)
+def test_serving_manager_contract(name):
+    """get_model() answers (None before any update is fine), and the
+    read-only flag follows config."""
+    spec = get_app(name)
+    cfg = _cfg(spec).overlay({"oryx.serving.api.read-only": True})
+    inst = load_instance_of(spec.serving_manager, ServingModelManager, cfg)
+    model = inst.get_model()
+    assert model is None or callable(model.fraction_loaded)
+    assert inst.is_read_only() is True
+    inst.close()
+
+
+@pytest.mark.parametrize("name", APPS)
+def test_finalize_generation_is_safe_without_a_build(name):
+    """The batch layer calls finalize_generation after EVERY window
+    persist, including generations whose build failed or built nothing —
+    the hook must tolerate that (PR 4 staging contract)."""
+    spec = get_app(name)
+    inst = load_instance_of(spec.batch_update, BatchLayerUpdate, _cfg(spec))
+    inst.finalize_generation(123456789)

@@ -366,8 +366,92 @@ def test_valid_event_lines_matches_per_line_validator():
         "u4,i4,notafloat",      # invalid strength
         "",                     # invalid
         "u5,i5,1.5,99",         # with timestamp
+        "u6,i6,1.5,1e400",      # float-overflow ts: False, never a raise
     ]
     assert valid_event_lines(lines) == [valid_event_line(l) for l in lines]
+    assert valid_event_line("u6,i6,1.5,1e400") is False
+
+
+def _seq_model_message(n_items: int = 6, dim: int = 8) -> str:
+    """A small loadable seq MODEL message — the ONE builder the chaos
+    CLI scenario also uses, so the test and the scenario cannot drift on
+    what 'a loadable seq model' means."""
+    from tools.chaos import _seq_model_message as build
+
+    return build(n_items=n_items, dim=dim)
+
+
+def test_seq_poison_quarantined_via_spi_hooks(tmp_path):
+    """PR 5's containment is app-generic, proven on the fourth app with
+    the REAL SeqSpeedModelManager: malformed session events are swept by
+    the SPI validate_records hook into the dead-letter store on the
+    commit path, a line that passes the cheap deserialize sweep but
+    deterministically breaks the build (int64 timestamp overflow at
+    array construction) is isolated by BISECTION, both are replayable,
+    the survivors' fold-in updates publish, and the stream converges."""
+    from oryx_tpu.apps.seq.speed import SeqSpeedModelManager
+
+    cfg = _cfg(tmp_path, "chaos-seq",
+               **{"oryx.monitoring.quarantine.max-attempts": 1})
+    mgr = SeqSpeedModelManager(cfg)
+    mgr.consume_key_message("MODEL", _seq_model_message())
+    assert mgr.state.fraction_loaded() == 1.0
+    layer = SpeedLayer(cfg, manager=mgr)
+    layer.ensure_streams()
+    broker = get_broker("mem://chaos-seq")
+    in_topic = cfg.get_string("oryx.input-topic.message.topic")
+
+    malformed = ["u1,s0,i0", "u1,s0,,2000", "u1,s0,i1,not-a-ts"]
+    poison = "u1,s9,i0,1e300"  # cheap sweep passes; int64 overflow in build
+    good = ["u1,s2,i0,1000", "u1,s2,i1,1001"]
+    for m in malformed + [poison] + good:
+        broker.send(in_topic, m, m)
+
+    layer.run_batch()  # attempt 1: build raises, window rewinds
+    assert layer._m_failures.value() >= 1
+    assert quarantine_files(str(tmp_path / "quarantine")) == []
+    layer.run_batch()  # attempt 2: bisect + divert both classes + commit
+    files = quarantine_files(str(tmp_path / "quarantine"), "speed")
+    by_reason = {}
+    for f in files:
+        for km in load_quarantined(f):
+            by_reason.setdefault(
+                "validate" if km.message in malformed else "bisect", []
+            ).append(km.message)
+    assert sorted(by_reason.get("validate", [])) == sorted(malformed)
+    assert by_reason.get("bisect") == [poison]
+    # the survivors' transition folded: exactly one delta-sized UP row
+    ups = _update_messages("chaos-seq", cfg)
+    assert len(ups) == 1 and ups[0].startswith('["E",')
+    # converged: a later window processes normally
+    broker.send(in_topic, None, "u1,s2,i2,1002")
+    assert layer.run_batch() == 1
+    assert len(_update_messages("chaos-seq", cfg)) == 2
+    layer.close()
+
+
+def test_seq_valid_session_lines_matches_parse():
+    """The seq validate hook must stay in lockstep with what
+    parse_session_events would ingest, line-class by line-class."""
+    from oryx_tpu.apps.seq.common import (
+        parse_session_events, valid_session_line, valid_session_lines,
+    )
+
+    lines = [
+        "u1,s1,i1,1000",        # canonical
+        '["u2","s2","i2",5]',   # JSON-array form
+        "u3,s3,i3",             # missing ts: invalid
+        "u4,s4,,1000",          # empty item: invalid
+        "u5,s5,i5,notats",      # bad ts: invalid
+        # float-overflow ts: must return False, never RAISE — a raising
+        # validate hook would bypass the layers' quarantine sweep
+        "u6,s6,i6,1e400",
+        "",                     # invalid
+    ]
+    assert valid_session_lines(lines) == [valid_session_line(l) for l in lines]
+    kept = [l for l in lines if valid_session_line(l)]
+    users, sess, items, tss = parse_session_events(lines)
+    assert len(tss) == len(kept)
 
 
 # ---- fault class 4: device-transfer error ---------------------------------

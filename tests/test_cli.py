@@ -416,3 +416,57 @@ def test_apply_platform_env_prefers_env_over_config(monkeypatch):
         assert jax.config.jax_platforms == "cpu"
     finally:
         jax.config.update("jax_platforms", before)
+
+
+def test_app_flag_wires_registry_classes(capsys):
+    """`--app seq` overlays the app registry's class/resource wiring
+    (apps/spi.py) under the effective config — visible through the
+    `config` subcommand like any other override."""
+    rc = cli.main(["config", "--app", "seq"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "oryx.batch.update-class=oryx_tpu.apps.seq.batch.SeqUpdate" in out
+    assert (
+        "oryx.speed.model-manager-class="
+        "oryx_tpu.apps.seq.speed.SeqSpeedModelManager" in out
+    )
+    assert (
+        "oryx.serving.model-manager-class="
+        "oryx_tpu.apps.seq.serving.SeqServingModelManager" in out
+    )
+    assert "oryx_tpu.serving.resources.seq" in out
+
+
+def test_app_flag_explicit_set_still_wins(capsys):
+    """An explicit --set outranks the app overlay (sugar must never
+    shadow an operator's deliberate override)."""
+    rc = cli.main([
+        "config", "--app", "als",
+        "--set", "oryx.batch.update-class=custom.Update",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "oryx.batch.update-class=custom.Update" in out
+    # the rest of the app wiring still applies
+    assert (
+        "oryx.serving.model-manager-class="
+        "oryx_tpu.apps.als.serving.ALSServingModelManager" in out
+    )
+
+
+def test_app_flag_unknown_app_fails_fast():
+    with pytest.raises(SystemExit):
+        cli.main(["config", "--app", "nosuchapp"])
+
+
+def test_app_flag_survives_child_argv_rebuild():
+    """fleet/pod child rebuilds keep --app (it is a value opt, so the
+    subcommand detection must not eat its value either)."""
+    raw = ["fleet", "--app", "seq", "--replicas", "2", "--conf", "x.conf"]
+    child = cli._fleet_child_flags(raw)
+    assert "--app" in child and child[child.index("--app") + 1] == "seq"
+    assert "--replicas" not in child
+    raw2 = ["--app", "seq", "pod", "--compute", "2"]
+    child2 = cli._pod_child_flags(raw2)
+    assert "--app" in child2 and child2[child2.index("--app") + 1] == "seq"
+    assert "pod" not in child2

@@ -1,0 +1,232 @@
+"""End-to-end seq lambda slice (the fourth packaged app's acceptance
+run, mirroring test_e2e_als.py): ingest session events -> batch GRU
+build -> update topic (MODEL skeleton + E row flood + freshness stamp)
+-> serving answers /recommend-next -> a second batch generation rides
+the incremental path and the served generation advances monotonically ->
+the speed layer folds a brand-new session (with a never-seen item) as a
+delta-sized UP update -> serving applies it through the FactorStore
+dirty-row sync and recommends the new item.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from oryx_tpu.apps.seq.batch import SeqUpdate
+from oryx_tpu.apps.seq.serving import SeqServingModelManager
+from oryx_tpu.apps.seq.speed import SeqSpeedModelManager
+from oryx_tpu.bus.broker import get_broker, topics
+from oryx_tpu.bus.inproc import InProcBroker
+from oryx_tpu.common.config import load_config
+from oryx_tpu.common.ioutil import choose_free_port
+from oryx_tpu.common.metrics import get_registry
+from oryx_tpu.common.rng import RandomManager
+from oryx_tpu.layers import BatchLayer, SpeedLayer
+from oryx_tpu.serving.server import ServingLayer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    InProcBroker.reset_all()
+    yield
+    InProcBroker.reset_all()
+
+
+from e2e_common import http_request as _http  # noqa: E402
+
+
+def _make_config(tmp_path, port):
+    return load_config(overlay={
+        "oryx.id": "e2e-seq",
+        "oryx.input-topic.broker": "mem://e2e-seq",
+        "oryx.update-topic.broker": "mem://e2e-seq",
+        "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+        "oryx.serving.api.port": port,
+        "oryx.serving.application-resources": [
+            "oryx_tpu.serving.resources.common",
+            "oryx_tpu.serving.resources.seq",
+        ],
+        "oryx.seq.hyperparams.dim": 16,
+        "oryx.seq.hyperparams.epochs": 12,
+        "oryx.speed.streaming.generation-interval-sec": 1,
+        "oryx.ml.eval.test-fraction": 0.1,
+        # top-5 content assertions below: the gate must not open while
+        # the UP embedding flood is still replaying (same reasoning as
+        # the ALS e2e)
+        "oryx.serving.min-model-load-fraction": 1.0,
+    })
+
+
+def _chain_sessions(n_sessions=80, chains=4, chain_len=5, events_per=6, seed=0):
+    """Sessions that walk one of `chains` planted item chains: chain g's
+    items are i{g*len}..i{g*len+len-1} and each session steps the cycle,
+    so 'what follows i(k)' has one strong answer."""
+    rng = np.random.default_rng(seed)
+    lines = []
+    for s in range(n_sessions):
+        g = s % chains
+        base = g * chain_len
+        it = base + int(rng.integers(0, chain_len))
+        for t in range(events_per):
+            lines.append(f"u{s % 10},s{s},i{it},{1000 + s * 100 + t}")
+            it = base + (it - base + 1) % chain_len
+    return lines
+
+
+def test_full_seq_lambda_slice(tmp_path):
+    RandomManager.use_test_seed(99)
+    port = choose_free_port()
+    cfg = _make_config(tmp_path, port)
+    topics.maybe_create("mem://e2e-seq", "OryxInput", partitions=2)
+    topics.maybe_create("mem://e2e-seq", "OryxUpdate", partitions=1)
+    broker = get_broker("mem://e2e-seq")
+
+    # ---- serving first: /ready must 503 before any model ----
+    serving = ServingLayer(cfg, model_manager=SeqServingModelManager(cfg))
+    serving.start()
+    base = f"http://127.0.0.1:{serving.port}"
+    status, _ = _http("GET", f"{base}/ready")
+    assert status == 503
+
+    # ---- ingest through the serving layer ----
+    lines = _chain_sessions()
+    status, resp = _http("POST", f"{base}/ingest", body="\n".join(lines).encode())
+    assert status == 200, resp
+    assert json.loads(resp)["ingested"] == len(lines)
+
+    # ---- batch generation 1 trains + publishes ----
+    gen1 = 1_700_000_000_000
+    batch = BatchLayer(cfg, update=SeqUpdate(cfg))
+    batch.ensure_streams()
+    # input was sent before the batch consumer existed: replay from 0
+    batch._consumer._fetch_pos = {p: 0 for p in batch._consumer._fetch_pos}
+    assert batch.run_generation(timestamp_ms=gen1) == len(lines)
+
+    # update topic: MODEL skeleton first, then the E row flood + stamp
+    recs = broker.read("OryxUpdate", 0, 0, 10)
+    assert recs[0][1] == "MODEL"
+    model_doc = json.loads(recs[0][2])
+    assert model_doc["app"] == "seq"
+
+    # ---- serving becomes ready by replaying the update topic ----
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        status, _ = _http("GET", f"{base}/ready")
+        if status == 200:
+            break
+        time.sleep(0.1)
+    assert status == 200, "serving never became ready"
+
+    # per-app console section
+    status, resp = _http("GET", f"{base}/console")
+    assert status == 200 and "Seq next-item model" in resp
+
+    # ---- /recommend-next over HTTP ----
+    status, resp = _http("GET", f"{base}/recommend-next/i0/i1?howMany=5")
+    assert status == 200, resp
+    recs5 = json.loads(resp)
+    assert len(recs5) == 5
+    # planted chain: i2 follows i1 in chain 0
+    assert recs5[0][0] == "i2", recs5
+    # the session's own history is excluded
+    assert not ({"i0", "i1"} & {r[0] for r in recs5})
+
+    # CSV negotiation + errors
+    status, resp = _http(
+        "GET", f"{base}/recommend-next/i0?howMany=2", accept="text/csv"
+    )
+    assert status == 200 and len(resp.strip().splitlines()) == 2 and "," in resp
+    status, _ = _http("GET", f"{base}/recommend-next/unknownitem")
+    assert status == 404
+    status, _ = _http("GET", f"{base}/recommend-next/i0?howMany=0")
+    assert status == 400
+
+    # ---- generation 2: incremental path, served generation monotone ----
+    deadline = time.time() + 30
+    while time.time() < deadline:  # gen1's stamp must reach serving first
+        status, resp = _http("GET", f"{base}/healthz")
+        if json.loads(resp).get("model_generation") == gen1:
+            break
+        time.sleep(0.1)
+    assert json.loads(resp)["model_generation"] == gen1
+
+    more = [f"u1,s100,i{j},{2_000_000 + j}" for j in (0, 1, 2, 3)]
+    status, _ = _http("POST", f"{base}/event", body="\n".join(more).encode())
+    assert status == 200
+    delta_counter = get_registry().counter("oryx_batch_incremental_total")
+    deltas_before = delta_counter.value(kind="delta")
+    gen2 = gen1 + 60_000
+    assert batch.run_generation(timestamp_ms=gen2) == len(more)
+    assert delta_counter.value(kind="delta") == deltas_before + 1, (
+        "generation 2 did not ride the incremental aggregate-snapshot path"
+    )
+    batch.close()
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        status, resp = _http("GET", f"{base}/healthz")
+        if json.loads(resp).get("model_generation") == gen2:
+            break
+        time.sleep(0.1)
+    assert json.loads(resp)["model_generation"] == gen2, (
+        "served model generation never advanced to generation 2"
+    )
+
+    # ---- speed layer folds a brand-new session with a NEW item ----
+    speed = SpeedLayer(cfg, manager=SeqSpeedModelManager(cfg))
+    speed.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = speed.manager.state
+        if st is not None and st.fraction_loaded() >= 0.8:
+            break
+        time.sleep(0.1)
+    assert speed.manager.state is not None
+
+    # the delta contract: note the topic edge, fold, and require that
+    # everything new on the topic is small UP rows — never a full model
+    up_end_before = broker.end_offsets("OryxUpdate")[0]
+    fold = ["u9,snew,i2,5000000", "u9,snew,iNEWCLICK,5000001"]
+    status, _ = _http("POST", f"{base}/event", body="\n".join(fold).encode())
+    assert status == 200
+
+    deadline = time.time() + 30
+    got = None
+    while time.time() < deadline:
+        status, resp = _http("GET", f"{base}/recommend-next/i2?howMany=8")
+        if status == 200:
+            pairs = json.loads(resp)
+            if any(i == "iNEWCLICK" for i, _ in pairs):
+                got = pairs
+                break
+        time.sleep(0.2)
+    assert got is not None, "speed fold-in never reached serving"
+
+    new_recs = broker.read("OryxUpdate", 0, up_end_before, 1000)
+    assert new_recs, "no update-topic records from the speed fold"
+    assert all(k == "UP" for _, k, _ in new_recs)
+    assert all(len(m) < 2048 for _, _, m in new_recs), (
+        "speed fold published something model-sized, not a row delta"
+    )
+    folded = get_registry().counter("oryx_seq_sessions_folded_total")
+    assert folded.value() >= 1
+
+    speed.close()
+    serving.close()
+
+
+def test_seq_serving_read_only_mode(tmp_path):
+    RandomManager.use_test_seed(7)
+    port = choose_free_port()
+    cfg = _make_config(tmp_path, port).overlay({"oryx.serving.api.read-only": True})
+    topics.maybe_create("mem://e2e-seq", "OryxInput", partitions=1)
+    topics.maybe_create("mem://e2e-seq", "OryxUpdate", partitions=1)
+    serving = ServingLayer(cfg, model_manager=SeqServingModelManager(cfg))
+    serving.start()
+    base = f"http://127.0.0.1:{serving.port}"
+    status, _ = _http("POST", f"{base}/event", body=b"u1,s1,i1,1000")
+    assert status == 405
+    serving.close()

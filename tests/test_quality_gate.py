@@ -152,6 +152,48 @@ def test_kmeans_quality_harness_smoke():
     assert rep.silhouette >= 0.5
 
 
+# ---- seq next-item gate (PR 10: the fourth packaged app) ----------------
+# Planted-successor sessions (ml/quality.py synthesize_sessions): the
+# walk follows a hidden permutation with p=0.85, so ~0.85 is the
+# achievable ceiling and chance is k/V. Calibrated 2026-08-03 on this
+# host: 0.819 at the full gate shape (2000 items, 3000 sessions, 12
+# epochs, 27 s CPU), 0.885 at toy shape — a broken windowing, a
+# mis-gathered embedding table, or a GRU cell regression lands near
+# chance (0.005), far below the floor.
+
+SEQ_HIT_RATE_FLOOR = 0.65
+
+
+@nightly
+def test_seq_next_item_hit_rate_floor():
+    from oryx_tpu.common.rng import RandomManager
+    from oryx_tpu.ml.quality import build_and_evaluate_seq
+
+    RandomManager.use_test_seed(1)
+    rep = build_and_evaluate_seq()
+    assert rep.hit_rate >= SEQ_HIT_RATE_FLOOR, (
+        f"hit-rate@{rep.k} {rep.hit_rate:.4f} < floor {SEQ_HIT_RATE_FLOOR} "
+        f"(ceiling ~0.85 at follow_p=0.85, chance {rep.chance:.4f})"
+    )
+
+
+def test_seq_quality_harness_smoke():
+    """Always-on toy-scale smoke of the seq gate harness (the same code
+    path bench's seq stage and the nightly gate run)."""
+    from oryx_tpu.common.rng import RandomManager
+    from oryx_tpu.ml.quality import build_and_evaluate_seq
+
+    RandomManager.use_test_seed(1)
+    rep = build_and_evaluate_seq(
+        n_items=200, n_sessions=300, session_len=8, dim=16, epochs=6
+    )
+    assert rep.hit_rate > 0.5, (
+        f"toy hit-rate@{rep.k} {rep.hit_rate:.4f} near chance "
+        f"({rep.chance:.3f}) — windowing or trainer regressed"
+    )
+    assert rep.examples > 0 and rep.build_s > 0
+
+
 # ---- serving score-mode recall gate (PR 8) ------------------------------
 # The quantized (int8 + exact rescore) and approx (partial-reduce) score
 # modes must hold recall@10 >= 0.95 against the exact top-k on the

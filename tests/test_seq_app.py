@@ -1,0 +1,259 @@
+"""Unit tests for the seq app's pieces: the windowed-sequence ingest,
+the mergeable per-session aggregate, update-message application, the
+GRU trainer's warm start / early stop, the speed fold-in, and the
+serving device view's dirty-row delta sync.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from oryx_tpu.bus.api import KeyMessage
+from oryx_tpu.common.config import load_config
+from oryx_tpu.common.rng import RandomManager
+
+
+def _cfg(**extra):
+    return load_config(overlay={**extra})
+
+
+# ---- windowed ingest -------------------------------------------------------
+
+def test_sessionize_orders_dedups_and_caps():
+    from oryx_tpu.apps.seq.common import session_key, sessionize
+
+    users = np.asarray(["u1"] * 5, dtype=object)
+    sess = np.asarray(["s1"] * 5, dtype=object)
+    items = np.asarray(["c", "a", "b", "a", "d"], dtype=object)
+    tss = np.asarray([30, 10, 20, 10, 40], dtype=np.int64)  # dup (10, a)
+    out = sessionize(users, sess, items, tss)
+    assert list(out) == [session_key("u1", "s1")]
+    assert out[session_key("u1", "s1")] == [(10, "a"), (20, "b"), (30, "c"), (40, "d")]
+    capped = sessionize(users, sess, items, tss, max_events=2)
+    assert capped[session_key("u1", "s1")] == [(30, "c"), (40, "d")]
+
+
+def test_windowed_examples_shapes_and_padding():
+    from oryx_tpu.apps.seq.common import windowed_examples
+
+    vocab = {f"i{j}": j for j in range(5)}
+    sessions = {"k": ["i0", "i1", "i2", "i3"]}
+    contexts, mask, targets = windowed_examples(sessions, vocab, window=2)
+    # examples: [i0]->i1, [i0,i1]->i2, [i1,i2]->i3 (window 2)
+    assert contexts.shape == (3, 2) and mask.shape == (3, 2)
+    assert list(targets) == [1, 2, 3]
+    # left padding: the single-item context is padded on the LEFT
+    assert mask[0].tolist() == [0.0, 1.0] and contexts[0, 1] == 0
+    assert contexts[2].tolist() == [1, 2]
+    # short sessions train nothing; unknown items drop their examples
+    assert windowed_examples({"k": ["i0"]}, vocab, 2)[2].size == 0
+    c2, _, t2 = windowed_examples({"k": ["i0", "zzz", "i1"]}, vocab, 2)
+    assert t2.size == 0  # zzz poisons both the target and later contexts
+
+
+def test_parse_session_events_skips_bad_lines():
+    from oryx_tpu.apps.seq.common import parse_session_events
+
+    users, sess, items, tss = parse_session_events([
+        KeyMessage(None, "u1,s1,i1,1000"),
+        KeyMessage(None, "u1,s1,i2"),        # no ts
+        KeyMessage(None, "u1,,i2,1000"),      # empty session
+        KeyMessage(None, '["u2","s2","i3",7]'),
+    ])
+    assert list(users) == ["u1", "u2"]
+    assert list(items) == ["i1", "i3"]
+    assert list(tss) == [1000, 7]
+
+
+# ---- mergeable aggregate ---------------------------------------------------
+
+def test_aggregate_merge_matches_from_scratch_and_roundtrips():
+    from oryx_tpu.apps.seq.batch import SeqAggregateState
+    from oryx_tpu.apps.seq.common import parse_session_events
+
+    rng = np.random.default_rng(3)
+    lines = [
+        f"u{rng.integers(0, 4)},s{rng.integers(0, 6)},i{rng.integers(0, 9)},{t}"
+        for t in rng.permutation(60)
+    ]
+    ev = parse_session_events([KeyMessage(None, l) for l in lines])
+    full = SeqAggregateState.from_events(*ev, 50)
+    # K-window merge must equal the from-scratch aggregation
+    merged = SeqAggregateState.empty(50)
+    for lo in range(0, 60, 17):
+        chunk = parse_session_events(
+            [KeyMessage(None, l) for l in lines[lo : lo + 17]]
+        )
+        merged = merged.merge(SeqAggregateState.from_events(*chunk, 50))
+    assert merged.sessions == full.sessions
+    # npz-array roundtrip is exact
+    back = SeqAggregateState.from_arrays(full.to_arrays(), 50)
+    assert back.sessions == full.sessions
+    assert back.entries == full.entries
+
+
+# ---- update-topic state ----------------------------------------------------
+
+def _model_message(n_items=4, dim=8, window=3, inline_e=True):
+    from oryx_tpu.common.artifact import ModelArtifact
+    from oryx_tpu.ops.seq import init_gru_params
+
+    rng = np.random.default_rng(1)
+    tensors = dict(init_gru_params(jax.random.PRNGKey(0), dim))
+    if inline_e:
+        tensors["E"] = rng.standard_normal((n_items, dim)).astype(np.float32)
+    art = ModelArtifact("seq", extensions={"dim": str(dim), "window": str(window)},
+                        tensors=tensors)
+    art.set_extension("ItemIDs", [f"i{j}" for j in range(n_items)])
+    return art.to_string()
+
+
+def test_apply_seq_update_model_then_up_flood():
+    from oryx_tpu.apps.seq.state import apply_seq_update
+    from oryx_tpu.apps.updates import vector_update_message
+
+    st = apply_seq_update(None, "MODEL", _model_message(inline_e=False))
+    assert st.fraction_loaded() == 0.0  # skeleton: rows arrive via UP
+    for j in range(4):
+        _, msg = vector_update_message("E", f"i{j}", np.full(8, float(j)))
+        st = apply_seq_update(st, "UP", msg)
+    assert st.fraction_loaded() == 1.0
+    assert st.items.get("i2")[0] == 2.0
+    # width-mismatched stale UP from an older-rank model is dropped
+    _, stale = vector_update_message("E", "i0", np.zeros(5))
+    st2 = apply_seq_update(st, "UP", stale)
+    assert st2 is st and st.items.get("i0")[0] == 0.0
+    # UP before any MODEL: nothing to apply to
+    assert apply_seq_update(None, "UP", stale) is None
+
+
+def test_apply_seq_update_dim_change_resets_state():
+    from oryx_tpu.apps.seq.state import apply_seq_update
+
+    st = apply_seq_update(None, "MODEL", _model_message(dim=8))
+    assert st.fraction_loaded() == 1.0
+    st2 = apply_seq_update(st, "MODEL", _model_message(dim=16))
+    assert st2 is not st and st2.dim == 16
+
+
+def test_model_without_weights_is_rejected():
+    from oryx_tpu.common.artifact import ModelArtifact
+    from oryx_tpu.apps.seq.state import apply_seq_update
+
+    art = ModelArtifact("seq", extensions={"dim": "8", "window": "3"})
+    with pytest.raises(ValueError):
+        apply_seq_update(None, "MODEL", art.to_string())
+
+
+# ---- trainer: warm start + early stop --------------------------------------
+
+def test_train_gru_warm_start_early_stops():
+    from oryx_tpu.apps.seq.common import windowed_examples
+    from oryx_tpu.ops.seq import train_gru
+
+    RandomManager.use_test_seed(11)
+    vocab = {f"i{j}": j for j in range(12)}
+    sessions = {
+        f"s{s}": [f"i{(s + t) % 12}" for t in range(6)] for s in range(40)
+    }
+    contexts, mask, targets = windowed_examples(sessions, vocab, window=4)
+    ids = list(vocab)
+    cold, ran_cold = train_gru(
+        contexts, mask, targets, n_items=12, dim=8, item_ids=ids,
+        epochs=10, seed_key=jax.random.PRNGKey(0),
+    )
+    assert ran_cold == 10  # no tol: the full epoch budget runs
+    warm, ran_warm = train_gru(
+        contexts, mask, targets, n_items=12, dim=8, item_ids=ids,
+        epochs=10, resume_e=cold.e, resume_params=cold.params,
+        tol=0.05, min_epochs=2, check_every=2,
+        seed_key=jax.random.PRNGKey(1),
+    )
+    assert ran_warm < ran_cold, (
+        "warm start from a converged model did not early-stop"
+    )
+
+
+# ---- speed fold-in ---------------------------------------------------------
+
+def test_speed_fold_emits_delta_and_bounds_tails():
+    from oryx_tpu.apps.seq.speed import SeqSpeedModelManager
+
+    cfg = _cfg(**{"oryx.seq.speed.max-sessions": 3})
+    mgr = SeqSpeedModelManager(cfg)
+    assert mgr.build_updates([KeyMessage(None, "u1,s1,i1,1")]) == []  # no model
+    mgr.consume_key_message("MODEL", _model_message(n_items=6, dim=8))
+    ups = mgr.build_updates([
+        KeyMessage(None, "u1,s1,i0,10"),
+        KeyMessage(None, "u1,s1,i1,11"),
+    ])
+    assert len(ups) == 1 and ups[0][0] == "UP" and ups[0][1].startswith('["E"')
+    # tails LRU-bounded at max-sessions
+    for s in range(5):
+        mgr.build_updates([
+            KeyMessage(None, f"u1,sx{s},i0,{100 + s}"),
+            KeyMessage(None, f"u1,sx{s},i1,{200 + s}"),
+        ])
+    assert len(mgr._tails) <= 3
+
+
+def test_speed_fold_replayed_window_is_idempotent():
+    """The speed layer rewinds and replays a window when the PUBLISH (or
+    quarantine divert) after build_updates fails: the replay must fold
+    nothing a second time — tails carry the newest folded ts, so a
+    replayed window derives zero transitions and zero UP rows."""
+    from oryx_tpu.apps.seq.speed import SeqSpeedModelManager
+
+    mgr = SeqSpeedModelManager(_cfg())
+    mgr.consume_key_message("MODEL", _model_message(n_items=6, dim=8))
+    window = [
+        KeyMessage(None, "u1,s1,i0,100"),
+        KeyMessage(None, "u1,s1,i1,101"),
+        KeyMessage(None, "u1,s1,i2,102"),
+    ]
+    first = mgr.build_updates(window)
+    assert first, "the first pass must fold the window"
+    assert mgr.build_updates(window) == [], "replayed window double-folded"
+    # a genuinely NEWER event for the same session still folds
+    assert mgr.build_updates([KeyMessage(None, "u1,s1,i3,103")])
+
+
+# ---- serving device view: delta sync ---------------------------------------
+
+def test_serving_view_applies_dirty_row_delta_not_full_rebuild(tmp_path):
+    from oryx_tpu.apps.seq.serving import SeqServingModelManager
+    from oryx_tpu.apps.updates import vector_update_message
+
+    mgr = SeqServingModelManager(_cfg())
+    mgr.consume_key_message("MODEL", _model_message(n_items=6, dim=8))
+    model = mgr.get_model()
+    pairs = model.next_items(["i0", "i1"], 3, exclude={"i0", "i1"})
+    assert len(pairs) == 3
+    v1 = model.served_version()
+    dev1, ids1 = model._device_view[0], model._device_view[1]
+    cap = int(model._device_view[3].shape[0])
+    assert cap >= len(ids1)
+    # one row update: the view must catch up by scatter (capacity and
+    # ids grow in place for a NEW item within headroom)
+    _, msg = vector_update_message("E", "iNEW", np.ones(8))
+    mgr.consume_key_message("UP", msg)
+    pairs2 = model.next_items(["i0", "i1"], 8, exclude=set())
+    assert model.served_version() > v1
+    assert any(i == "iNEW" for i, _ in pairs2) or len(pairs2) == 8
+    view = model._device_view
+    assert int(view[0].shape[0]) == cap, "delta apply reallocated the matrix"
+    assert "iNEW" in view[1]
+
+
+def test_serving_encode_unknown_context_is_none():
+    from oryx_tpu.apps.seq.serving import SeqServingModelManager
+
+    mgr = SeqServingModelManager(_cfg())
+    mgr.consume_key_message("MODEL", _model_message())
+    model = mgr.get_model()
+    assert model.encode(["nope", "alsono"]) is None
+    assert model.next_items(["nope"], 3) is None
+    assert model.encode([]) is None

@@ -479,6 +479,86 @@ def fleet_kill(tmp: str) -> list[str]:
     return problems
 
 
+def _seq_model_message(n_items: int = 6, dim: int = 8) -> str:
+    """A small loadable seq MODEL message (GRU weights + inline item
+    embeddings) so the speed manager is past its load fraction before
+    the poison window arrives."""
+    import numpy as np
+
+    import jax
+
+    from oryx_tpu.common.artifact import ModelArtifact
+    from oryx_tpu.ops.seq import init_gru_params
+
+    rng = np.random.default_rng(7)
+    art = ModelArtifact(
+        "seq",
+        extensions={"dim": str(dim), "window": "4"},
+        tensors={
+            "E": rng.standard_normal((n_items, dim)).astype(np.float32),
+            **init_gru_params(jax.random.PRNGKey(0), dim),
+        },
+    )
+    art.set_extension("ItemIDs", [f"i{j}" for j in range(n_items)])
+    return art.to_string()
+
+
+@scenario("seq-poison",
+          "the seq app's two poison classes through the REAL manager: "
+          "malformed session events are swept by the SPI validate_records "
+          "hook into the dead-letter store, and a line that passes the "
+          "cheap sweep but deterministically breaks the build (int64 "
+          "timestamp overflow) is isolated by bisection; both replayable, "
+          "survivors' updates published, stream converges")
+def seq_poison(tmp: str) -> list[str]:
+    from oryx_tpu.bus.broker import get_broker, topics
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.common.quarantine import load_quarantined, quarantine_files
+    from oryx_tpu.layers.speed import SpeedLayer
+    from oryx_tpu.apps.seq.speed import SeqSpeedModelManager
+
+    name = "chaos-cli-seq"
+    cfg = load_config(overlay={
+        "oryx.id": name,
+        "oryx.input-topic.broker": f"mem://{name}",
+        "oryx.update-topic.broker": f"mem://{name}",
+        "oryx.monitoring.quarantine.dir": os.path.join(tmp, "quarantine"),
+        "oryx.monitoring.quarantine.max-attempts": 1,
+        "oryx.monitoring.retry.base-ms": 5,
+    })
+    in_topic = cfg.get_string("oryx.input-topic.message.topic")
+    up_topic = cfg.get_string("oryx.update-topic.message.topic")
+    topics.maybe_create(f"mem://{name}", in_topic, 2)
+    topics.maybe_create(f"mem://{name}", up_topic, 1)
+    broker = get_broker(f"mem://{name}")
+    manager = SeqSpeedModelManager(cfg)
+    manager.consume_key_message("MODEL", _seq_model_message())
+    layer = SpeedLayer(cfg, manager=manager)
+    layer.ensure_streams()
+
+    malformed = ["u1,s0,i0", "u1,s0,,2000", "u1,s0,i1,not-a-ts"]
+    poison = "u1,s9,i0,1e300"  # passes the cheap sweep; int64 overflow in build
+    good = ["u1,s2,i0,1000", "u1,s2,i1,1001"]
+    for m in malformed + [poison] + good:
+        broker.send(in_topic, m, m)
+
+    layer.run_batch()  # attempt 1: build raises, window rewinds
+    layer.run_batch()  # attempt 2: bisect + divert + commit
+    problems = []
+    files = quarantine_files(os.path.join(tmp, "quarantine"), "speed")
+    dead = sorted(km.message for f in files for km in load_quarantined(f))
+    if dead != sorted(malformed + [poison]):
+        problems.append(f"dead letters {dead}, want malformed + overflow line")
+    ups = _updates(broker, up_topic)
+    if len(ups) != 1 or '"E"' not in ups[0]:
+        problems.append(f"survivor fold-in updates wrong: {ups}")
+    broker.send(in_topic, None, "u1,s2,i2,1002")
+    if layer.run_batch() != 1:
+        problems.append("stream did not converge after quarantine")
+    layer.close()
+    return problems
+
+
 def replay_quarantine(paths: list[str]) -> int:
     """Print a dead-letter file's records as raw input lines, ready to
     pipe into `curl --data-binary @- .../ingest` once the poison cause is

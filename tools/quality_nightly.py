@@ -41,6 +41,7 @@ def main() -> int:
         KMEANS_SSE_RATIO_CEIL,
         ML25M_SHAPE,
         RDF_ACC_FLOOR,
+        SEQ_HIT_RATE_FLOOR,
     )
 
     import jax
@@ -55,6 +56,7 @@ def main() -> int:
             "kmeans_sse_ratio_max": KMEANS_SSE_RATIO_CEIL,
             "kmeans_silhouette": KMEANS_SIL_FLOOR,
             "score_mode_recall_at_10": MIN_SCORE_MODE_RECALL,
+            "seq_hit_rate_at_10": SEQ_HIT_RATE_FLOOR,
         },
         "gates": {},
     }
@@ -157,6 +159,30 @@ def main() -> int:
             "wall_s": round(time.perf_counter() - t0, 1),
         },
         rr.green,
+    )
+
+    # ---- gate 5: seq next-item hit-rate floor ---------------------------
+    # the fourth packaged app is recall-gated like the ALS score modes:
+    # planted-successor sessions, hit-rate@10 on held-out final
+    # transitions (ceiling ~0.85 at follow_p=0.85, chance k/V)
+    RandomManager.use_test_seed(1)
+    t0 = time.perf_counter()
+    from oryx_tpu.ml.quality import build_and_evaluate_seq
+
+    sq = build_and_evaluate_seq()
+    record(
+        "seq_next_item",
+        {
+            "hit_rate_at_10": round(sq.hit_rate, 4),
+            "chance": round(sq.chance, 4),
+            "examples": sq.examples,
+            "n_items": sq.n_items,
+            "n_sessions": sq.n_sessions,
+            "epochs_run": sq.epochs_run,
+            "build_s": round(sq.build_s, 1),
+            "wall_s": round(time.perf_counter() - t0, 1),
+        },
+        sq.hit_rate >= SEQ_HIT_RATE_FLOOR,
     )
 
     doc["all_green"] = ok
