@@ -2032,6 +2032,101 @@ def _chunk_thresholds() -> tuple[int, int]:
 _CHUNK_OVER_BYTES, _CHUNK_TARGET_BYTES = None, None
 
 
+def _bench_shard_body() -> None:
+    """Shard-scaling stage (ISSUE 11): the pod-scale sharded serving and
+    training paths measured on the same host. (a) the fused top-k over a
+    2-shard ShardedMatrix vs the 1-shard view — same catalog, same
+    queries, per-shard partials merged by the cross-shard bitonic merge
+    (ops/shard_topk.py) — reported as shard_topk_scaling_2shard (>1 needs
+    one device per shard; on a 1-device host the ratio prices the merge
+    overhead instead, honestly labeled by shard_devices); (b) the bucketed
+    ALS scan under pjit with the factor table sharded over a model-axis
+    mesh, banking oryx_device_mfu{kind=train} as train_mfu — the
+    ROADMAP-item-2 leftover: train MFU measured by the runtime perf
+    accounting, not a bench-side estimate."""
+    import math
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from oryx_tpu.ops.als import topk_dot_batch
+    from oryx_tpu.ops.transfer import sharded_device_put
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    n_dev = len(jax.local_devices())
+    n_items, features, batch, k = (
+        (1_000_000, 50, 1024, 10) if on_accel else (200_000, 32, 256, 10)
+    )
+    rng = np.random.default_rng(5)
+    y = rng.standard_normal((n_items, features)).astype(np.float32)
+    xs = jnp.asarray(rng.standard_normal((batch, features)).astype(np.float32))
+    iters = 20 if on_accel else 6
+    qps: dict[int, float] = {}
+    idx_by: dict[int, object] = {}
+    for shards in (1, 2):
+        sm = sharded_device_put(y, shards, dtype=jnp.bfloat16)
+        v, i = topk_dot_batch(xs, sm, k=k)  # warm: compile per shard
+        np.asarray(v)
+        idx_by[shards] = np.asarray(i)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            v, i = topk_dot_batch(xs, sm, k=k)
+            np.asarray(i)
+        dt = time.perf_counter() - t0
+        qps[shards] = batch * iters / dt
+    scaling = qps[2] / qps[1] if qps[1] > 0 else None
+    # the correctness half of the claim rides along: the 2-shard merge
+    # must return the 1-shard view's exact candidate set
+    identical = bool((idx_by[1] == idx_by[2]).all())
+
+    # sharded bucketed train -> runtime train-MFU accounting
+    from oryx_tpu.common.perfstats import get_perfstats
+    from oryx_tpu.ops.als import aggregate_interactions, train_als
+    from oryx_tpu.parallel.mesh import model_mesh
+
+    n_users, nnz = (200_000, 2_000_000) if on_accel else (5_000, 40_000)
+    t_users = rng.integers(0, n_users, nnz).astype(str)
+    t_items = rng.integers(0, n_items // 10, nnz).astype(str)
+    data = aggregate_interactions(
+        t_users, t_items, (rng.random(nnz) + 0.2).astype(np.float32),
+        implicit=True,
+    )
+    train_shards = min(2, n_dev)
+    t0 = time.perf_counter()
+    train_als(
+        data, features=features, iterations=3,
+        shard_mesh=model_mesh(train_shards) if train_shards > 1 else None,
+    )
+    train_s = time.perf_counter() - t0
+    train_mfu = get_perfstats().mfu("train")
+
+    print(
+        f"shard scaling: {n_items} items x {features}f, 1-shard "
+        f"{qps[1]:.0f} qps vs 2-shard {qps[2]:.0f} qps on {n_dev} "
+        f"device(s) ({platform}); sharded train {train_s:.1f}s",
+        file=sys.stderr,
+    )
+    out = {
+        "metric": "shard_topk_scaling_2shard",
+        "value": round(scaling, 3) if scaling is not None else None,
+        "unit": "x",
+        "platform": platform,
+        "shard_qps_1shard": round(qps[1], 1),
+        "shard_qps_2shard": round(qps[2], 1),
+        "shard_devices": n_dev,
+        "shard_merge_identical": identical,
+        "shard_items": n_items,
+        "shard_features": features,
+        "shard_train_seconds": round(train_s, 2),
+        "shard_train_shards": train_shards,
+    }
+    if train_mfu is not None and not math.isnan(train_mfu):
+        out["train_mfu"] = round(float(train_mfu), 4)
+    print(json.dumps(out))
+
+
 def _bench_scale_body() -> None:
     """Serving-kernel throughput across the reference's ENTIRE benchmark
     grid (BASELINE.md: items {1M,5M,20M} x features {50,250}; the
@@ -2505,6 +2600,28 @@ def _merge_seq(result: dict, row: dict) -> None:
         result["seq_hit_rate_at_10"] = row["seq_hit_rate_at_10"]
 
 
+def _merge_shard(result: dict, row: dict) -> None:
+    """Shard-scaling block lands nested, with the 2-shard ratio promoted
+    to the compact final line. train_mfu fills in only when the train
+    stage didn't already bank a value (setdefault: the dedicated train
+    build's MFU, measured at full scale, outranks this stage's)."""
+    result["shard"] = {
+        key: row[key]
+        for key in (
+            "shard_qps_1shard", "shard_qps_2shard", "shard_devices",
+            "shard_merge_identical", "shard_items", "shard_features",
+            "shard_train_seconds", "shard_train_shards", "train_mfu",
+            "platform",
+        )
+        if key in row
+    }
+    result["shard_topk_scaling_2shard"] = row.get("value")
+    if row.get("shard_qps_2shard") is not None:
+        result["shard_qps_2shard"] = row["shard_qps_2shard"]
+    if row.get("train_mfu") is not None:
+        result.setdefault("train_mfu", row["train_mfu"])
+
+
 def _merge_lsh(result: dict, row: dict) -> None:
     result["lsh_qps"] = row.get("value")
     result["lsh_vs_baseline"] = row.get("vs_baseline")
@@ -2547,6 +2664,9 @@ _SUITE_STAGES = (
     # measured windows even start
     ("_bench_fleet_body", 480, False, _merge_fleet, True),
     ("_bench_seq_body", 300, False, _merge_seq, False),
+    # shard-scaling: device-only work (catalog generated host-side once,
+    # no serving tier), cheap next to the scale sweep
+    ("_bench_shard_body", 300, False, _merge_shard, False),
     ("_bench_scale_body", 900, True, _merge_scaling, False),
 )
 
@@ -2558,7 +2678,8 @@ _SUITE_STAGES = (
 # (round-4 window post-mortem: the upload-heavy stage ran first, wedged
 # the tunnel when killed mid-transfer, and nothing survived).
 _ACCEL_STAGE_ORDER = (
-    "_bench_body", "_bench_scale_body", "_bench_http_body",
+    "_bench_body", "_bench_shard_body", "_bench_scale_body",
+    "_bench_http_body",
     "_bench_update_storm_body", "_bench_train_body",
     "_bench_generations_body", "_bench_speed_body",
     "_bench_kmeans_rdf_body", "_bench_seq_body",

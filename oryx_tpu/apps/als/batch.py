@@ -64,6 +64,10 @@ class ALSUpdate(MLUpdate):
             "oryx.batch.train.min-iterations", 2
         )
         self.train_check_every = config.get_int("oryx.batch.train.check-every", 2)
+        # pod-scale factor sharding: > 1 runs the bucketed scan under
+        # pjit with the item-factor table row-sharded over a model-axis
+        # mesh of that many devices (ops/als.py train_als shard_mesh)
+        self.train_shards = config.get_int("oryx.batch.train.shards", 1)
         self.max_drift_fraction = config.get_float(
             "oryx.batch.storage.incremental.max-drift-fraction", 0.5
         )
@@ -309,6 +313,10 @@ class ALSUpdate(MLUpdate):
         )
         snap_thread.start()
         try:
+            # shards (when configured and applicable) replace the auto
+            # mesh: the sharded BUCKETED scan is the one that composes
+            # with the donated carry and warm starts below
+            shard_mesh = self._shard_mesh()
             model, sweeps = train_als_warm(
                 agg,
                 features=features,
@@ -316,12 +324,13 @@ class ALSUpdate(MLUpdate):
                 alpha=float(hyperparams["alpha"]),
                 iterations=self.als.iterations,
                 implicit=self.als.implicit,
-                mesh=self._build_mesh(),
+                mesh=None if shard_mesh is not None else self._build_mesh(),
                 compute_dtype=self.als.compute_dtype,
                 resume_y=resume_y,
                 tol=self.train_tol if resume_y is not None else 0.0,
                 min_iterations=self.train_min_iterations,
                 check_every=self.train_check_every,
+                shard_mesh=shard_mesh,
             )
         finally:
             snap_thread.join()
@@ -471,6 +480,41 @@ class ALSUpdate(MLUpdate):
             data, self.test_fraction, super().split_train_test
         )
 
+    def _shard_mesh(self):
+        """Model-axis mesh for pjit-sharded bucketed training, or None.
+
+        Precedence: a candidate sub-mesh (partitioned parallel search)
+        and an explicit TENSOR-PARALLEL training mesh (model axis > 1 —
+        the operator already chose a factor layout) always win; otherwise
+        ``oryx.batch.train.shards > 1`` REPLACES the auto data-parallel
+        mesh for the build — the sharded bucketed scan is the path that
+        keeps the bucketed-width savings, the donated Y carry, and warm
+        starts while the factor table is row-sharded, which the plain
+        mesh trainer has none of. The shard count clamps to the devices
+        that exist — a 2-shard config on a 1-chip host trains unsharded
+        instead of failing the build."""
+        if self.train_shards <= 1:
+            return None
+        from oryx_tpu.parallel.submesh import current_candidate_mesh
+
+        if current_candidate_mesh() is not None:
+            return None
+        from oryx_tpu.parallel.mesh import MODEL_AXIS, model_mesh
+
+        mesh = self.training_mesh()
+        if (
+            mesh is not None
+            and MODEL_AXIS in mesh.shape
+            and mesh.shape[MODEL_AXIS] > 1
+        ):
+            return None
+        import jax
+
+        n = min(self.train_shards, len(jax.devices()))
+        if n <= 1:
+            return None
+        return model_mesh(n)
+
     def _aggregate(self, data: Sequence[KeyMessage]):
         users, items, vals, tss = parse_events(data)
         if len(vals) == 0:
@@ -487,13 +531,16 @@ class ALSUpdate(MLUpdate):
 
     def build_model(self, train: Sequence[KeyMessage], hyperparams: dict[str, Any]) -> ModelArtifact:
         agg = self._aggregate(train)
+        shard_mesh = self._shard_mesh()
         kwargs = dict(
             features=int(hyperparams["features"]),
             lam=float(hyperparams["lambda"]),
             alpha=float(hyperparams["alpha"]),
             iterations=self.als.iterations,
             implicit=self.als.implicit,
-            mesh=self._build_mesh(),
+            # shards (when configured and applicable) replace the auto
+            # mesh so the build takes the row-sharded BUCKETED scan
+            mesh=None if shard_mesh is not None else self._build_mesh(),
             compute_dtype=self.als.compute_dtype,
         )
         model_dir = self.config.get_string("oryx.batch.storage.model-dir", None)
@@ -516,10 +563,11 @@ class ALSUpdate(MLUpdate):
                 agg,
                 pathlib.Path(strip_scheme(model_dir)) / ".als-checkpoint" / combo,
                 self.als.checkpoint_interval,
+                shard_mesh=shard_mesh,
                 **kwargs,
             )
         else:
-            m = train_als(agg, **kwargs)
+            m = train_als(agg, shard_mesh=shard_mesh, **kwargs)
         return self._artifact_from_model(m, hyperparams, agg)
 
     def _artifact_from_model(self, m, hyperparams, agg) -> ModelArtifact:

@@ -80,11 +80,21 @@ class SyncConfig:
     max_delta_fraction: a dirty set larger than this fraction of the store
       full-resyncs instead — past that point the delta costs more than the
       snapshot it replaces.
+    shard_count: > 1 row-shards the device scoring view across that many
+      shards (ops/transfer.ShardedMatrix — one device per shard when the
+      host has them): each shard scores its own row slice and the
+      partials merge exactly (ops/shard_topk.py, bit-identical to the
+      unsharded dispatch), dirty-row deltas scatter into their OWNING
+      shard only, and int8 shards re-quantize per-row locally. The
+      pod-scale layout for catalogs larger than one chip's HBM; on a
+      1-device host every shard shares the device (the CPU correctness
+      simulation the tests pin).
     """
 
     mode: str = "delta"
     capacity_headroom: float = 0.125
     max_delta_fraction: float = 0.2
+    shard_count: int = 1
 
     @staticmethod
     def from_config(config: Config) -> "SyncConfig":
@@ -105,7 +115,13 @@ class SyncConfig:
             raise ValueError(
                 "oryx.serving.api.sync.max-delta-fraction must be in (0, 1]"
             )
-        return SyncConfig(mode, headroom, frac)
+        shards = int(g("shard-count", 1))
+        if shards < 1:
+            raise ValueError(
+                "oryx.serving.api.sync.shard-count must be >= 1, got "
+                f"{shards}"
+            )
+        return SyncConfig(mode, headroom, frac, shards)
 
 
 # Sync metric families + dirty-delta id extension moved to the shared
@@ -399,7 +415,9 @@ class ALSServingModel(ServingModel):
     def _build_unit_view(self, y, ids, version, host_mat) -> tuple:  # oryxlint: holds=_sync_lock
         """Normalize the device view into the cosine-scoring unit view +
         cached host norms. Call under _sync_lock."""
-        from oryx_tpu.ops.transfer import ChunkedMatrix, QuantizedMatrix
+        from oryx_tpu.ops.transfer import (
+            ChunkedMatrix, QuantizedMatrix, ShardedMatrix,
+        )
 
         def normalize(a):
             af = a.astype(jnp.float32)
@@ -411,8 +429,16 @@ class ALSServingModel(ServingModel):
         # normalize to zero (they never reach callers: _post drops
         # out-of-range indices). A quantized view normalizes by SCALE
         # alone (unit(q·s) = q/||q||) and shares the int8 rows — the
-        # cosine view costs no second item matrix in HBM.
-        if isinstance(y, QuantizedMatrix):
+        # cosine view costs no second item matrix in HBM. A sharded view
+        # normalizes per shard (quantized shards stay scale-only and keep
+        # sharing their int8 rows) and stays sharded.
+        if isinstance(y, ShardedMatrix):
+            unit = y.map(
+                lambda s: s.unit_scaled()
+                if isinstance(s, QuantizedMatrix)
+                else normalize(s)
+            )
+        elif isinstance(y, QuantizedMatrix):
             unit = y.unit_scaled()
         elif isinstance(y, ChunkedMatrix):
             unit = y.map(normalize)
@@ -432,16 +458,17 @@ class ALSServingModel(ServingModel):
         exhausted, arena compaction). Call under _sync_lock."""
         from oryx_tpu.ops.transfer import (
             CHUNKED_OVER_BYTES, ChunkedMatrix, device_put_maybe_chunked,
-            quantized_device_put, row_capacity,
+            quantized_device_put, row_capacity, sharded_device_put,
         )
 
         t0 = time.monotonic()
         mat, ids, version = self.state.y.snapshot()
         mat = np.asarray(mat, dtype=np.float32)
         n = len(ids)
+        sharded = self.sync.shard_count > 1
         # int8 quantized views stream 1 byte/element; exact bf16 views 2
         quantize = self.score_mode == "quantized"
-        if quantize and n * self.state.features > CHUNKED_OVER_BYTES:
+        if quantize and not sharded and n * self.state.features > CHUNKED_OVER_BYTES:
             # no chunked quantized form: a model this size serves exact
             # bf16 chunks instead of silently quantizing half the catalog
             log.warning(
@@ -463,7 +490,10 @@ class ALSServingModel(ServingModel):
         cap = n
         if self.sync.mode != "blocking":
             cap = row_capacity(n, self.sync.capacity_headroom)
-            if cap * self.state.features * itemsize > CHUNKED_OVER_BYTES:
+            if (
+                not sharded
+                and cap * self.state.features * itemsize > CHUNKED_OVER_BYTES
+            ):
                 cap = n
         if cap > n:
             host = np.zeros((cap, self.state.features), dtype=np.float32)
@@ -481,7 +511,26 @@ class ALSServingModel(ServingModel):
         # models come back as a ChunkedMatrix: a single (20M, 250)-class
         # operand's program is too large to compile (ops/transfer.py);
         # the batcher scores it chunk-and-merge.
-        if quantize:
+        by_shard = None
+        if sharded:
+            # pod-scale row shards over the CAPACITY rows: growth within
+            # the headroom scatters into its owning shard without
+            # re-planning, and each shard's per-program shape is bounded
+            # by construction (no chunking on top). Sharding replaces
+            # chunking here, never composes with it.
+            y_dev = sharded_device_put(
+                host, self.sync.shard_count,
+                dtype=None if quantize else jnp.bfloat16, quantize=quantize,
+            )
+            from oryx_tpu.serving.viewsync import set_shard_rows
+
+            set_shard_rows(_sync_metrics()[4], y_dev.plan, n)
+            per_row = self.state.features * itemsize + (4 if quantize else 0)
+            by_shard = {
+                s: y_dev.plan.size(s) * per_row
+                for s in range(y_dev.plan.n_shards)
+            }
+        elif quantize:
             y_dev = quantized_device_put(host)
         else:
             y_dev = device_put_maybe_chunked(host, dtype=jnp.bfloat16)
@@ -497,21 +546,26 @@ class ALSServingModel(ServingModel):
         sync_bytes = cap * self.state.features * itemsize + (
             cap * 4 if quantize else 0
         )
-        self._note_resync("full", n, sync_bytes, dur, version)
+        self._note_resync("full", n, sync_bytes, dur, version, by_shard)
         return view
 
     # -- background resync --------------------------------------------------
 
     def _note_resync(self, kind: str, rows: int, n_bytes: int,  # oryxlint: holds=_sync_lock
-                     seconds: float, version: int) -> None:
-        m_bytes, m_secs, m_total, _ = _sync_metrics()
-        m_bytes.inc(n_bytes)
+                     seconds: float, version: int,
+                     by_shard: dict[int, int] | None = None) -> None:
+        from oryx_tpu.serving.viewsync import note_sync_bytes
+
+        m_bytes, m_secs, m_total = _sync_metrics()[:3]
+        note_sync_bytes(m_bytes, n_bytes, by_shard)
         m_secs.observe(seconds)
         m_total.inc(kind=kind)
         self.last_resync = {
             "kind": kind, "rows": rows, "bytes": n_bytes,
             "seconds": seconds, "version": version,
         }
+        if by_shard is not None:
+            self.last_resync["shard_bytes"] = dict(by_shard)
         tr = get_tracer()
         if tr.enabled:
             tr.record_interval(
@@ -611,9 +665,10 @@ class ALSServingModel(ServingModel):
         scatter_rows (per-row scales are independent) — an update storm
         never triggers a full-matrix requantization."""
         from oryx_tpu.ops.transfer import (
-            QuantizedMatrix, quantize_rows_int8, quantized_scatter_bytes,
-            scatter_rows, scatter_transfer_bytes,
+            QuantizedMatrix, ShardedMatrix, quantize_rows_int8,
+            quantized_scatter_bytes, scatter_rows, scatter_transfer_bytes,
         )
+        from oryx_tpu.serving.viewsync import set_shard_rows, sharded_delta_bytes
 
         t0 = time.monotonic()
         y_dev, ids, _version, host_mat = dv
@@ -659,8 +714,38 @@ class ALSServingModel(ServingModel):
         # the old view tuple stays fully consistent until the swap below,
         # at a transient cost of one extra matrix in HBM. Host->device
         # traffic is the bucket-padded delta rows either way.
-        quantized = isinstance(y_dev, QuantizedMatrix)
-        if quantized:
+        sharded = isinstance(y_dev, ShardedMatrix)
+        quantized = isinstance(y_dev, QuantizedMatrix) or (
+            sharded and isinstance(y_dev.shards[0], QuantizedMatrix)
+        )
+        by_shard: dict[int, int] | None = None
+        if sharded:
+            # dirty rows scatter into their OWNING shard only (untouched
+            # shards stay shared with the old view). Quantized shards:
+            # quantize the dirty rows ONCE here (per-row scales are
+            # row-local, so the host-side quantization is bit-identical
+            # to what each shard's scatter would do internally) and hand
+            # every touched shard its pre-quantized slice — the unit
+            # branch below reuses the same q_rows for its scales instead
+            # of quantizing a second time.
+            if quantized:
+                q_rows, s_rows = quantize_rows_int8(mat_rows)
+                new_shards = list(y_dev.shards)
+                for s, local, sel in y_dev.plan.split(
+                    rows, np.arange(rows.size, dtype=np.int64)
+                ):
+                    new_shards[s] = QuantizedMatrix(
+                        scatter_rows(y_dev.shards[s].q, local, q_rows[sel]),
+                        scatter_rows(
+                            y_dev.shards[s].scale, local, s_rows[sel]
+                        ),
+                    )
+                y_new = ShardedMatrix(new_shards, y_dev.plan)
+            else:
+                y_new = scatter_rows(y_dev, rows, mat_rows)
+            if delta.n > n_old:
+                set_shard_rows(_sync_metrics()[4], y_dev.plan, delta.n)
+        elif quantized:
             # quantize the dirty rows ONCE here (per-row scales are
             # independent — never a full requantization) so the unit view
             # below can keep SHARING the device view's int8 rows
@@ -673,14 +758,57 @@ class ALSServingModel(ServingModel):
             y_new = scatter_rows(y_dev, rows, mat_rows)
         self._device_view = (y_new, ids, delta.version, host_mat)
 
-        def _delta_bytes() -> int:
+        def _bytes_of_d(d: int) -> int:
             if quantized:
-                return quantized_scatter_bytes(rows.size, self.state.features)
-            return scatter_transfer_bytes(rows.size, 2, self.state.features)
+                return quantized_scatter_bytes(d, self.state.features)
+            return scatter_transfer_bytes(d, 2, self.state.features)
 
-        n_bytes = _delta_bytes()
+        def _delta_bytes() -> int:
+            return _bytes_of_d(rows.size)
+
+        if sharded:
+            # per-shard accounting: each touched shard's scatter is its
+            # own bucket-padded transfer to that shard's device
+            n_bytes, by_shard = sharded_delta_bytes(
+                y_dev.plan, rows, _bytes_of_d
+            )
+        else:
+            n_bytes = _delta_bytes()
         if uv is not None:
-            if quantized and isinstance(uv[0], QuantizedMatrix):
+            if sharded and quantized:
+                # per-shard quantized unit view: adopt each touched
+                # shard's freshly scattered int8 rows (the two views keep
+                # sharing ONE int8 matrix per shard) and scatter only the
+                # dirty rows' unit scales into that shard — derived from
+                # the SAME q_rows the device scatter above used, so the
+                # whole delta quantizes each dirty row exactly once
+                qn = np.linalg.norm(q_rows.astype(np.float32), axis=1)
+                unit_scales = np.where(
+                    qn > 0, 1.0 / np.maximum(qn, 1e-12), 0.0
+                ).astype(np.float32)
+                unit_shards = list(uv[0].shards)
+                for s, local, sc in y_dev.plan.split(rows, unit_scales):
+                    unit_shards[s] = QuantizedMatrix(
+                        y_new.shards[s].q,
+                        scatter_rows(uv[0].shards[s].scale, local, sc),
+                    )
+                    by_shard[s] = by_shard.get(s, 0) + scatter_transfer_bytes(
+                        len(local), 4, 1
+                    )
+                unit_new = ShardedMatrix(unit_shards, uv[0].plan)
+                n_bytes = sum(by_shard.values())
+            elif sharded:
+                # sharded bf16 unit view: the ShardedMatrix scatter
+                # routes the dirty unit rows into their owning shards —
+                # the same per-shard bucket-padded transfers the device
+                # scatter just priced, so each touched shard's bytes
+                # simply double (no second plan.split pass)
+                unit_rows = mat_rows / np.maximum(norms, 1e-12)[:, None]
+                unit_new = scatter_rows(uv[0], rows, unit_rows)
+                for s in list(by_shard):
+                    by_shard[s] *= 2
+                n_bytes = sum(by_shard.values())
+            elif quantized and isinstance(uv[0], QuantizedMatrix):
                 # the quantized unit view is (shared int8 rows, scale =
                 # 1/||q_row||): adopt the device view's freshly scattered
                 # q and scatter ONLY the dirty rows' unit scales — the
@@ -702,7 +830,7 @@ class ALSServingModel(ServingModel):
             self._unit_view = (unit_new, ids, delta.version, host_mat, uv[4])
         self._note_resync(
             "delta", int(rows.size), n_bytes,
-            time.monotonic() - t0, delta.version,
+            time.monotonic() - t0, delta.version, by_shard,
         )
         return True
 

@@ -72,9 +72,13 @@ class SeqServingModel(ServingModel):
         model swap). NOT donated: in-flight coalesced dispatches still
         score the old buffer — the functional scatter IS the double
         buffer (ops/transfer.py scatter_rows contract)."""
-        from oryx_tpu.ops.transfer import scatter_rows
-
-        from oryx_tpu.serving.viewsync import extend_view_ids, view_sync_metrics
+        from oryx_tpu.ops.transfer import (
+            ShardedMatrix, scatter_rows, scatter_transfer_bytes,
+        )
+        from oryx_tpu.serving.viewsync import (
+            extend_view_ids, note_sync_bytes, set_shard_rows,
+            sharded_delta_bytes, view_sync_metrics,
+        )
         import time as _time
 
         t0 = _time.monotonic()
@@ -93,24 +97,35 @@ class SeqServingModel(ServingModel):
         if ids is None:
             return False
         host_mat[delta.rows] = delta.mat
+        # a ShardedMatrix view routes each dirty row into its OWNING
+        # shard only (ops/transfer.py scatter_rows)
         y_new = scatter_rows(y_dev, delta.rows, delta.mat)
         self._device_view = (y_new, ids, delta.version, host_mat)
-        from oryx_tpu.ops.transfer import scatter_transfer_bytes
 
-        m_bytes, m_secs, m_total, _ = view_sync_metrics()
-        n_bytes = scatter_transfer_bytes(delta.rows.size, 2, self.state.dim)
-        m_bytes.inc(n_bytes)
-        m_secs.observe(_time.monotonic() - t0)
-        m_total.inc(kind="delta")
+        metrics = view_sync_metrics()
+        bytes_of_d = lambda d: scatter_transfer_bytes(d, 2, self.state.dim)
+        if isinstance(y_dev, ShardedMatrix):
+            n_bytes, by_shard = sharded_delta_bytes(
+                y_dev.plan, delta.rows, bytes_of_d
+            )
+            if delta.n > n_old:
+                set_shard_rows(metrics[4], y_dev.plan, delta.n)
+        else:
+            n_bytes, by_shard = bytes_of_d(delta.rows.size), None
+        note_sync_bytes(metrics[0], n_bytes, by_shard)
+        metrics[1].observe(_time.monotonic() - t0)
+        metrics[2].inc(kind="delta")
         return True
 
     def _build_view_full(self) -> tuple:
         """Initial load / delta-overflow fallback: one capacity-padded
         bf16 upload. Call under _sync_lock."""
         from oryx_tpu.ops.transfer import (
-            device_put_maybe_chunked, row_capacity,
+            device_put_maybe_chunked, row_capacity, sharded_device_put,
         )
-        from oryx_tpu.serving.viewsync import view_sync_metrics
+        from oryx_tpu.serving.viewsync import (
+            note_sync_bytes, set_shard_rows, view_sync_metrics,
+        )
         import time as _time
 
         t0 = _time.monotonic()
@@ -123,13 +138,27 @@ class SeqServingModel(ServingModel):
             host[:n] = mat
         else:
             host = mat
-        y_dev = device_put_maybe_chunked(host, dtype=jnp.bfloat16)
+        by_shard = None
+        if self.sync.shard_count > 1:
+            # the seq item-embedding matrix shards exactly like the ALS
+            # item factors: same plan, same owning-shard delta routing,
+            # same cross-shard merge on the serve path
+            y_dev = sharded_device_put(
+                host, self.sync.shard_count, dtype=jnp.bfloat16
+            )
+            set_shard_rows(view_sync_metrics()[4], y_dev.plan, n)
+            by_shard = {
+                s: y_dev.plan.size(s) * self.state.dim * 2
+                for s in range(y_dev.plan.n_shards)
+            }
+        else:
+            y_dev = device_put_maybe_chunked(host, dtype=jnp.bfloat16)
         view = (y_dev, ids, version, host)
         self._device_view = view
-        m_bytes, m_secs, m_total, _ = view_sync_metrics()
-        m_bytes.inc(cap * self.state.dim * 2)
-        m_secs.observe(_time.monotonic() - t0)
-        m_total.inc(kind="full")
+        metrics = view_sync_metrics()
+        note_sync_bytes(metrics[0], cap * self.state.dim * 2, by_shard)
+        metrics[1].observe(_time.monotonic() - t0)
+        metrics[2].inc(kind="full")
         return view
 
     # -- queries -----------------------------------------------------------

@@ -67,6 +67,12 @@ def _parse_args(argv):
         "oryx.fleet.front.policy; hash = consistent-hash-by-user)",
     )
     p.add_argument(
+        "--shards", type=int, default=None,
+        help="fleet: device-view shards per replica (overrides "
+        "oryx.fleet.shards; the second scaling dimension — replicas x "
+        "shards)",
+    )
+    p.add_argument(
         "--compute", type=int, default=1,
         help="pod: total jax.distributed compute (batch) processes in the "
         "pod across all hosts",
@@ -493,7 +499,7 @@ _VALUE_OPTS = {
     "--compute", "--local-start", "--local-count", "--coordinator",
     "--conf", "--url", "--paths", "--rate", "--duration", "--workers",
     "--pmml", "--set", "--loops", "--sync-mode", "--sync-headroom",
-    "--replicas", "--front-port", "--policy", "--app",
+    "--replicas", "--front-port", "--policy", "--shards", "--app",
 }
 
 
@@ -550,7 +556,7 @@ def _pod_child_flags(raw_argv: list[str]) -> list[str]:
 
 def _fleet_child_flags(raw_argv: list[str]) -> list[str]:
     return _child_flags(
-        raw_argv, {"--replicas", "--front-port", "--policy"}
+        raw_argv, {"--replicas", "--front-port", "--policy", "--shards"}
     )
 
 
@@ -578,6 +584,8 @@ def cmd_fleet(config: Config, args, raw_argv: list[str]) -> int:
         overlay["oryx.fleet.front.port"] = args.front_port
     if args.policy is not None:
         overlay["oryx.fleet.front.policy"] = args.policy
+    if args.shards is not None:
+        overlay["oryx.fleet.shards"] = args.shards
     if overlay:
         config = config.overlay(overlay)
     sup = FleetSupervisor(config, argv=_fleet_child_flags(raw_argv))

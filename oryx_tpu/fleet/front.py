@@ -112,6 +112,7 @@ class ReplicaInfo:
         self.staleness_seconds: float | None = None
         self.mfu: float | None = None
         self.update_lag: int | None = None
+        self.shards: int | None = None
         self.last_reasons: list[str] = []
 
     def snapshot(self) -> dict:
@@ -126,6 +127,7 @@ class ReplicaInfo:
             "staleness_seconds": self.staleness_seconds,
             "mfu": self.mfu,
             "update_lag": self.update_lag,
+            "shards": self.shards,
             "degraded": self.last_reasons,
         }
 
@@ -194,6 +196,13 @@ class FleetFront(AsyncHTTPServer):
         # must cover the expected in-flight depth or completions churn
         # through connect/close instead of reusing sockets
         self.pool_size = config.get_int("oryx.fleet.front.pool-size", 256)
+        # shard-aware health: the shards-per-replica topology this fleet
+        # was launched with (oryx.fleet.shards). A replica whose /healthz
+        # reports a DIFFERENT shard count is mis-sharded — restarted with
+        # stale config, about to overrun one chip's HBM at pod scale —
+        # and is treated like a degraded probe: routing never lands on a
+        # half-sharded view
+        self.expect_shards = config.get_int("oryx.fleet.shards", 1)
         if backends is None:
             # derive the local fleet the supervisor would launch: replicas
             # r0..rN-1 on base-port..base-port+N-1 of this host
@@ -260,6 +269,13 @@ class FleetFront(AsyncHTTPServer):
             "Update-topic records each replica still has to consume "
             "(its /healthz update_lag); sustained growth on one replica "
             "means it stopped keeping up with model distribution",
+            labeled=True,
+        )
+        self._g_shards = reg.gauge(
+            "oryx_fleet_replica_shards",
+            "Device-view shard count each replica reports on /healthz "
+            "(1 where unsharded); a replica disagreeing with the fleet's "
+            "configured oryx.fleet.shards is treated as degraded",
             labeled=True,
         )
         self._m_requests = reg.counter(
@@ -344,6 +360,11 @@ class FleetFront(AsyncHTTPServer):
             r.mfu = float(m) if isinstance(m, (int, float)) else None
             lag = body.get("update_lag")
             r.update_lag = int(lag) if isinstance(lag, (int, float)) else None
+            sh = body.get("shards")
+            r.shards = (
+                int(sh) if isinstance(sh, (int, float))
+                else (1 if status in (200, 503) else None)
+            )
             r.last_reasons = [str(x) for x in body.get("degraded") or []]
         if r.generation is not None:
             self._g_gen.set(float(r.generation), replica=r.id)
@@ -353,6 +374,21 @@ class FleetFront(AsyncHTTPServer):
             self._g_mfu.set(r.mfu, replica=r.id)
         if r.update_lag is not None:
             self._g_lag.set(float(r.update_lag), replica=r.id)
+        if r.shards is not None:
+            self._g_shards.set(float(r.shards), replica=r.id)
+
+        expect = max(1, self.expect_shards)
+        if status == 200 and (r.shards or 1) != expect:
+            # shard-aware health: an otherwise-healthy replica serving
+            # the wrong shard topology counts as a degraded probe — the
+            # same eject-after discipline as a 503, with a reason the
+            # ejection log can act on. Checked in BOTH directions: a
+            # replica still sharded after the fleet scaled back to
+            # unsharded is as mis-deployed as the reverse.
+            r.last_reasons = r.last_reasons + [
+                f"shard-topology:{r.shards or 1}!={expect}@{r.id}"
+            ]
+            status = 503
 
         if status == 200:
             r.consecutive_ok += 1
@@ -886,6 +922,7 @@ class FleetFront(AsyncHTTPServer):
             body = json.dumps(
                 {
                     "policy": self.policy,
+                    "shards": self.expect_shards,
                     "replicas": [r.snapshot() for r in self.replicas],
                 }
             )

@@ -42,20 +42,35 @@ _FAST_FAIL_S = 10.0
 
 
 def replica_overlays(
-    config: Config, n: int | None = None, base_port: int | None = None
+    config: Config,
+    n: int | None = None,
+    base_port: int | None = None,
+    shards: int | None = None,
 ) -> list[dict[str, object]]:
     """Per-replica ``--set`` overlays for an N-replica fleet on this host.
 
     Shared config stays shared (broker, topics, model dir); only identity
     and per-process resources differ per replica. Exposed as a function so
     tests and the bench can build the exact child configs without spawning.
+
+    ``shards`` (default ``oryx.fleet.shards``) is the fleet's SECOND
+    scaling dimension: every replica serves its device view row-sharded
+    across that many shards (oryx.serving.api.sync.shard-count — one
+    device per shard on multi-chip hosts), so the fleet scales replicas
+    (processes / failure domains) x shards (devices / HBM capacity).
+    The front probes the same number back off /healthz and treats a
+    mis-sharded replica as degraded.
     """
     if n is None:
         n = config.get_int("oryx.fleet.replicas", 2)
     if base_port is None:
         base_port = config.get_int("oryx.fleet.base-port", 8100)
+    if shards is None:
+        shards = config.get_int("oryx.fleet.shards", 1)
     if n < 1:
         raise ValueError(f"fleet needs >= 1 replica, got {n}")
+    if shards < 1:
+        raise ValueError(f"fleet needs >= 1 shard per replica, got {shards}")
     data_root = strip_scheme(
         config.get_string("oryx.fleet.data-dir", "file:/tmp/oryx_tpu/fleet")
     )
@@ -83,6 +98,10 @@ def replica_overlays(
                 ),
             }
         )
+        if shards > 1:
+            # the sharded-view knob rides the overlay so every replica of
+            # this fleet serves the same (replicas x shards) topology
+            overlays[-1]["oryx.serving.api.sync.shard-count"] = shards
     return overlays
 
 
@@ -104,9 +123,10 @@ class FleetSupervisor:
         stdout=None,
         stderr=None,
         exec_prefixes: list[list[str]] | None = None,
+        shards: int | None = None,
     ):
         self.config = config
-        self.overlays = replica_overlays(config, n, base_port)
+        self.overlays = replica_overlays(config, n, base_port, shards)
         # per-replica command prefixes (e.g. ["taskset", "-c", "0"]):
         # affinity set at exec time is inherited by every thread the
         # replica spawns, unlike a post-hoc sched_setaffinity(pid) which

@@ -734,6 +734,7 @@ def train_als(
     resume_y: np.ndarray | None = None,
     timings: dict | None = None,
     donate_y0: bool = False,
+    shard_mesh=None,
 ) -> ALSModelArrays:
     """Train ALS factor matrices. If a mesh is given, the padded lists and
     factor tables are sharded over its "data" axis and the whole scan runs
@@ -745,11 +746,26 @@ def train_als(
     item-factor init with a [n_items, features] matrix (mid-build
     checkpoint resume: the per-sweep carry is fully determined by Y).
 
+    shard_mesh (mutually exclusive with mesh): run the BUCKETED scan —
+    the trainer incremental generations and warm starts use — under pjit
+    with the item-factor table sharded by row over the mesh's "model"
+    axis (parallel/mesh.model_mesh) and the bucketed lists replicated;
+    XLA inserts the gather/scatter collectives. This is the pod-scale
+    path for factor tables larger than one chip's HBM that still wants
+    the bucketed-width work savings and the donated Y carry, and it
+    composes with the warm-start early stop unchanged (train_als_warm
+    threads it through).
+
     timings (single-device path only): pass a dict to receive a
     {"lists_s", "compile_s", "train_s"} breakdown — the XLA compile is
     separated from compute via AOT lower/compile, so benchmarks report
     one-time compilation apart from the per-build cost it amortizes into.
     """
+    if mesh is not None and shard_mesh is not None:
+        # loud, not silent: a caller combining the two would get
+        # mesh-only training with the shard layout dropped — exactly the
+        # capability loss sharding exists to prevent
+        raise ValueError("train_als: mesh and shard_mesh are mutually exclusive")
     if mesh is not None:
         from oryx_tpu.parallel.mesh import MODEL_AXIS
 
@@ -774,6 +790,16 @@ def train_als(
         # Row counts round to a 1024 unit so retrains on slowly growing
         # data keep hitting the jit cache.
         unit = 1024
+        shard_n = 1
+        if shard_mesh is not None:
+            from oryx_tpu.parallel.mesh import MODEL_AXIS as _M
+
+            shard_n = int(shard_mesh.shape[_M])
+            if shard_n > 1 and unit % shard_n:
+                # the sharded row axis must divide evenly across the
+                # model axis; non-pow2 shard counts grow the rounding
+                # unit instead of failing the device_put
+                unit *= shard_n
         u_buckets, blocks_u = _cached_lists(
             "u_buckets", data, (cap, block, unit),
             lambda: build_bucketed_lists(
@@ -801,9 +827,21 @@ def train_als(
                 + 1.0 / math.sqrt(features)
             )
             y0 = y0 * (jnp.arange(n_i_pad) < n_i)[:, None]
+        put = jnp.asarray
+        if shard_n > 1:
+            # pjit-sharded bucketed scan: the item-factor table (the Y
+            # carry, donated on warm restarts) lives row-sharded over the
+            # mesh's "model" axis; the bucketed lists replicate, and XLA
+            # threads the gather/solve/scatter collectives through the
+            # SAME compiled scan the single-device path runs
+            from oryx_tpu.parallel.mesh import model_sharding, replicated
+
+            rep = replicated(shard_mesh)
+            put = lambda a: jax.device_put(jnp.asarray(a), rep)  # noqa: E731
+            y0 = jax.device_put(y0, model_sharding(shard_mesh, 2))
         args = (
-            tuple(tuple(jnp.asarray(a) for a in b) for b in u_buckets),
-            tuple(tuple(jnp.asarray(a) for a in b) for b in i_buckets),
+            tuple(tuple(put(a) for a in b) for b in u_buckets),
+            tuple(tuple(put(a) for a in b) for b in i_buckets),
             y0, jnp.float32(lam), jnp.float32(alpha),
         )
         kwargs = dict(
@@ -920,6 +958,7 @@ def train_als_checkpointed(
     block: int = 1024,
     seed_key=None,
     compute_dtype: str = "float32",
+    shard_mesh=None,
 ) -> ALSModelArrays:
     """train_als with mid-build checkpoints every `checkpoint_every`
     sweeps: a preempted/killed build resumes from the last checkpoint
@@ -981,6 +1020,7 @@ def train_als_checkpointed(
     kwargs = dict(
         features=features, lam=lam, alpha=alpha, implicit=implicit,
         mesh=mesh, cap=cap, block=block, compute_dtype=compute_dtype,
+        shard_mesh=shard_mesh,
     )
     # checkpoints are only written mid-build (done < iterations) and the
     # fingerprint pins `iterations`, so done < iterations always holds
@@ -1022,6 +1062,7 @@ def train_als_warm(
     tol: float = 0.0,
     min_iterations: int = 1,
     check_every: int = 2,
+    shard_mesh=None,
 ) -> tuple[ALSModelArrays, int]:
     """train_als with a convergence-based early stop for warm starts.
 
@@ -1047,7 +1088,7 @@ def train_als_warm(
             data, features=features, lam=lam, alpha=alpha,
             iterations=iterations, implicit=implicit, mesh=mesh, cap=cap,
             block=block, seed_key=seed_key, compute_dtype=compute_dtype,
-            resume_y=resume_y,
+            resume_y=resume_y, shard_mesh=shard_mesh,
         )
         return m, iterations
     check_every = max(1, check_every)
@@ -1068,6 +1109,7 @@ def train_als_warm(
             iterations=chunk, implicit=implicit, mesh=mesh, cap=cap,
             block=block, seed_key=seed_key, compute_dtype=compute_dtype,
             resume_y=prev_y, donate_y0=prev_y is not None,
+            shard_mesh=shard_mesh,
         )
         done += chunk
         pred = (model.x[su] * model.y[si]).sum(axis=1)
@@ -1394,7 +1436,9 @@ def als_train_tp_jit(
     with x sharded over "data" rows and y over "model" rows.
     """
     from jax.sharding import PartitionSpec as P
-    from oryx_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+    from oryx_tpu.parallel.mesh import (
+        DATA_AXIS, MODEL_AXIS, pcast_varying_compat, shard_map_compat,
+    )
 
     cdt = jnp.dtype(compute_dtype)
 
@@ -1421,7 +1465,7 @@ def als_train_tp_jit(
         x0 = jnp.zeros((n_u_local, y0.shape[1]), dtype=jnp.float32)
         # mark the zero-filled carry as device-varying over "data" so its
         # type matches the per-shard x the loop produces (shard_map VMA)
-        x0 = jax.lax.pcast(x0, (DATA_AXIS,), to="varying")
+        x0 = pcast_varying_compat(x0, (DATA_AXIS,))
         (x_fin, y_fin), _ = jax.lax.scan(
             one_iter, (x0, y0), None, length=iterations
         )
@@ -1430,11 +1474,12 @@ def als_train_tp_jit(
     row_d = P(DATA_AXIS, None)
     row_m = P(MODEL_AXIS, None)
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             body,
             mesh=mesh,
             in_specs=(row_d, row_d, row_d, row_m, row_m, row_m, row_m, P(), P()),
             out_specs=(row_d, row_m),
+            check_vma=False,
         )
     )
 
@@ -1732,11 +1777,21 @@ def topk_dot_batch(xs, y, *, k: int, recall: float = 1.0):
     QuantizedMatrix (int8 rows + per-row scales, score-mode=quantized)
     dispatches the quantized kernel on TPU and the dequantize-and-dot XLA
     form elsewhere; a ChunkedMatrix (oversized model, ops/transfer.py)
-    routes through the chunk-and-merge form. A kernel failure only
+    routes through the chunk-and-merge form; a ShardedMatrix (pod-scale
+    row shards, one device per shard) scores per shard — each shard
+    re-entering this selection with its own dtype — and merges the
+    partials with the cross-shard bitonic merge (ops/shard_topk.py),
+    bit-identical to the unsharded dispatch. A kernel failure only
     disables that exact (shapes, k) signature — standard serving shapes
     keep the fast path."""
-    from oryx_tpu.ops.transfer import ChunkedMatrix, QuantizedMatrix
+    from oryx_tpu.ops.transfer import (
+        ChunkedMatrix, QuantizedMatrix, ShardedMatrix,
+    )
 
+    if isinstance(y, ShardedMatrix):
+        from oryx_tpu.ops.shard_topk import topk_dot_batch_sharded
+
+        return topk_dot_batch_sharded(xs, y, k=k, recall=recall)
     if isinstance(y, ChunkedMatrix):
         return topk_dot_batch_chunked(xs, y.chunks, k=k, recall=recall)
     if isinstance(y, QuantizedMatrix):

@@ -115,6 +115,116 @@ class ChunkedMatrix:
 
 
 # ---------------------------------------------------------------------------
+# sharded device matrices: one logical row-partitioned matrix whose shards
+# live on (up to) as many devices as there are shards — the pod-scale form
+# of the serving item matrix (ops/shard_topk.py scores it per shard and
+# merges the partials; parallel/shardspec.py owns the row partition). On a
+# 1-device host every shard shares the device: a faithful CPU simulation
+# of the multi-chip layout, which is how the host_mesh(n) tests prove the
+# sharded path bit-identical to single-device.
+# ---------------------------------------------------------------------------
+
+
+class ShardedMatrix:
+    """Row-sharded committed device matrix: shards[s] (a device array, or
+    a QuantizedMatrix for score-mode=quantized) holds the rows
+    [plan.bounds[s], plan.bounds[s+1]) of the logical matrix. Quacks like
+    an array exactly where the serving batcher needs it (shape / dtype /
+    devices / nbytes); scoring dispatches through
+    ops.shard_topk.topk_dot_batch_sharded, which merges the per-shard
+    top-k partials with globally rebased indices; scatter_rows routes a
+    dirty-row delta into the OWNING shards only."""
+
+    __slots__ = ("shards", "plan")
+
+    def __init__(self, shards, plan):
+        self.shards = list(shards)
+        self.plan = plan
+        if len(self.shards) != plan.n_shards:
+            raise ValueError(
+                f"{len(self.shards)} shards for a {plan.n_shards}-shard plan"
+            )
+        for s, shard in enumerate(self.shards):
+            if int(shard.shape[0]) != plan.size(s):
+                raise ValueError(
+                    f"shard {s} has {shard.shape[0]} rows, plan owns "
+                    f"{plan.size(s)}"
+                )
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.shards)
+
+    @property
+    def shape(self):
+        return (self.plan.total,) + tuple(self.shards[0].shape[1:])
+
+    @property
+    def dtype(self):
+        return self.shards[0].dtype
+
+    @property
+    def nbytes(self):
+        return int(sum(getattr(s, "nbytes", 0) for s in self.shards))
+
+    def devices(self):
+        out = set()
+        for s in self.shards:
+            out |= set(s.devices())
+        return out
+
+    def map(self, fn) -> "ShardedMatrix":
+        """Per-shard row-local transform (e.g. row normalization for the
+        cosine view); anything cross-shard belongs in the merge step of
+        the sharded kernel."""
+        return ShardedMatrix([fn(s) for s in self.shards], self.plan)
+
+
+def sharded_device_put(
+    a: np.ndarray,
+    n_shards: int,
+    dtype=None,
+    quantize: bool = False,
+    devices=None,
+) -> ShardedMatrix:
+    """Upload a host matrix as a ShardedMatrix: rows partitioned by
+    RowShards.plan, shard s staged onto its own placement device
+    (parallel/shardspec.shard_devices — distinct chips when the host has
+    them, the default device cycled otherwise). quantize=True builds
+    per-shard QuantizedMatrix views; per-row scales are row-local, so a
+    shard-local quantization is bit-identical to quantizing the whole
+    matrix and slicing."""
+    from oryx_tpu.parallel.shardspec import RowShards, shard_devices
+
+    a = np.asarray(a)
+    plan = RowShards.plan(a.shape[0], n_shards)
+    devs = shard_devices(plan.n_shards, devices)
+    shards = []
+    for s in range(plan.n_shards):
+        block = np.ascontiguousarray(a[plan.bounds[s]:plan.bounds[s + 1]])
+        # stage onto the shard's device, then COMMIT the buffers there
+        # (device_put with an explicit device). The default_device
+        # context alone leaves the arrays uncommitted, and the first
+        # scatter/normalize would silently migrate the whole shard back
+        # to the default device — exactly the multi-chip OOM the sharded
+        # layout exists to prevent. Committed shards pin every
+        # descendant computation (delta scatters, the unit-view
+        # normalize) to their own device.
+        with jax.default_device(devs[s]):
+            if quantize:
+                qm = quantized_device_put(block)
+                shards.append(QuantizedMatrix(
+                    jax.device_put(qm.q, devs[s]),
+                    jax.device_put(qm.scale, devs[s]),
+                ))
+            else:
+                shards.append(jax.device_put(
+                    staged_device_put(block, dtype=dtype), devs[s]
+                ))
+    return ShardedMatrix(shards, plan)
+
+
+# ---------------------------------------------------------------------------
 # quantized device matrices: int8 rows + per-row f32 scales. The serving
 # top-k scan is HBM-bandwidth-bound in Y; int8 halves the bf16 stream (a
 # quarter of f32) and the serving tier's exact f32 re-rank of surviving
@@ -259,6 +369,19 @@ def scatter_rows(buf, idx: np.ndarray, rows: np.ndarray, *, donate: bool = False
             scatter_rows(buf.q, idx, q_rows, donate=donate),
             scatter_rows(buf.scale, idx, s_rows, donate=donate),
         )
+    if isinstance(buf, ShardedMatrix):
+        # dirty rows scatter into their OWNING shard only (the pod-scale
+        # delta-sync contract): untouched shards are shared with the old
+        # view, and a quantized shard re-quantizes its own dirty rows
+        # per-row via the QuantizedMatrix branch below — shard-local by
+        # construction, never a cross-shard (let alone full-matrix)
+        # requantization.
+        new_shards = list(buf.shards)
+        for s, local, r in buf.plan.split(idx, np.asarray(rows)):
+            new_shards[s] = scatter_rows(
+                buf.shards[s], local, r, donate=donate
+            )
+        return ShardedMatrix(new_shards, buf.plan)
     if isinstance(buf, ChunkedMatrix):
         order = np.argsort(idx, kind="stable")
         idx_s, rows_s = idx[order], np.asarray(rows)[order]

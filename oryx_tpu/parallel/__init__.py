@@ -11,6 +11,9 @@ from oryx_tpu.parallel.mesh import (
     data_sharding,
     host_mesh,
     make_mesh,
+    model_mesh,
+    model_sharding,
     replicated,
     shard_array,
 )
+from oryx_tpu.parallel.shardspec import RowShards, shard_devices

@@ -45,10 +45,25 @@ def candidate_mesh(mesh: Mesh | None):
 
 
 def process_groups(process_ids: list[int], k: int) -> list[list[int]]:
-    """Partition an ordered process list into up to k contiguous groups,
-    sizes as equal as possible — the multi-PROCESS analogue of
-    partition_mesh's contiguous data-axis slices. Deterministic: every pod
-    member computes the identical partition from (process list, k)."""
+    """Partition an ordered unit list into min(k, len) contiguous groups.
+
+    THE partitioning contract of the project — processes here, mesh data
+    rows in partition_mesh, factor-matrix rows in
+    parallel/shardspec.RowShards — one implementation so no two layers
+    can ever disagree about how an ordered axis splits:
+
+    - groups are contiguous runs of the input, in input order;
+    - sizes are as equal as possible, with the LARGER groups first
+      (divmod remainder distributed to the leading groups);
+    - k clamps to [1, len(process_ids)]: asking for more groups than
+      units returns one unit per group, never empty groups — callers
+      must read the EFFECTIVE parallelism from len(result), not from
+      the k they asked for (the k > n disagreement this unified:
+      pod_group_submesh proceeded with n groups while a caller that
+      assumed k groups dealt work modulo the wrong count).
+
+    Deterministic: every pod member computes the identical partition
+    from (process list, k)."""
     k = max(1, min(k, len(process_ids)))
     base, extra = divmod(len(process_ids), k)
     groups: list[list[int]] = []
@@ -74,8 +89,12 @@ def pod_group_submesh(mesh: Mesh, k: int) -> tuple[int, list[list[int]], Mesh] |
     only the group's own hosts — groups never synchronize mid-build.
 
     Returns None when the mesh cannot be partitioned by process (a data
-    row spanning several processes, or a single-process pod): callers
-    fall back to the serial lockstep search."""
+    row spanning several processes, non-host-major row ownership, or a
+    single-process pod): callers fall back to the serial lockstep
+    search. Every None branch is computed from pod-global inputs, so
+    the whole pod always takes the SAME path — a member can never
+    compute a different partition (or a different fallback decision)
+    than its peers."""
     import jax
 
     if jax.process_count() <= 1:
@@ -97,6 +116,17 @@ def pod_group_submesh(mesh: Mesh, k: int) -> tuple[int, list[list[int]], Mesh] |
         # collectives. Every member computes this same set comparison
         # from the same mesh, so the whole pod falls back together.
         return None
+    if row_owner != sorted(row_owner):
+        # Unified ordering contract (process_groups): groups are
+        # CONTIGUOUS runs of the ordered unit list. A mesh whose data
+        # rows are not host-major (owners interleaved, e.g. [0,1,0,1])
+        # has process groups that are non-contiguous in row space —
+        # partition_mesh and this function would then carve DIFFERENT
+        # device partitions from the same (mesh, k). Fall back (every
+        # member sees the same row_owner, so the whole pod falls back
+        # together) instead of silently diverging from the documented
+        # contiguous-slice contract.
+        return None
     groups = process_groups(procs, k)
     if len(groups) <= 1:
         return None
@@ -105,23 +135,30 @@ def pod_group_submesh(mesh: Mesh, k: int) -> tuple[int, list[list[int]], Mesh] |
     if my_group is None:
         return None
     rows = [r for r, p in enumerate(row_owner) if p in groups[my_group]]
+    # host-major ownership + contiguous process groups => contiguous row
+    # runs: the same slice partition_mesh(mesh, len(groups)) computes
+    # when the per-process row counts are equal
     sub = Mesh(mesh.devices[rows, :], (DATA_AXIS, MODEL_AXIS))
     return my_group, groups, sub
 
 
 def partition_mesh(mesh: Mesh, k: int) -> list[Mesh]:
     """Split a (data, model) mesh into up to k disjoint sub-meshes along
-    the data axis (contiguous slices, sizes as equal as possible; the
-    model axis is kept whole inside every sub-mesh — tensor-parallel
-    candidates stay tensor-parallel). Returns fewer than k meshes when
-    the data axis has fewer rows than k; a 1-row data axis returns the
-    whole mesh (nothing to partition)."""
+    the data axis (the process_groups contract: contiguous slices in
+    row order, sizes as equal as possible with larger slices first, k
+    clamped to the row count; the model axis is kept whole inside every
+    sub-mesh — tensor-parallel candidates stay tensor-parallel).
+    Returns fewer than k meshes when the data axis has fewer rows than
+    k — callers read the effective parallelism from the RESULT length;
+    a 1-row data axis returns the whole mesh (nothing to partition).
+    The row selection is the same explicit-rows form pod_group_submesh
+    builds its group sub-mesh with, so the two can never drift."""
     if k <= 1:
         return [mesh]
     row_groups = process_groups(list(range(mesh.devices.shape[0])), k)
     if len(row_groups) <= 1:
         return [mesh]
     return [
-        Mesh(mesh.devices[rows[0] : rows[-1] + 1, :], (DATA_AXIS, MODEL_AXIS))
+        Mesh(mesh.devices[rows, :], (DATA_AXIS, MODEL_AXIS))
         for rows in row_groups
     ]

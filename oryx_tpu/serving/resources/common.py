@@ -117,6 +117,13 @@ def register(app: ServingApp) -> None:
             body["replica"] = a.replica_id
         if a.listen_port:
             body["port"] = a.listen_port
+        shard_count = a.config.get_int("oryx.serving.api.sync.shard-count", 1)
+        if shard_count > 1:
+            # shard topology surface: the fleet front compares this
+            # against its expected shards-per-replica and treats a
+            # mis-sharded replica (restarted with stale config, about to
+            # overrun one chip's HBM) as degraded
+            body["shards"] = shard_count
         age = a.staleness_age()
         if age is not None:
             body["staleness_seconds"] = round(age, 3)

@@ -24,9 +24,9 @@ _SYNC_METRICS_LOCK = threading.Lock()
 
 
 def view_sync_metrics():
-    """(bytes counter, seconds histogram, resync counter, lsh histogram) —
-    process-wide, lazily registered so importing this module never touches
-    the registry."""
+    """(bytes counter, seconds histogram, resync counter, lsh histogram,
+    shard-rows gauge) — process-wide, lazily registered so importing this
+    module never touches the registry."""
     global _SYNC_METRICS
     if _SYNC_METRICS is None:
         with _SYNC_METRICS_LOCK:
@@ -37,7 +37,12 @@ def view_sync_metrics():
                         "oryx_device_sync_bytes",
                         "host->device bytes moved keeping serving views in "
                         "sync (delta scatters move dirty rows; full "
-                        "resyncs move the whole matrix)",
+                        "resyncs move the whole matrix). The unlabeled "
+                        "series is the process total; on a sharded view "
+                        "each {shard=\"sN\"} series carries the bytes that "
+                        "landed on that shard's device — a dirty-row "
+                        "delta touching one shard moves ~1/S of a "
+                        "full-matrix sync",
                     ),
                     reg.histogram(
                         "oryx_device_sync_seconds",
@@ -57,8 +62,51 @@ def view_sync_metrics():
                         "(delta reassignments ride oryx_device_sync_seconds)",
                         buckets=MICROBATCH_BUCKETS,
                     ),
+                    reg.gauge(
+                        "oryx_shard_rows",
+                        "valid (non-padding) rows each shard of the "
+                        "sharded serving view owns, by {shard=\"sN\"} — "
+                        "absent on unsharded views",
+                        labeled=True,
+                    ),
                 )
     return _SYNC_METRICS
+
+
+def note_sync_bytes(m_bytes, total: int, by_shard: dict[int, int] | None) -> None:
+    """Record one resync's host->device traffic: the unlabeled process
+    total, plus — on a sharded view — a {shard="sN"} series per shard the
+    delta actually landed on (each shard's scatter is its own
+    bucket-padded transfer to that shard's device)."""
+    m_bytes.inc(total)
+    if by_shard:
+        for s, n in by_shard.items():
+            if n:
+                m_bytes.inc(n, shard=f"s{s}")
+
+
+def set_shard_rows(gauge, plan, n_valid: int) -> None:
+    """Publish per-shard valid-row ownership for a sharded view: shard s
+    owns the capacity rows [bounds[s], bounds[s+1]), of which the rows
+    below the store size n_valid are real."""
+    for s in range(plan.n_shards):
+        lo, hi = plan.bounds[s], plan.bounds[s + 1]
+        gauge.set(float(max(0, min(n_valid, hi) - lo)), shard=f"s{s}")
+
+
+def sharded_delta_bytes(plan, rows, bytes_of_d) -> tuple[int, dict[int, int]]:
+    """(total, {shard: bytes}) one dirty-row delta moves into a sharded
+    view: rows split by owning shard (parallel/shardspec), each shard's
+    slice priced by ``bytes_of_d`` (its own bucket-padded scatter). The
+    owning-shard-only contract means a delta confined to one shard
+    produces exactly one entry."""
+    import numpy as np
+
+    by_shard = {
+        s: int(bytes_of_d(len(local)))
+        for s, local, _ in plan.split(np.asarray(rows))
+    }
+    return sum(by_shard.values()), by_shard
 
 
 def extend_view_ids(ids: list, delta) -> list | None:

@@ -10,6 +10,7 @@ import urllib.error
 import urllib.request
 
 import numpy as np
+import pytest
 
 from oryx_tpu.apps.als.serving import ALSServingModel, SyncConfig
 from oryx_tpu.apps.als.state import ALSState, FactorStore
@@ -417,11 +418,15 @@ def _scrape(base: str, name: str) -> dict[str, float]:
     return out
 
 
-def test_update_storm_smoke_zero_5xx_monotone_generation_delta_sync():
+@pytest.mark.parametrize("shards", [1, 2])
+def test_update_storm_smoke_zero_5xx_monotone_generation_delta_sync(shards):
     """The acceptance smoke: /recommend under a continuous UP stream must
     serve zero 5xx, oryx_model_generation must be monotone across MODEL
     publishes, and at least one kind=delta view resync must happen (with
-    kind=full staying at its initial-load count)."""
+    kind=full staying at its initial-load count). shards=2 runs the same
+    end-to-end storm over a 2-shard serving view (PR 11): deltas must
+    land in their owning shard and the per-shard sync-byte series must
+    both move."""
     from oryx_tpu.apps.als.serving import ALSServingModelManager
     from oryx_tpu.bus.broker import get_broker, topics
     from oryx_tpu.bus.inproc import InProcBroker
@@ -452,6 +457,7 @@ def test_update_storm_smoke_zero_5xx_monotone_generation_delta_sync():
         # poll behind and a 20% dirty set would legitimately (but
         # irrelevantly here) convert one delta into a full rebuild
         "oryx.serving.api.sync.max-delta-fraction": 1.0,
+        "oryx.serving.api.sync.shard-count": shards,
     })
     topics.maybe_create("mem://storm", "OryxUpdate", partitions=1)
     topics.maybe_create("mem://storm", "OryxInput", partitions=1)
@@ -547,6 +553,16 @@ def test_update_storm_smoke_zero_5xx_monotone_generation_delta_sync():
         full_after = reg.counter("oryx_view_resync_total").value(kind="full")
         assert full_after - full_baseline <= 2
         assert reg.counter("oryx_device_sync_bytes").value() > 0
+        if shards == 2:
+            # the sharded storm actually exercised BOTH shards: each
+            # shard's device received its slice of the full build plus
+            # its own dirty rows, and nothing else
+            c = reg.counter("oryx_device_sync_bytes")
+            assert c.value(shard="s0") > 0 and c.value(shard="s1") > 0
+            from oryx_tpu.ops.transfer import ShardedMatrix
+
+            served = manager.get_model()
+            assert isinstance(served._device_view[0], ShardedMatrix)
     finally:
         serving.close()
         InProcBroker.reset_all()

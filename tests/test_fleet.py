@@ -71,8 +71,9 @@ class _StubReplica:
     """Scripted HTTP backend: /healthz answers 200; every other GET/POST
     runs the injected behavior. Counts the non-probe requests it served."""
 
-    def __init__(self, behave):
+    def __init__(self, behave, healthz: bytes = b'{"status":"up","degraded":[]}'):
         self.behave = behave
+        self.healthz = healthz
         self.hits = 0
         self.lock = threading.Lock()
         stub = self
@@ -87,7 +88,7 @@ class _StubReplica:
                 length = int(self.headers.get("Content-Length") or 0)
                 self.rfile.read(length) if length else b""
                 if self.path == "/healthz":
-                    body = b'{"status":"up","degraded":[]}'
+                    body = stub.healthz
                     self.send_response(200)
                 else:
                     with stub.lock:
@@ -433,6 +434,75 @@ def test_replica_overlays_namespace_identity_and_ports():
     assert len(dirs) == 3  # per-replica dead-letter dirs never interleave
     for o in ov:
         assert o["oryx.serving.api.processes"] == 1
+
+
+def test_front_shard_topology_mismatch_degrades():
+    """PR 11 shard-aware health: with oryx.fleet.shards=2, a replica
+    whose /healthz reports the matching shard count stays routable, and
+    a replica serving the WRONG topology (unsharded — restarted with
+    stale config) counts degraded probes and is ejected with a
+    shard-topology reason; both replicas' shard counts are published."""
+    good = _StubReplica(
+        lambda m, p: (200, [], b'{"ok":true}'),
+        healthz=b'{"status":"up","degraded":[],"shards":2}',
+    )
+    bad = _StubReplica(lambda m, p: (200, [], b'{"ok":true}'))  # no shards
+    overlay = {
+        "oryx.fleet.front.probe-interval-sec": 0.2,
+        "oryx.fleet.front.eject-after": 1,
+        "oryx.fleet.shards": 2,
+    }
+    cfg = load_config(overlay=overlay)
+    front = FleetFront(
+        cfg,
+        backends=[("r0", "127.0.0.1", good.port), ("r1", "127.0.0.1", bad.port)],
+        port=0,
+    )
+    front.start()
+    try:
+        r0, r1 = front.replicas
+        deadline = time.time() + 10
+        while r1.routable or not r0.routable:
+            assert time.time() < deadline, (r0.snapshot(), r1.snapshot())
+            time.sleep(0.05)
+        assert r0.state == "up" and r0.shards == 2
+        assert r1.state == "degraded" and (r1.shards or 1) == 1
+        assert any("shard-topology" in x for x in r1.last_reasons)
+        assert front._g_shards.value(replica="r0") == 2.0
+        assert front._g_shards.value(replica="r1") == 1.0
+        # /fleet/status carries the expected topology + per-replica counts
+        status, _, body = _get(front.port, "/fleet/status")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["shards"] == 2
+        assert {r["id"]: r["shards"] for r in doc["replicas"]} == {
+            "r0": 2, "r1": 1,
+        }
+    finally:
+        front.close()
+        good.close()
+        bad.close()
+
+
+def test_replica_overlays_shards_dimension():
+    """replicas x shards: every replica overlay of a sharded fleet
+    carries the shard-count knob; an unsharded fleet's overlays don't
+    (the serving default stays authoritative), and a nonsense shard
+    count is rejected loudly."""
+    cfg = load_config(overlay={"oryx.fleet.replicas": 3})
+    for o in replica_overlays(cfg, shards=2):
+        assert o["oryx.serving.api.sync.shard-count"] == 2
+    for o in replica_overlays(cfg):
+        assert "oryx.serving.api.sync.shard-count" not in o
+    cfg2 = load_config(
+        overlay={"oryx.fleet.replicas": 2, "oryx.fleet.shards": 4}
+    )
+    assert all(
+        o["oryx.serving.api.sync.shard-count"] == 4
+        for o in replica_overlays(cfg2)
+    )
+    with pytest.raises(ValueError):
+        replica_overlays(cfg, shards=0)
 
 
 def test_replica_overlays_reject_empty_fleet():

@@ -112,6 +112,75 @@ def test_pod_group_submesh_partial_process_set_falls_back(monkeypatch):
     assert sub == ("submesh", (2, 1))
 
 
+def test_pod_group_and_partition_mesh_share_one_contract(monkeypatch):
+    """PR 11 bugfix satellite: pod_group_submesh and partition_mesh used
+    to disagree about ordering when k exceeds the partitionable unit
+    count. The unified contract (process_groups): min(k, n) contiguous
+    groups in input order, larger groups first, effective parallelism
+    read from the RESULT — and every pod member must compute the
+    IDENTICAL groups list (a diverging member would wedge the pod's
+    collectives)."""
+    from types import SimpleNamespace
+
+    import jax
+
+    import oryx_tpu.parallel.submesh as sm
+
+    class FakeDev:
+        def __init__(self, proc):
+            self.process_index = proc
+
+    def fake_mesh(owners):
+        devs = np.array([[FakeDev(p)] for p in owners], dtype=object)
+        return SimpleNamespace(devices=devs)
+
+    # Mesh stub returns its device array so row selection is observable
+    monkeypatch.setattr(sm, "Mesh", lambda devs, axes: devs)
+
+    owners = [0, 0, 1, 1, 2, 2]  # host-major, 3 processes x 2 rows
+    monkeypatch.setattr(jax, "process_count", lambda: 3)
+    from oryx_tpu.parallel.submesh import process_groups
+
+    for k in (2, 3, 5, 8):  # includes k > n_processes
+        seen_groups = []
+        for me in range(3):
+            monkeypatch.setattr(jax, "process_index", lambda me=me: me)
+            res = sm.pod_group_submesh(fake_mesh(owners), k)
+            assert res is not None
+            my_group, groups, _sub = res
+            seen_groups.append(groups)
+            # k clamps to the process count: effective parallelism is
+            # len(groups), never the requested k
+            assert len(groups) == min(k, 3)
+            assert me in groups[my_group]
+        # every member computed the identical partition, and it is the
+        # one shared contract (process_groups over the process list)
+        assert all(g == seen_groups[0] for g in seen_groups)
+        assert seen_groups[0] == process_groups([0, 1, 2], k)
+        # one group-leader per group: their sub-mesh rows concatenate to
+        # the mesh's rows exactly once, in mesh order (contiguous runs)
+        per_group = []
+        for procs in seen_groups[0]:
+            monkeypatch.setattr(jax, "process_index", lambda p=procs[0]: p)
+            _, _, sub = sm.pod_group_submesh(fake_mesh(owners), k)
+            per_group.extend(d[0].process_index for d in sub)
+        assert per_group == owners
+        # partition_mesh obeys the same contract over ROWS: its slices
+        # are process_groups(range(n_rows), k), larger slices first
+        subs = sm.partition_mesh(fake_mesh(owners), k)
+        assert [len(s) for s in subs] == [
+            len(g) for g in process_groups(list(range(6)), k)
+        ]
+        assert [d[0].process_index for s in subs for d in s] == owners
+
+    # NON-host-major row ownership breaks the contiguous-groups
+    # contract: every member falls back together (None), deterministically
+    for me in range(2):
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda me=me: me)
+        assert sm.pod_group_submesh(fake_mesh([0, 1, 0, 1]), 2) is None
+
+
 def test_candidate_mesh_is_thread_local():
     import jax
 

@@ -1,0 +1,230 @@
+"""Sharded serving views (PR 11): owning-shard delta sync, per-shard
+sync-byte accounting, and sharded-vs-unsharded answer identity through
+the real ALS/seq serving models."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from oryx_tpu.apps.als.serving import ALSServingModel, SyncConfig
+from oryx_tpu.apps.als.state import ALSState
+from oryx_tpu.common.metrics import get_registry
+from oryx_tpu.ops.transfer import ShardedMatrix, scatter_transfer_bytes
+
+
+def _als_model(n=64, k=8, seed=2, **kw):
+    rng = np.random.default_rng(seed)
+    st = ALSState(k, implicit=True)
+    st.y.bulk_set(
+        [f"i{j}" for j in range(n)],
+        rng.standard_normal((n, k)).astype(np.float32),
+    )
+    st.x.bulk_set(["u0"], rng.standard_normal((1, k)).astype(np.float32))
+    st.set_expected(["u0"], [f"i{j}" for j in range(n)])
+    return st, ALSServingModel(st, **kw)
+
+
+def _wait_synced(model, timeout=10.0):
+    q = np.ones(model.state.features, dtype=np.float32)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if (model.served_version() or -1) >= model.state.y.get_version():
+            return True
+        model.top_n(q, 3)
+        time.sleep(0.01)
+    return False
+
+
+def _shard_bytes(reg, n_shards):
+    c = reg.counter("oryx_device_sync_bytes")
+    return {s: c.value(shard=f"s{s}") for s in range(n_shards)}
+
+
+def test_sharded_view_full_build_splits_bytes_evenly():
+    reg = get_registry()
+    before = _shard_bytes(reg, 2)
+    st, model = _als_model(sync=SyncConfig(shard_count=2))
+    try:
+        q = np.ones(8, dtype=np.float32)
+        model.top_n(q, 5)
+        y_dev = model._device_view[0]
+        assert isinstance(y_dev, ShardedMatrix)
+        assert y_dev.n_shards == 2
+        after = _shard_bytes(reg, 2)
+        moved = {s: after[s] - before[s] for s in after}
+        cap = int(model._device_view[3].shape[0])
+        full = cap * 8 * 2  # bf16 capacity matrix
+        # the full build lands ~1/S of the matrix on each shard
+        assert moved[0] + moved[1] == full
+        assert abs(moved[0] - moved[1]) <= full / 4
+        # per-shard valid-row ownership is published
+        g = reg.gauge("oryx_shard_rows")
+        assert g.value(shard="s0") + g.value(shard="s1") == 64
+    finally:
+        model.close()
+
+
+def test_sharded_delta_moves_only_owning_shard_fraction():
+    """The PR 3 storm assertion one level up: a dirty-row delta touching
+    ONE shard moves that shard's bucket-padded scatter only — about 1/S
+    of what the same delta would cost as a full-matrix sync, and nothing
+    at all on the other shard."""
+    reg = get_registry()
+    # a real-sized store: the minimum 64-row scatter bucket must be small
+    # next to each shard's slice for the 1/S claim to be observable
+    st, model = _als_model(n=1000, sync=SyncConfig(shard_count=2))
+    try:
+        q = np.ones(8, dtype=np.float32)
+        model.top_n(q, 5)
+        cap = int(model._device_view[3].shape[0])
+        plan = model._device_view[0].plan
+        before = _shard_bytes(reg, 2)
+        # dirty exactly one row owned by shard 0 (global row 0)
+        st.y.set("i0", (q * 50).astype(np.float32))
+        assert _wait_synced(model)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and model.last_resync["kind"] != "delta":
+            time.sleep(0.01)
+        lr = model.last_resync
+        assert lr["kind"] == "delta"
+        after = _shard_bytes(reg, 2)
+        moved = {s: after[s] - before[s] for s in after}
+        one_bucket = scatter_transfer_bytes(1, 2, 8)
+        assert moved[0] == one_bucket
+        assert moved[1] == 0.0  # the other shard's device saw NOTHING
+        assert lr["shard_bytes"] == {0: one_bucket}
+        # the update is served, from the shard that owns it
+        assert model.top_n(q, 5)[0][0] == "i0"
+        # untouched shard buffer was shared, not re-uploaded
+        full_matrix = cap * 8 * 2
+        assert moved[0] < full_matrix / 2
+        assert plan.owner(0) == 0
+    finally:
+        model.close()
+
+
+def test_sharded_answers_identical_to_unsharded():
+    st1, unsharded = _als_model(n=100, seed=5)
+    st2, sharded = _als_model(n=100, seed=5, sync=SyncConfig(shard_count=4))
+    try:
+        rng = np.random.default_rng(9)
+        for _ in range(5):
+            q = rng.standard_normal(8).astype(np.float32)
+            a = unsharded.top_n(q, 10)
+            b = sharded.top_n(q, 10)
+            assert [p[0] for p in a] == [p[0] for p in b]
+            np.testing.assert_allclose(
+                [p[1] for p in a], [p[1] for p in b], rtol=1e-6
+            )
+            # cosine rides the sharded unit view
+            a = unsharded.top_n(q, 10, cosine=True)
+            b = sharded.top_n(q, 10, cosine=True)
+            assert [p[0] for p in a] == [p[0] for p in b]
+    finally:
+        unsharded.close()
+        sharded.close()
+
+
+def test_sharded_quantized_delta_requantizes_shard_locally():
+    st, model = _als_model(
+        n=40, sync=SyncConfig(shard_count=2), score_mode="quantized"
+    )
+    try:
+        q = np.ones(8, dtype=np.float32)
+        model.top_n(q, 5)
+        model.top_n(q, 5, cosine=True)  # materialize the unit view
+        y_dev = model._device_view[0]
+        assert isinstance(y_dev, ShardedMatrix)
+        from oryx_tpu.ops.transfer import QuantizedMatrix
+
+        assert all(isinstance(s, QuantizedMatrix) for s in y_dev.shards)
+        shard1_q_before = np.asarray(y_dev.shards[1].q)
+        # dirty one row in shard 0
+        st.y.set("i1", (q * 30).astype(np.float32))
+        assert _wait_synced(model)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and model.last_resync["kind"] != "delta":
+            time.sleep(0.01)
+        assert model.last_resync["kind"] == "delta"
+        y_new = model._device_view[0]
+        # shard 1 untouched: SAME object, identical int8 bits
+        assert y_new.shards[1] is y_dev.shards[1]
+        np.testing.assert_array_equal(
+            np.asarray(y_new.shards[1].q), shard1_q_before
+        )
+        # unit view keeps sharing the device view's int8 rows per shard
+        uv = model._unit_view
+        assert uv is not None and uv[2] == model._device_view[2]
+        assert uv[0].shards[0].q is y_new.shards[0].q
+        assert model.top_n(q, 5)[0][0] == "i1"
+    finally:
+        model.close()
+
+
+def test_als_update_shard_mesh_reachable_through_config(tmp_path):
+    """Review regression (PR 11): oryx.batch.train.shards must actually
+    reach the trainer — on a multi-device host mesh_from_config
+    auto-builds a data-parallel mesh, and the original guard made the
+    knob a silent no-op exactly there. The shards knob replaces the auto
+    mesh; an explicit tensor-parallel mesh and an active candidate
+    sub-mesh still win."""
+    import jax
+
+    from oryx_tpu.apps.als.batch import ALSUpdate
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.parallel.mesh import MODEL_AXIS, MeshSpec, make_mesh
+    from oryx_tpu.parallel.submesh import candidate_mesh
+
+    cfg = load_config(overlay={
+        "oryx.id": "shardwire",
+        "oryx.batch.storage.model-dir": str(tmp_path / "m"),
+        "oryx.batch.train.shards": 2,
+        "oryx.als.hyperparams.features": 4,
+    })
+    upd = ALSUpdate(cfg)
+    sm = upd._shard_mesh()
+    assert sm is not None and sm.shape[MODEL_AXIS] == 2
+    # an explicit tensor-parallel training mesh wins over the knob
+    tp_mesh = make_mesh(MeshSpec(data=4, model=2), jax.devices()[:8])
+    upd_tp = ALSUpdate(cfg, mesh=tp_mesh)
+    assert upd_tp._shard_mesh() is None
+    # a partitioned candidate search's sub-mesh wins too
+    with candidate_mesh(tp_mesh):
+        assert upd._shard_mesh() is None
+    # shards <= 1: never a mesh
+    cfg1 = load_config(overlay={
+        "oryx.id": "shardwire1",
+        "oryx.batch.storage.model-dir": str(tmp_path / "m1"),
+        "oryx.als.hyperparams.features": 4,
+    })
+    assert ALSUpdate(cfg1)._shard_mesh() is None
+
+
+def test_seq_sharded_view_builds_and_deltas():
+    from oryx_tpu.apps.seq.serving import SeqServingModel
+    from oryx_tpu.apps.seq.state import SeqState
+
+    rng = np.random.default_rng(3)
+    n, d = 50, 8
+    st = SeqState(dim=d, window=8)
+    st.params = {
+        "Wx": rng.standard_normal((d, 3 * d)).astype(np.float32) * 0.1,
+        "Wh": rng.standard_normal((d, 3 * d)).astype(np.float32) * 0.1,
+        "b": np.zeros(3 * d, dtype=np.float32),
+    }
+    st.items.bulk_set(
+        [f"i{j}" for j in range(n)],
+        rng.standard_normal((n, d)).astype(np.float32),
+    )
+    model = SeqServingModel(st, sync=SyncConfig(shard_count=2))
+    out = model.next_items(["i1", "i2"], 5)
+    assert out and len(out) == 5
+    assert isinstance(model._device_view[0], ShardedMatrix)
+    # growth + update route through the owning shard
+    st.items.set("i3", rng.standard_normal(d).astype(np.float32))
+    out2 = model.next_items(["i1", "i2"], 5)
+    assert out2 and len(out2) == 5

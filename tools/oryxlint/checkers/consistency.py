@@ -54,14 +54,18 @@ DOC_ROW = re.compile(r"^\|\s*`(oryx_[^`]+)`", re.M)
 METRIC_IGNORE = {"oryx_tpu"}
 
 # Score-mode vocabulary (PR 8): bench fields the serving-mode claims ride
-# on, and the label key the batcher's dispatch records carry.
+# on, and the label key the batcher's dispatch records carry. PR 11 adds
+# the shard-scaling vocabulary (sharded top-k + measured train MFU) and
+# the per-shard sync label.
 REQUIRED_BENCH_FIELDS = (
     "qps_quantized",
     "approx_recall_at_10",
     "quantized_recall_at_10",
     "lsh_measured_recall_at_10",
+    "shard_topk_scaling_2shard",
+    "train_mfu",
 )
-REQUIRED_DOC_TOKENS = ("score_mode",)
+REQUIRED_DOC_TOKENS = ("score_mode", "shard")
 
 
 # -- collectors (shared with the thin CLI wrappers) --------------------------
