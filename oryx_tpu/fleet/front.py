@@ -360,6 +360,8 @@ class FleetFront(AsyncHTTPServer):
             r.mfu = float(m) if isinstance(m, (int, float)) else None
             lag = body.get("update_lag")
             r.update_lag = int(lag) if isinstance(lag, (int, float)) else None
+            # shard-topology rule: this parse is the vocabulary leg
+            # ReplicaInfo.shards is fed by — serving's /healthz emits it
             sh = body.get("shards")
             r.shards = (
                 int(sh) if isinstance(sh, (int, float))

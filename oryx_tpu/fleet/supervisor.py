@@ -101,6 +101,8 @@ def replica_overlays(
         if shards > 1:
             # the sharded-view knob rides the overlay so every replica of
             # this fleet serves the same (replicas x shards) topology
+            # (oryxlint shard-topology: oryx.fleet.shards must overlay
+            # the sync shard-count or the fleet knob is a silent no-op)
             overlays[-1]["oryx.serving.api.sync.shard-count"] = shards
     return overlays
 

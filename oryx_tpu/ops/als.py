@@ -764,7 +764,8 @@ def train_als(
     if mesh is not None and shard_mesh is not None:
         # loud, not silent: a caller combining the two would get
         # mesh-only training with the shard layout dropped — exactly the
-        # capability loss sharding exists to prevent
+        # capability loss sharding exists to prevent (oryxlint's
+        # device-placement rule flags such call sites before runtime)
         raise ValueError("train_als: mesh and shard_mesh are mutually exclusive")
     if mesh is not None:
         from oryx_tpu.parallel.mesh import MODEL_AXIS

@@ -203,7 +203,9 @@ def sharded_device_put(
     for s in range(plan.n_shards):
         block = np.ascontiguousarray(a[plan.bounds[s]:plan.bounds[s + 1]])
         # stage onto the shard's device, then COMMIT the buffers there
-        # (device_put with an explicit device). The default_device
+        # (device_put with an explicit device — oryxlint's
+        # device-placement rule flags uncommitted puts that reach
+        # long-lived stores). The default_device
         # context alone leaves the arrays uncommitted, and the first
         # scatter/normalize would silently migrate the whole shard back
         # to the default device — exactly the multi-chip OOM the sharded

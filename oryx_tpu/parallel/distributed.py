@@ -208,10 +208,13 @@ def mesh_from_config(config: Config) -> Mesh | None:
     single device (trainers then skip sharding entirely). This is how the
     app updates scale to every chip — and every host once init_distributed
     has joined the process group — without code changes."""
+    if jax.device_count() == 1:
+        # read nothing on single-device hosts: the mesh keys only have
+        # meaning once there is something to shard over (and the early
+        # return must not silently drop values already read)
+        return None
     data = config.get_int("oryx.compute.mesh.data", -1)
     model = config.get_int("oryx.compute.mesh.model", 1)
-    if jax.device_count() == 1:
-        return None
     return global_mesh(MeshSpec(data=data, model=model))
 
 

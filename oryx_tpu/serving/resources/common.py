@@ -122,7 +122,9 @@ def register(app: ServingApp) -> None:
             # shard topology surface: the fleet front compares this
             # against its expected shards-per-replica and treats a
             # mis-sharded replica (restarted with stale config, about to
-            # overrun one chip's HBM) as degraded
+            # overrun one chip's HBM) as degraded. oryxlint's
+            # shard-topology rule pins this field to the shard-count
+            # read above — removing either leg alone fails tier-1.
             body["shards"] = shard_count
         age = a.staleness_age()
         if age is not None:

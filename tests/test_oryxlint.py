@@ -23,9 +23,16 @@ if str(ROOT) not in sys.path:
     sys.path.insert(0, str(ROOT))
 
 from tools.oryxlint.core import Project, run_lint  # noqa: E402
+from tools.oryxlint.callgraph import ProjectIndex  # noqa: E402
 from tools.oryxlint.checkers.eventloop import EventLoopChecker  # noqa: E402
 from tools.oryxlint.checkers.jaxpurity import JaxPurityChecker  # noqa: E402
 from tools.oryxlint.checkers.lockdiscipline import LockDisciplineChecker  # noqa: E402
+from tools.oryxlint.checkers.lockorder import (  # noqa: E402
+    LockOrderChecker, load_canonical_order,
+)
+from tools.oryxlint.checkers.paramflow import ParamFlowChecker  # noqa: E402
+from tools.oryxlint.checkers.placement import PlacementChecker  # noqa: E402
+from tools.oryxlint.checkers.shardtopology import ShardTopologyChecker  # noqa: E402
 
 
 def _lint_fixture(tmp_path, source: str, checkers) -> tuple[list, list]:
@@ -349,6 +356,447 @@ def test_metric_rule_catches_undocumented_name(tmp_path):
     assert "oryx_ghost_metric" in msgs        # docs -> code reverse rule
 
 
+# -- dataflow: param-dropped (the PR 11 dropped-shard_mesh class) -------------
+
+
+def test_param_dropped_catches_resume_path_drop(tmp_path):
+    """The ancestor bug: a checkpointed train path accepts the sharding
+    config but forwards it only on the fresh path — the resume path
+    silently trains unsharded."""
+    active, _ = _lint_fixture(tmp_path, """
+        def train_chunk(y, shard_mesh=None):
+            return compute(y, shard_mesh)
+
+        def train_checkpointed(data, config):
+            shards = config.get_int("oryx.batch.train.shards", 1)
+            if data.resume:
+                y = load_ckpt()
+                return train_chunk(y)  # drops shards on the resume path
+            return train_chunk(data.y0, shard_mesh=shards)
+    """, [ParamFlowChecker()])
+    assert _rules(active) == ["param-dropped"]
+    assert "oryx.batch.train.shards" in active[0].message
+    assert "dropped on the path returning" in active[0].message
+
+
+def test_param_dropped_interprocedural_callee_drop(tmp_path):
+    """Handing the value to a wrapper does not launder it: the engine
+    recurses into the callee's parameter with the same every-path rule."""
+    active, _ = _lint_fixture(tmp_path, """
+        def inner(y, shard_mesh=None):
+            if y is None:
+                return base(y)
+            return base(y, shard_mesh)
+
+        def outer(config, y):
+            sm = config.get_int("oryx.batch.train.shards", 1)
+            return inner(y, shard_mesh=sm)
+    """, [ParamFlowChecker()])
+    assert _rules(active) == ["param-dropped"]
+    assert "inner" in active[0].message
+    assert "does not reach a sink on every path" in active[0].message
+
+
+def test_param_dropped_through_partial_rebind_offsets_positionals(tmp_path):
+    """A call through a `partial(...)` alias binds positionals starting
+    at the first UNBOUND callee parameter: `g = partial(train, data)`
+    then `g(n)` reaches train's SECOND parameter — whose resume path
+    drops it (flagged); the compliant callee stays clean."""
+    active, _ = _lint_fixture(tmp_path, """
+        from functools import partial
+
+        def train(data, shards=1):
+            if data is None:
+                return fit(data)
+            return fit(data, shards)
+
+        def run(config, data):
+            g = partial(train, data)
+            n = config.get_int("oryx.batch.train.shards", 1)
+            return g(n)
+
+        def train_ok(data, shards=1):
+            return fit(data, shards)
+
+        def run_ok(config, data):
+            h = partial(train_ok, data)
+            n = config.get_int("oryx.batch.train.shards", 1)
+            return h(n)
+    """, [ParamFlowChecker()])
+    assert _rules(active) == ["param-dropped"]
+    assert "'shards'" in active[0].message and "train" in active[0].message
+
+
+def test_param_dropped_compliant_forms_pass(tmp_path):
+    """Guard-on-the-value returns, attribute stores, full threading, and
+    the `# oryxlint: sink` terminal-read annotation are all clean."""
+    active, _ = _lint_fixture(tmp_path, """
+        class Layer:
+            def adopt(self, config):
+                n = config.get_int("oryx.batch.train.shards", 1)
+                self.shards = n
+
+        def guarded(data, config):
+            shards = config.get_int("oryx.batch.train.shards", 1)
+            if shards <= 1:
+                return plain(data)
+            return sharded(data, shards)
+
+        def terminal(config):
+            n = config.get_int("oryx.batch.train.shards", 1)  # oryxlint: sink
+            return 0
+    """, [ParamFlowChecker()])
+    assert active == []
+
+
+def test_param_dropped_never_consumed_flagged_at_read(tmp_path):
+    active, _ = _lint_fixture(tmp_path, """
+        def dead_read(config):
+            n = config.get_int("oryx.fleet.replica.count", 2)
+            return 0
+    """, [ParamFlowChecker()])
+    assert _rules(active) == ["param-dropped"]
+    assert "never reaches a sink" in active[0].message
+
+
+# -- dataflow: device-placement (the PR 11 uncommitted-device_put class) ------
+
+
+def test_device_placement_uncommitted_store_caught(tmp_path):
+    """The ancestor bug: shards staged under a default_device context
+    only — uncommitted buffers silently migrate to device 0 on first
+    use, recreating the multi-chip OOM sharding exists to prevent."""
+    active, _ = _lint_fixture(tmp_path, """
+        import jax
+
+        class ShardedView:
+            def __init__(self, host, dev):
+                with jax.default_device(dev):
+                    staged = jax.device_put(host)  # no explicit device
+                self.view = staged
+
+        class CommittedView:
+            def __init__(self, host, dev):
+                self.view = jax.device_put(host, dev)
+    """, [PlacementChecker()])
+    assert _rules(active) == ["device-placement"]
+    assert "uncommitted" in active[0].message
+    assert "self.view" in active[0].message
+
+
+def test_device_placement_tracks_through_helper_returns(tmp_path):
+    active, _ = _lint_fixture(tmp_path, """
+        import jax
+
+        def stage(host):
+            return jax.device_put(host)
+
+        class View:
+            def __init__(self, host):
+                self.y = stage(host)
+    """, [PlacementChecker()])
+    assert _rules(active) == ["device-placement"]
+
+
+def test_device_placement_mesh_shard_mesh_pair_caught(tmp_path):
+    """Both layouts constructed and passed to one train call: the loud
+    runtime raise PR 11 added, now caught before runtime. Wrapper
+    forwarding and the conditional-exclusivity idiom stay clean."""
+    active, _ = _lint_fixture(tmp_path, """
+        def pair_bug(data, make_mesh, make_shard):
+            mesh = make_mesh(2)
+            sm = make_shard(2)
+            return train_als(data, mesh=mesh, shard_mesh=sm)
+
+        def wrapper_ok(data, mesh=None, shard_mesh=None):
+            return train_als(data, mesh=mesh, shard_mesh=shard_mesh)
+
+        def conditional_ok(data, make_mesh, shard_mesh=None):
+            return train_als_warm(
+                data,
+                mesh=None if shard_mesh is not None else make_mesh(),
+                shard_mesh=shard_mesh,
+            )
+    """, [PlacementChecker()])
+    assert _rules(active) == ["device-placement"]
+    assert "mutually exclusive" in active[0].message
+
+
+# -- dataflow: lock-order (the PR 11 convention-only multi-lock class) --------
+
+
+_INVERTED_LOCKS = """
+    import threading
+
+    class Batcher:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def forward(self):
+            with self._a:
+                with self._b:
+                    return 1
+
+        def inverted(self):
+            with self._b:
+                with self._a:
+                    return 2
+"""
+
+
+def test_lock_order_inverted_pair_caught(tmp_path):
+    active, _ = _lint_fixture(tmp_path, _INVERTED_LOCKS, [LockOrderChecker()])
+    assert _rules(active) == ["lock-order"]
+    assert "inverted lock pair" in active[0].message
+    assert "deadlock" in active[0].message
+
+
+def test_lock_order_consistent_nesting_passes(tmp_path):
+    active, _ = _lint_fixture(tmp_path, """
+        import threading
+
+        class Batcher:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def forward(self):
+                with self._a:
+                    with self._b:
+                        return 1
+
+            def also_forward(self):
+                with self._a:
+                    return self._under_a()
+
+            def _under_a(self):  # oryxlint: holds=_a
+                with self._b:
+                    return 2
+    """, [LockOrderChecker()])
+    assert active == []
+
+
+def test_lock_order_transitive_edge_through_call(tmp_path):
+    """The acquisition graph crosses function boundaries: holding A and
+    calling a helper that takes B in the opposite order elsewhere is the
+    same deadlock, invisible to any single-function review."""
+    active, _ = _lint_fixture(tmp_path, """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def outer(self):
+                with self._a:
+                    return self.helper_b()
+
+            def helper_b(self):
+                with self._b:
+                    return 1
+
+            def other_thread(self):
+                with self._b:
+                    with self._a:
+                        return 2
+    """, [LockOrderChecker()])
+    assert _rules(active) == ["lock-order"]
+
+
+def test_lock_order_canonical_order_violation(tmp_path):
+    """An edge going backwards against lockorder.toml fails even before
+    the inverse edge lands — the second half of a deadlock should never
+    get written."""
+    order = tmp_path / "lockorder.toml"
+    order.write_text(
+        'order = [\n  "Batcher._a",\n  "Batcher._b",\n]\n', encoding="utf-8"
+    )
+    active, _ = _lint_fixture(tmp_path, """
+        import threading
+
+        class Batcher:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def backwards(self):
+                with self._b:
+                    with self._a:
+                        return 1
+    """, [LockOrderChecker(order_file=order)])
+    assert _rules(active) == ["lock-order"]
+    assert "canonical order" in active[0].message
+
+
+def test_committed_lockorder_toml_is_nonempty_and_ordered():
+    """The committed canonical order exists and ends leaf-ward: shared
+    observability locks (the metrics registry) come after the domain
+    locks that call into them."""
+    order = load_canonical_order()
+    assert "MetricsRegistry._lock" in order
+    assert order.index("MetricsRegistry._lock") == len(order) - 1
+    for domain in ("ALSServingModel._sync_lock", "TopKBatcher._lock"):
+        assert order.index(domain) < order.index("MetricsRegistry._lock")
+
+
+# -- dataflow: shard-topology (the PR 11 half-wired-surface class) ------------
+
+
+def test_shard_topology_new_key_flagged(tmp_path):
+    active, _ = _lint_fixture(tmp_path, """
+        def build(config):
+            n = config.get_int("oryx.pod.shards", 1)
+            return n
+    """, [ShardTopologyChecker()])
+    assert any(
+        f.rule == "shard-topology" and "oryx.pod.shards" in f.message
+        for f in active
+    )
+
+
+def test_shard_topology_half_wired_healthz_flagged(tmp_path):
+    """The healthz resource reads the shard count but never emits the
+    `shards` field — the front can no longer vet replica topology."""
+    res = tmp_path / "oryx_tpu" / "serving" / "resources"
+    res.mkdir(parents=True)
+    (res / "common.py").write_text(textwrap.dedent("""
+        def healthz(a):
+            n = a.config.get_int("oryx.serving.api.sync.shard-count", 1)
+            body = {"ok": True}
+            return encode(body, n)
+    """), encoding="utf-8")
+    active, _ = run_lint(tmp_path, checkers=[ShardTopologyChecker()])
+    assert any(
+        f.rule == "shard-topology" and '"shards"' in f.message
+        for f in active
+    )
+
+
+def test_shard_topology_fully_wired_fixture_passes(tmp_path):
+    res = tmp_path / "oryx_tpu" / "serving" / "resources"
+    res.mkdir(parents=True)
+    (res / "common.py").write_text(textwrap.dedent("""
+        def healthz(a):
+            n = a.config.get_int("oryx.serving.api.sync.shard-count", 1)
+            return {"ok": True, "shards": n}
+    """), encoding="utf-8")
+    fleet = tmp_path / "oryx_tpu" / "fleet"
+    fleet.mkdir(parents=True)
+    (fleet / "supervisor.py").write_text(textwrap.dedent("""
+        def overlays(config):
+            shards = config.get_int("oryx.fleet.shards", 1)
+            return {"oryx.serving.api.sync.shard-count": shards}
+    """), encoding="utf-8")
+    (fleet / "front.py").write_text(textwrap.dedent("""
+        class ReplicaInfo:
+            def __init__(self):
+                self.shards = None
+
+        def probe(r, body):
+            r.shards = body.get("shards")
+    """), encoding="utf-8")
+    (tmp_path / "oryx_tpu" / "batch.py").write_text(
+        'def b(config):\n'
+        '    n = config.get_int("oryx.batch.train.shards", 1)\n'
+        '    return n\n',
+        encoding="utf-8",
+    )
+    (tmp_path / "bench.py").write_text(
+        'FIELDS = ["shard_devices"]\n', encoding="utf-8"
+    )
+    active, _ = run_lint(tmp_path, checkers=[ShardTopologyChecker()])
+    assert active == []
+
+
+# -- callgraph edge cases (PR 12 satellites) ----------------------------------
+
+
+def _index(tmp_path, source: str) -> ProjectIndex:
+    pkg = tmp_path / "oryx_tpu"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "mod.py").write_text(textwrap.dedent(source), encoding="utf-8")
+    return ProjectIndex(Project.load(tmp_path))
+
+
+def test_callgraph_double_partial_resolves(tmp_path):
+    idx = _index(tmp_path, """
+        from functools import partial
+
+        def base(a, b, c):
+            return a
+
+        once = partial(base, 1)
+        twice = partial(partial(base, 1), 2)
+
+        def caller():
+            return twice(3) + once(2, 3)
+    """)
+    caller = idx.top_level[("oryx_tpu/mod.py", "caller")]
+    import ast as _ast
+
+    calls = [n for n in _ast.walk(caller.node) if isinstance(n, _ast.Call)]
+    resolved = {t.name for c in calls for t in idx.resolve_call(caller, c)}
+    assert resolved == {"base"}
+    assert len(idx.partial_aliases) == 2
+
+
+def test_callgraph_property_typed_receiver_resolves(tmp_path):
+    """`self.store.refresh_view()` resolves through the @property's
+    return annotation even when two classes define the method name (the
+    unique-definition fallback cannot apply)."""
+    idx = _index(tmp_path, """
+        class Store:
+            def refresh_view(self):
+                return 1
+
+        class Decoy:
+            def refresh_view(self):
+                return 2
+
+        class Owner:
+            def __init__(self, s: Store):
+                self._s = s
+
+            @property
+            def store(self) -> Store:
+                return self._s
+
+            def go(self):
+                return self.store.refresh_view()
+    """)
+    go = idx.classes["Owner"].methods["go"]
+    import ast as _ast
+
+    calls = [n for n in _ast.walk(go.node) if isinstance(n, _ast.Call)]
+    targets = [t for c in calls for t in idx.resolve_call(go, c)]
+    assert [t.cls for t in targets] == ["Store"]
+
+
+def test_callgraph_lambda_call_sites_counted(tmp_path):
+    idx = _index(tmp_path, """
+        def g():
+            return (lambda x: x)(3)
+    """)
+    g = idx.top_level[("oryx_tpu/mod.py", "g")]
+    import ast as _ast
+
+    for c in [n for n in _ast.walk(g.node) if isinstance(n, _ast.Call)]:
+        idx.resolve_call(g, c)
+    assert idx.stats["lambda_sites"] == 1
+    assert idx.stats["call_sites"] >= 1
+
+
+def test_cli_stats_prints_resolution_rate():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.oryxlint", "--stats"],
+        cwd=ROOT, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "resolved" in proc.stdout and "lambda call site" in proc.stdout
+
+
 # -- check_bench stale-pending ------------------------------------------------
 
 
@@ -424,6 +872,43 @@ def test_pending_survives_artifacts_that_do_not_measure_it(tmp_path):
     assert check_bench.stale_pending_problems(rows, root=str(tmp_path)) == []
 
 
+def test_stale_pending_recognizes_pr11_shard_rows(tmp_path):
+    """PR 11 committed `shard_topk_scaling_2shard` and `train_mfu` as
+    pending+pending_since:11 — the staleness gate must trip each the
+    moment a banked TPU artifact from round >= 11 measures it, and
+    tolerate artifacts that are older or do not measure it."""
+    from tools import check_bench
+
+    rows = [
+        m for m in check_bench.load_baseline(str(ROOT / "BASELINE_RATCHET.json"))
+        if m.get("name") in ("shard_topk_scaling_2shard", "train_mfu")
+    ]
+    assert len(rows) == 2, "the PR 11 pending rows are gone from the ratchet"
+    for m in rows:
+        assert m.get("pending") and m.get("pending_since") == 11
+        assert m.get("platform") == "tpu"
+
+    # tolerate: a TPU artifact OLDER than the declaring round measures it
+    _bank(tmp_path, "BENCH_TPU_WINDOW_r05.json", {
+        "final": {"platform": "tpu", "shard_topk_scaling_2shard": 1.7,
+                  "train_mfu": 0.02},
+    })
+    assert check_bench.stale_pending_problems(rows, root=str(tmp_path)) == []
+    # tolerate: a round-11 TPU artifact that does NOT measure them
+    _bank(tmp_path, "BENCH_TPU_WINDOW_r11.json", {
+        "final": {"platform": "tpu", "kernel_mfu": 0.01},
+    })
+    assert check_bench.stale_pending_problems(rows, root=str(tmp_path)) == []
+    # trip: the same round-11 artifact now banks both measurements
+    _bank(tmp_path, "BENCH_TPU_WINDOW_r11.json", {
+        "final": {"platform": "tpu", "shard_topk_scaling_2shard": 1.8,
+                  "train_mfu": 0.015},
+    })
+    problems = check_bench.stale_pending_problems(rows, root=str(tmp_path))
+    assert len(problems) == 2
+    assert all("remove the pending flag" in p for p in problems)
+
+
 def test_committed_ratchet_has_no_stale_pending_rows():
     from tools import check_bench
 
@@ -455,8 +940,37 @@ def test_cli_json_and_changed_modes():
     )
     assert proc.returncode == 0
     for rule in ("guarded-by", "jit-side-effect", "donation-reuse",
-                 "config-keys", "metric-docs", "bench-ratchet"):
+                 "config-keys", "metric-docs", "bench-ratchet",
+                 "param-dropped", "device-placement", "lock-order",
+                 "shard-topology"):
         assert rule in proc.stdout
+
+
+def test_json_findings_carry_severity_and_fix_hint(tmp_path):
+    """The stable --json per-finding schema: path/line/rule/severity/
+    fix_hint/message (tools/precommit.sh groups on these fields)."""
+    active, _ = _lint_fixture(tmp_path, """
+        def dead_read(config):
+            n = config.get_int("oryx.fleet.replica.count", 2)
+            return 0
+    """, [ParamFlowChecker()])
+    assert len(active) == 1
+    d = active[0].as_dict()
+    assert set(d) == {"path", "line", "rule", "severity", "fix_hint", "message"}
+    assert d["rule"] == "param-dropped"
+    assert d["severity"] == "error"
+    assert "sink" in d["fix_hint"]
+
+
+def test_precommit_script_clean_exit():
+    """tools/precommit.sh consumes the --json schema and exits 0 on a
+    clean (or unchanged) tree, with ruff optional."""
+    proc = subprocess.run(
+        ["sh", str(ROOT / "tools" / "precommit.sh")],
+        cwd=ROOT, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "precommit:" in proc.stdout
 
 
 # -- the tier-1 whole-tree gate ----------------------------------------------

@@ -9,7 +9,11 @@ import sys
 import pytest
 from pathlib import Path
 
-from hypothesis import given, settings, strategies as st
+# hypothesis is an optional dev dependency: absent in the minimal CI
+# container, the whole suite must still COLLECT cleanly (a hard import
+# here was a tier-1 collection error, not a skip)
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from oryx_tpu.common.text import (
     from_json,

@@ -24,11 +24,23 @@ Checkers shipped (tools/oryxlint/checkers/):
   (both directions; absorbed tools/check_metrics.py)
 - ``bench-ratchet``          BASELINE_RATCHET.json vocabulary + stale
   ``pending`` rows vs banked bench artifacts
+- ``param-dropped``          a config value read into a variable must
+  reach a sink on every path, interprocedurally
+  (tools/oryxlint/dataflow.py value-flow engine)
+- ``device-placement``       uncommitted device_put results flowing into
+  long-lived stores; mesh + shard_mesh at one train_als call site
+- ``lock-order``             inverted lock-acquisition pairs and
+  violations of the canonical order in tools/oryxlint/lockorder.toml
+- ``shard-topology``         half-wired shard-count surfaces (config
+  keys vs /healthz, ReplicaInfo, supervisor overlay, bench honesty)
 
 Run ``python -m tools.oryxlint`` (``--changed`` for a git-diff-scoped
-fast pass, ``--json`` for machine consumption). The whole-tree run is
-wired as a tier-1 test (tests/test_oryxlint.py); docs/development.md
-documents the rule catalog and annotation syntax.
+fast pass, ``--json`` for machine consumption — each finding carries
+stable rule/severity/fix_hint fields, ``--stats`` for the call-graph
+resolution rate). tools/precommit.sh wraps the --changed mode for
+pre-commit hooks. The whole-tree run is wired as a tier-1 test
+(tests/test_oryxlint.py); docs/development.md documents the rule
+catalog and annotation syntax.
 """
 
 from tools.oryxlint.core import Finding, Project, run_lint  # noqa: F401

@@ -57,10 +57,16 @@ def main(argv: list[str] | None = None) -> int:
     )
     ap.add_argument(
         "--json", action="store_true", dest="as_json",
-        help="machine-readable output: {findings, suppressed, rules}",
+        help="machine-readable output: {findings, suppressed, rules}; each "
+        "finding carries stable path/line/rule/severity/fix_hint/message "
+        "fields (consumed by tools/precommit.sh)",
     )
     ap.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    ap.add_argument(
+        "--stats", action="store_true",
+        help="print call-graph resolution-rate stats instead of linting",
     )
     args = ap.parse_args(argv)
 
@@ -69,9 +75,32 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{rid}: {desc}")
         return 0
 
+    if args.stats:
+        from tools.oryxlint.callgraph import ProjectIndex, body_calls
+        from tools.oryxlint.core import Project
+
+        project = Project.load(args.root)
+        idx = ProjectIndex(project)
+        for fi in idx.functions:
+            for call in body_calls(fi.node):
+                idx.resolve_call(fi, call)
+        s = idx.stats
+        rate = 100.0 * s["resolved"] / max(1, s["call_sites"])
+        print(
+            f"oryxlint --stats: resolved {s['resolved']}/{s['call_sites']} "
+            f"call sites ({rate:.1f}%), {s['lambda_sites']} lambda call "
+            f"site(s) (unresolved), {len(idx.functions)} functions, "
+            f"{len(idx.partial_aliases)} partial alias(es)"
+        )
+        return 0
+
     changed = _changed_files(args.root) if args.changed else None
     if changed is not None and not changed:
-        print("oryxlint --changed: no modified files; per-file rules skipped")
+        # stderr so --json stdout stays parseable for the pre-commit hook
+        print(
+            "oryxlint --changed: no modified files; per-file rules skipped",
+            file=sys.stderr,
+        )
     active, suppressed = run_lint(args.root, changed=changed)
 
     if args.as_json:

@@ -30,6 +30,9 @@ COMMON_METHOD_NAMES = frozenset({
     "split", "strip", "match", "search", "format", "count", "index",
     "sort", "reverse", "load", "save", "check", "render", "observe",
     "inc", "dec", "snapshot", "commit", "request", "connect", "shutdown",
+    # numpy/jax array reducers: `arr.sum()` must never resolve to a
+    # project method that happens to share the name (Histogram.sum)
+    "sum", "mean", "min", "max", "all", "any", "reshape", "astype",
 })
 
 
@@ -94,10 +97,25 @@ class ProjectIndex:
         self._dotted_to_rel = {
             _module_dotted(m.relpath): m.relpath for m in project.modules
         }
+        # (module relpath, local name) -> FunctionInfo for names bound by
+        # partial(...) wrapper assignments (incl. partial(partial(f, a), b)
+        # double-wrapping) — resolution follows the alias to the wrapped fn
+        self.partial_aliases: dict[tuple[str, str], FunctionInfo] = {}
+        # (module relpath, local name) -> how many positional args the
+        # partial chain pre-bound (dataflow offsets call-site positionals
+        # by this before mapping them to callee parameters)
+        self.partial_bound: dict[tuple[str, str], int] = {}
+        self._partial_conflicts: set[tuple[str, str]] = set()
+        # resolution-rate accounting, surfaced by `oryxlint --stats`:
+        # lambda call sites are counted separately because they are today
+        # silently unresolved (a lambda body is its own edge, not a def)
+        self.stats = {"call_sites": 0, "resolved": 0, "lambda_sites": 0}
         for mod in project.modules:
             self._index_module(mod)
         for ci in self.classes.values():
             self._infer_attr_types(ci)
+        for mod in project.modules:
+            self._index_partials(mod)
 
     # -- indexing ------------------------------------------------------------
 
@@ -198,6 +216,70 @@ class ProjectIndex:
                 ):
                     ci.lock_aliases[t.attr] = v.args[0].attr
 
+    def _unwrap_partial(
+        self, mod: SourceModule, expr: ast.AST
+    ) -> tuple[ast.Name, int] | None:
+        """(Name at the bottom of a ``partial(...)`` chain, number of
+        positional args the chain pre-binds): ``partial(f, a)`` and
+        ``partial(partial(f, a), b)`` both unwrap to ``f`` (binding 1
+        and 2 positionals). Returns None for anything that is not a
+        partial chain over a plain name. Pre-bound positionals apply
+        outermost-last, so the counts simply add."""
+        depth = 0
+        bound = 0
+        while isinstance(expr, ast.Call) and depth < 8:
+            d = self.dotted_name(mod, expr.func)
+            if d not in ("functools.partial", "partial") or not expr.args:
+                return None
+            bound += len(expr.args) - 1
+            inner = expr.args[0]
+            if isinstance(inner, ast.Name):
+                return inner, bound
+            expr = inner
+            depth += 1
+        return None
+
+    def _index_partials(self, mod: SourceModule) -> None:
+        """``g = partial(f, ...)`` wrapper assignments (anywhere in the
+        module, module level or function-local) alias ``g`` to ``f`` for
+        call resolution. Conflicts — ``g`` is already a def, or two
+        assignments wrap different functions — drop the alias instead of
+        guessing."""
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+            ):
+                continue
+            unwrapped = self._unwrap_partial(mod, node.value)
+            if unwrapped is None:
+                continue
+            inner, bound = unwrapped
+            tgt = self.top_level.get((mod.relpath, inner.id))
+            if tgt is None:
+                imp = self.imports.get(mod.relpath, {}).get(inner.id)
+                if imp is not None and imp[0] == "sym":
+                    rel = self._dotted_to_rel.get(imp[1])
+                    if rel is not None:
+                        tgt = self.top_level.get((rel, imp[2]))
+            if tgt is None:
+                continue
+            key = (mod.relpath, node.targets[0].id)
+            if key in self.top_level or key in self._partial_conflicts:
+                continue  # shadows a real def / known-conflicting name
+            if key in self.partial_aliases and (
+                self.partial_aliases[key] is not tgt
+                or self.partial_bound.get(key) != bound
+            ):
+                del self.partial_aliases[key]  # conflicting rebinds
+                self.partial_bound.pop(key, None)
+                self._partial_conflicts.add(key)
+                continue
+            self.partial_aliases[key] = tgt
+            self.partial_bound[key] = bound
+
     # -- resolution ------------------------------------------------------------
 
     def dotted_name(self, mod: SourceModule, expr: ast.AST) -> str | None:
@@ -240,6 +322,15 @@ class ProjectIndex:
                 t = self.classes[cls].attr_types.get(expr.attr)
                 if t is not None:
                     return t
+            # @property with a project-class return annotation: the
+            # receiver of `obj.prop.method()` resolves through the
+            # property's declared type
+            prop = self.method_on(base, expr.attr)
+            if prop is not None and _is_property(prop.node):
+                ret = getattr(prop.node, "returns", None)
+                t = _base_name(ret) if ret is not None else None
+                if t in self.classes and t not in self._ambiguous_classes:
+                    return t
             return None
         if isinstance(expr, ast.Call):
             # ClassName(...) or Class.shared()-style constructor
@@ -264,8 +355,30 @@ class ProjectIndex:
                 return fi
         return None
 
+    def call_positional_offset(self, mod: SourceModule, call: ast.Call) -> int:
+        """Positional-argument offset of a call site: calls through a
+        partial alias start binding at the first UNBOUND callee
+        parameter, not at position 0."""
+        if isinstance(call.func, ast.Name):
+            return self.partial_bound.get((mod.relpath, call.func.id), 0)
+        return 0
+
     def resolve_call(self, fi: FunctionInfo, call: ast.Call) -> list[FunctionInfo]:
-        """Confident candidate targets of a call made inside ``fi``."""
+        """Confident candidate targets of a call made inside ``fi``.
+        Updates the --stats resolution-rate counters as a side effect."""
+        self.stats["call_sites"] += 1
+        if isinstance(call.func, ast.Lambda):
+            # an immediately-invoked lambda: its body is its own edge,
+            # not a def — unresolved, but counted so --stats keeps the
+            # blind spot visible
+            self.stats["lambda_sites"] += 1
+            return []
+        out = self._resolve_call(fi, call)
+        if out:
+            self.stats["resolved"] += 1
+        return out
+
+    def _resolve_call(self, fi: FunctionInfo, call: ast.Call) -> list[FunctionInfo]:
         func = call.func
         mod = fi.module
         if isinstance(func, ast.Name):
@@ -296,6 +409,9 @@ class ProjectIndex:
                 if ci.module is mod:
                     init = ci.methods.get("__init__")
                     return [init] if init is not None else []
+            alias = self.partial_aliases.get((mod.relpath, func.id))
+            if alias is not None:
+                return [alias]
             return []
         if isinstance(func, ast.Attribute):
             # module.function via imports
@@ -323,6 +439,26 @@ class ProjectIndex:
                     return list(cands)
             return []
         return []
+
+
+def shared_index(project: Project) -> ProjectIndex:
+    """One ProjectIndex per loaded Project: six checkers asking the same
+    symbol questions must not re-index the whole tree six times (the
+    --changed pre-commit path pays index cost on every commit). The
+    index is read-only after construction apart from the --stats
+    counters, which are cumulative by design."""
+    idx = getattr(project, "_shared_index", None)
+    if idx is None:
+        idx = ProjectIndex(project)
+        project._shared_index = idx
+    return idx
+
+
+def _is_property(node) -> bool:
+    for dec in getattr(node, "decorator_list", []):
+        if isinstance(dec, ast.Name) and dec.id == "property":
+            return True
+    return False
 
 
 def _is_nonblocking_route(node) -> bool:

@@ -4,10 +4,18 @@ from tools.oryxlint.checkers.consistency import ConsistencyChecker
 from tools.oryxlint.checkers.eventloop import EventLoopChecker
 from tools.oryxlint.checkers.jaxpurity import JaxPurityChecker
 from tools.oryxlint.checkers.lockdiscipline import LockDisciplineChecker
+from tools.oryxlint.checkers.lockorder import LockOrderChecker
+from tools.oryxlint.checkers.paramflow import ParamFlowChecker
+from tools.oryxlint.checkers.placement import PlacementChecker
+from tools.oryxlint.checkers.shardtopology import ShardTopologyChecker
 
 ALL_CHECKERS = [
     EventLoopChecker,
     LockDisciplineChecker,
+    LockOrderChecker,
     JaxPurityChecker,
+    PlacementChecker,
+    ParamFlowChecker,
+    ShardTopologyChecker,
     ConsistencyChecker,
 ]

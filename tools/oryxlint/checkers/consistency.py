@@ -318,6 +318,22 @@ class ConsistencyChecker(Checker):
             "artifact that measures them"
         ),
     }
+    severities = {"metric-docs": "warning", "bench-ratchet": "warning"}
+    fix_hints = {
+        "config-keys": (
+            "declare the key in common/reference.conf (or read/remove the "
+            "dead robustness knob)"
+        ),
+        "metric-docs": (
+            "add/remove the row in docs/observability.md so code and docs "
+            "agree in both directions"
+        ),
+        "bench-ratchet": (
+            "update BASELINE_RATCHET.json: fix the metric name, add "
+            "pending_since, or lock the measured baseline and drop the "
+            "pending flag"
+        ),
+    }
 
     def check(self, project: Project) -> list[Finding]:
         root = project.root

@@ -30,7 +30,9 @@ from __future__ import annotations
 
 import ast
 
-from tools.oryxlint.callgraph import FunctionInfo, ProjectIndex, body_calls
+from tools.oryxlint.callgraph import (
+    FunctionInfo, ProjectIndex, body_calls, shared_index,
+)
 from tools.oryxlint.core import Checker, Finding, Project
 
 # fully-qualified callables that block the calling thread
@@ -96,9 +98,15 @@ class EventLoopChecker(Checker):
             "execution with an offloop annotation"
         ),
     }
+    fix_hints = {
+        "blocking-call-on-loop": (
+            "offload the call to a worker thread (and mark that function "
+            "`# oryxlint: offloop`), or drop nonblocking=True"
+        ),
+    }
 
     def check(self, project: Project) -> list[Finding]:
-        idx = ProjectIndex(project)
+        idx = shared_index(project)
         roots = [
             fi for fi in idx.functions
             if (fi.is_async or fi.nonblocking_route) and not fi.offloop

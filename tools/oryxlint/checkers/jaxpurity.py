@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import ast
 
-from tools.oryxlint.callgraph import ProjectIndex
+from tools.oryxlint.callgraph import ProjectIndex, shared_index
 from tools.oryxlint.core import Checker, Finding, Project, SourceModule
 
 MUTATOR_METHODS = frozenset({
@@ -83,9 +83,19 @@ class JaxPurityChecker(Checker):
             "after the donating call invalidated it"
         ),
     }
+    fix_hints = {
+        "jit-side-effect": (
+            "hoist the side effect out of the traced function (record "
+            "after the call, or thread values out as outputs)"
+        ),
+        "donation-reuse": (
+            "rebind the name from the donating call (the carry idiom) or "
+            "stop donating on this path"
+        ),
+    }
 
     def check(self, project: Project) -> list[Finding]:
-        idx = ProjectIndex(project)
+        idx = shared_index(project)
         findings: list[Finding] = []
         jitted, donated = self._discover(idx)
         for mod, fn in jitted:

@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import ast
 
-from tools.oryxlint.callgraph import ClassInfo, ProjectIndex
+from tools.oryxlint.callgraph import ClassInfo, shared_index
 from tools.oryxlint.core import Checker, Finding, Project
 
 
@@ -65,9 +65,15 @@ class LockDisciplineChecker(Checker):
             "`holds=` contract)"
         ),
     }
+    fix_hints = {
+        "guarded-by": (
+            "hold the declared lock around the access, or mark the whole "
+            "function `# oryxlint: holds=<lock>` if every caller does"
+        ),
+    }
 
     def check(self, project: Project) -> list[Finding]:
-        idx = ProjectIndex(project)
+        idx = shared_index(project)
         findings: list[Finding] = []
         for ci in idx.classes.values():
             guards = self._collect_guards(ci)

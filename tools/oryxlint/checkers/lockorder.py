@@ -1,0 +1,268 @@
+"""Lock-ordering checker (rule ``lock-order``).
+
+The multi-lock code paths (batcher dispatch vs. its shared class lock,
+supervisor op-lock around per-replica process state, front router lock
+vs. prober state) enforce their acquisition order only by convention —
+the deadlock shape is two functions taking the same two locks in
+opposite orders, which no single-function review can see.
+
+The checker builds the global lock-acquisition graph:
+
+- lock identities: instance attributes assigned ``threading.Lock()`` /
+  ``RLock()`` (named ``Class._attr``), module-level lock globals
+  (``module._NAME``), and ``threading.Condition`` aliases normalized to
+  their underlying lock;
+- edges: inside a ``with <lock>:`` block, every further lock acquired —
+  lexically nested ``with``, or transitively inside a confidently
+  resolved callee (callgraph resolution, bounded depth) — adds
+  ``held -> acquired``. ``oryxlint: holds=<lock>`` contracts seed the
+  held set for functions whose callers lock around them.
+
+Findings:
+
+- an **inverted pair**: edges ``A -> B`` and ``B -> A`` both observed
+  (the statically visible deadlock), reported with both sites;
+- a **canonical-order violation**: ``tools/oryxlint/lockorder.toml``
+  commits the project-wide acquisition order; an observed edge that
+  goes backwards against it fails even before the inverse edge lands —
+  the second half of the deadlock should never get written.
+
+Locks not named in lockorder.toml are only subject to the inversion
+check, so a new lock does not demand a toml entry until it participates
+in nesting.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from tools.oryxlint.callgraph import FunctionInfo, ProjectIndex, shared_index
+from tools.oryxlint.core import Checker, Finding, Project
+
+MAX_DEPTH = 6
+LOCK_CTORS = ("threading.Lock", "threading.RLock")
+ORDER_FILE = Path(__file__).resolve().parent.parent / "lockorder.toml"
+_ORDER_RE = re.compile(r'"([^"]+)"')
+
+
+def load_canonical_order(path: Path = ORDER_FILE) -> list[str]:
+    """The committed acquisition order: the ``order = [...]`` string list
+    of lockorder.toml (hand-parsed — the schema is one key, and the
+    container python predates tomllib)."""
+    if not path.exists():
+        return []
+    text = path.read_text(encoding="utf-8")
+    m = re.search(r"order\s*=\s*\[(.*?)\]", text, re.S)
+    if m is None:
+        return []
+    return _ORDER_RE.findall(m.group(1))
+
+
+class _Edge:
+    __slots__ = ("src", "dst", "path", "line", "via")
+
+    def __init__(self, src, dst, path, line, via):
+        self.src = src
+        self.dst = dst
+        self.path = path
+        self.line = line
+        self.via = via  # qualname chain for the report
+
+
+class LockOrderChecker(Checker):
+    name = "lockorder"
+    rules = {
+        "lock-order": (
+            "two locks are acquired in opposite orders somewhere in the "
+            "tree, or an acquisition edge violates the canonical order "
+            "committed in tools/oryxlint/lockorder.toml"
+        ),
+    }
+    severities = {"lock-order": "error"}
+    fix_hints = {
+        "lock-order": (
+            "acquire locks in the lockorder.toml order everywhere "
+            "(release and re-acquire if the code path needs the reverse)"
+        ),
+    }
+
+    def __init__(self, order_file: Path | None = None):
+        self.order_file = order_file or ORDER_FILE
+
+    def check(self, project: Project) -> list[Finding]:
+        idx = shared_index(project)
+        self._module_locks = self._collect_module_locks(idx)
+        self._class_locks = self._collect_class_locks(idx)
+        edges = self._collect_edges(idx)
+        return self._verdicts(edges)
+
+    # -- lock identity --------------------------------------------------------
+
+    def _collect_module_locks(self, idx: ProjectIndex) -> dict[tuple[str, str], str]:
+        """(relpath, global name) -> lock id for module-level lock
+        globals and class-level shared locks."""
+        out: dict[tuple[str, str], str] = {}
+        for mod in idx.project.modules:
+            stem = mod.relpath.rsplit("/", 1)[-1].removesuffix(".py")
+            for node in ast.walk(mod.tree):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                ):
+                    continue
+                d = idx.dotted_name(mod, node.value.func)
+                if d in LOCK_CTORS:
+                    name = node.targets[0].id
+                    out[(mod.relpath, name)] = f"{stem}.{name}"
+        return out
+
+    def _collect_class_locks(self, idx: ProjectIndex) -> dict[str, set[str]]:
+        """class key -> instance lock attr names (self.x = Lock()/RLock(),
+        plus class-level shared locks)."""
+        out: dict[str, set[str]] = {}
+        for key, ci in idx.classes.items():
+            attrs: set[str] = set()
+            for node in ast.walk(ci.node):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.value, ast.Call)
+                ):
+                    continue
+                d = idx.dotted_name(ci.module, node.value.func)
+                if d not in LOCK_CTORS:
+                    continue
+                t = node.targets[0]
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    attrs.add(t.attr)
+                elif isinstance(t, ast.Name):
+                    attrs.add(t.id)  # class-level shared lock
+            if attrs:
+                out[key] = attrs
+        return out
+
+    def _lock_id(self, idx: ProjectIndex, fi: FunctionInfo, expr: ast.AST) -> str | None:
+        """Lock identity of a `with <expr>:` context, or None."""
+        mod = fi.module
+        if isinstance(expr, ast.Name):
+            hit = self._module_locks.get((mod.relpath, expr.id))
+            if hit is not None:
+                return hit
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+            base, attr = expr.value.id, expr.attr
+            if base == "self" and fi.cls is not None:
+                for cls in idx._mro(fi.cls):
+                    ci = idx.classes[cls]
+                    attr_n = ci.lock_aliases.get(attr, attr)
+                    if attr_n in self._class_locks.get(cls, ()):  # normalized
+                        return f"{ci.name}.{attr_n}"
+            elif base in idx.classes and base not in idx._ambiguous_classes:
+                if attr in self._class_locks.get(base, ()):
+                    return f"{base}.{attr}"
+        return None
+
+    def _contract_ids(self, fi: FunctionInfo, idx: ProjectIndex) -> list[str]:
+        out = []
+        for lock in fi.holds:
+            if fi.cls is not None:
+                for cls in idx._mro(fi.cls):
+                    ci = idx.classes[cls]
+                    n = ci.lock_aliases.get(lock, lock)
+                    if n in self._class_locks.get(cls, ()):
+                        out.append(f"{ci.name}.{n}")
+                        break
+        return out
+
+    # -- edge collection ------------------------------------------------------
+
+    def _collect_edges(self, idx: ProjectIndex) -> list[_Edge]:
+        edges: list[_Edge] = []
+        for fi in idx.functions:
+            held = tuple(self._contract_ids(fi, idx))
+            self._walk_body(
+                idx, fi, list(fi.node.body), held, [fi.qualname], edges,
+                set(), 0,
+            )
+        return edges
+
+    def _walk_body(self, idx, fi, body, held, via, edges, visited, depth) -> None:
+        for node in body:
+            self._walk_node(idx, fi, node, held, via, edges, visited, depth)
+
+    def _walk_node(self, idx, fi, node, held, via, edges, visited, depth) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # a closure runs later, not under these locks
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            newly = list(held)
+            for item in node.items:
+                lid = self._lock_id(idx, fi, item.context_expr)
+                if lid is not None:
+                    for h in newly:
+                        if h != lid:
+                            edges.append(_Edge(
+                                h, lid, fi.module.relpath,
+                                item.context_expr.lineno, " -> ".join(via),
+                            ))
+                    newly = newly + [lid]
+            self._walk_body(
+                idx, fi, list(node.body), tuple(newly), via, edges, visited,
+                depth,
+            )
+            return
+        if isinstance(node, ast.Call) and held and depth < MAX_DEPTH:
+            for tgt in idx.resolve_call(fi, node):
+                key = (id(tgt), held)
+                if key in visited:
+                    continue
+                visited.add(key)
+                # the held set carries into the callee unchanged; its own
+                # holds= contract locks coincide with ours by definition
+                # (same-lock edges are filtered at the acquisition site)
+                self._walk_body(
+                    idx, tgt, list(tgt.node.body), held,
+                    via + [tgt.qualname], edges, visited, depth + 1,
+                )
+        for child in ast.iter_child_nodes(node):
+            self._walk_node(idx, fi, child, held, via, edges, visited, depth)
+
+    # -- verdicts -------------------------------------------------------------
+
+    def _verdicts(self, edges: list[_Edge]) -> list[Finding]:
+        findings: list[Finding] = []
+        by_pair: dict[tuple[str, str], _Edge] = {}
+        for e in edges:
+            by_pair.setdefault((e.src, e.dst), e)
+        reported: set[frozenset] = set()
+        for (a, b), e in sorted(by_pair.items()):
+            inv = by_pair.get((b, a))
+            if inv is not None and frozenset((a, b)) not in reported:
+                reported.add(frozenset((a, b)))
+                findings.append(Finding(
+                    e.path, e.line, "lock-order",
+                    f"inverted lock pair: {a} -> {b} here (via {e.via}) "
+                    f"but {b} -> {a} at {inv.path}:{inv.line} (via "
+                    f"{inv.via}) — two threads on these paths deadlock",
+                ))
+        order = load_canonical_order(self.order_file)
+        rank = {name: i for i, name in enumerate(order)}
+        for (a, b), e in sorted(by_pair.items()):
+            if a in rank and b in rank and rank[a] > rank[b] and (
+                frozenset((a, b)) not in reported
+            ):
+                reported.add(frozenset((a, b)))
+                findings.append(Finding(
+                    e.path, e.line, "lock-order",
+                    f"acquisition {a} -> {b} (via {e.via}) violates the "
+                    f"canonical order in tools/oryxlint/lockorder.toml "
+                    f"({b} before {a}) — this is half of a deadlock; "
+                    "reorder, or update the canonical order everywhere",
+                ))
+        return findings

@@ -1,0 +1,263 @@
+"""Device-placement checker (rule ``device-placement``).
+
+Two placement invariants PR 11's review pass enforced by hand, now
+machine-checked:
+
+1. **Committed placement for long-lived stores.** A bare
+   ``jax.device_put(x)`` — no explicit device/sharding argument — leaves
+   the buffer *uncommitted*: it follows the current default device, and
+   the first computation touching it silently migrates the whole array
+   to device 0 (``jax.default_device`` context blocks do NOT commit).
+   For a transient temp that is at worst a perf wobble; for a value
+   stored into long-lived serving state (an attribute on a serving
+   view, a ``ShardedMatrix`` field) it re-creates the multi-chip OOM
+   sharding exists to prevent. The rule: an uncommitted ``device_put``
+   result must not flow — through local assignments or confidently
+   resolved helper returns — into an attribute store or a
+   ``ShardedMatrix(...)`` construction.
+
+2. **mesh / shard_mesh exclusivity at train call sites.** ``train_als``
+   raises loudly at runtime when both are passed (the PR 11 hardening);
+   the rule catches it before runtime at any ``train_als``-family call
+   site where both keywords are *definitely constructed* values (a
+   ``Call`` expression, or a local assigned from one). Wrapper
+   forwarding — both values are bare parameters of the enclosing
+   function, exclusivity being the outer caller's obligation — is
+   exempt and checked at the outer site instead.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.oryxlint.callgraph import FunctionInfo, ProjectIndex, shared_index
+from tools.oryxlint.core import Checker, Finding, Project
+
+LONG_LIVED_CTORS = frozenset({"ShardedMatrix"})
+TRAIN_FAMILY_PREFIX = "train_als"
+
+
+def _is_put_name(dotted: str | None, bare: str | None) -> bool:
+    if dotted is not None and (
+        dotted == "jax.device_put" or dotted.endswith(".device_put")
+    ):
+        return True
+    return bare == "device_put"
+
+
+def _committed(call: ast.Call) -> bool:
+    """An explicit placement argument commits the buffers."""
+    if len(call.args) >= 2:
+        return True
+    return any(kw.arg in ("device", "sharding") for kw in call.keywords)
+
+
+class PlacementChecker(Checker):
+    name = "placement"
+    rules = {
+        "device-placement": (
+            "an uncommitted device_put result (no explicit device/"
+            "sharding arg) flows into long-lived state, or mesh and "
+            "shard_mesh both reach the same train_als-family call site"
+        ),
+    }
+    severities = {"device-placement": "error"}
+    fix_hints = {
+        "device-placement": (
+            "pass the device/sharding explicitly to device_put (a "
+            "default_device context does not commit), or drop one of "
+            "mesh/shard_mesh at the train call"
+        ),
+    }
+
+    def check(self, project: Project) -> list[Finding]:
+        idx = shared_index(project)
+        findings: list[Finding] = []
+        returns_uncommitted = self._summarize_returns(idx)
+        for fi in idx.functions:
+            self._check_stores(idx, fi, returns_uncommitted, findings)
+            self._check_train_calls(idx, fi, findings)
+        return findings
+
+    # -- rule 1: uncommitted puts into long-lived stores ----------------------
+
+    def _uncommitted_call(
+        self, idx: ProjectIndex, fi: FunctionInfo, node: ast.AST,
+        returns_uncommitted: set[int],
+    ) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        dotted = idx.dotted_name(fi.module, node.func)
+        bare = node.func.id if isinstance(node.func, ast.Name) else None
+        if _is_put_name(dotted, bare):
+            return not _committed(node)
+        # a confidently-resolved helper that returns an uncommitted put
+        for tgt in idx.resolve_call(fi, node):
+            if id(tgt) in returns_uncommitted:
+                return True
+        return False
+
+    def _summarize_returns(self, idx: ProjectIndex) -> set[int]:
+        """ids of FunctionInfos that return an uncommitted device_put
+        result (directly, or via a local). One fixed-point round over
+        direct returns, then one propagation round through call chains."""
+        out: set[int] = set()
+        for _ in range(3):  # direct + two levels of helper chaining
+            changed = False
+            for fi in idx.functions:
+                if id(fi) in out:
+                    continue
+                tainted = self._local_tainted(idx, fi, out)
+                for node in ast.walk(fi.node):
+                    if isinstance(node, ast.Return) and node.value is not None:
+                        v = node.value
+                        if self._uncommitted_call(idx, fi, v, out) or (
+                            isinstance(v, ast.Name) and v.id in tainted
+                        ):
+                            out.add(id(fi))
+                            changed = True
+                            break
+            if not changed:
+                break
+        return out
+
+    def _local_tainted(
+        self, idx: ProjectIndex, fi: FunctionInfo, returns_uncommitted: set[int]
+    ) -> set[str]:
+        """Local names bound to uncommitted put results (fixed-point over
+        plain Name-to-Name copies)."""
+        tainted: set[str] = set()
+        for _ in range(3):
+            changed = False
+            for node in ast.walk(fi.node):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                ):
+                    continue
+                name = node.targets[0].id
+                if name in tainted:
+                    continue
+                v = node.value
+                if self._uncommitted_call(idx, fi, v, returns_uncommitted) or (
+                    isinstance(v, ast.Name) and v.id in tainted
+                ):
+                    tainted.add(name)
+                    changed = True
+            if not changed:
+                break
+        return tainted
+
+    def _check_stores(
+        self, idx: ProjectIndex, fi: FunctionInfo,
+        returns_uncommitted: set[int], findings: list[Finding],
+    ) -> None:
+        mod = fi.module
+        tainted = self._local_tainted(idx, fi, returns_uncommitted)
+
+        def is_uncommitted_value(v: ast.AST) -> bool:
+            return self._uncommitted_call(idx, fi, v, returns_uncommitted) or (
+                isinstance(v, ast.Name) and v.id in tainted
+            )
+
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and is_uncommitted_value(
+                        node.value
+                    ):
+                        findings.append(Finding(
+                            mod.relpath, node.lineno, "device-placement",
+                            "uncommitted device_put result stored into "
+                            f"long-lived attribute {ast.unparse(t)}: without "
+                            "an explicit device/sharding argument the first "
+                            "computation silently migrates the buffer to the "
+                            "default device (a default_device context does "
+                            "not commit)",
+                        ))
+            elif isinstance(node, ast.Call):
+                fname = node.func.id if isinstance(node.func, ast.Name) else (
+                    node.func.attr if isinstance(node.func, ast.Attribute)
+                    else None
+                )
+                if fname in LONG_LIVED_CTORS:
+                    for a in node.args:
+                        vals = a.elts if isinstance(a, (ast.List, ast.Tuple)) \
+                            else [a]
+                        for v in vals:
+                            if is_uncommitted_value(v):
+                                findings.append(Finding(
+                                    mod.relpath, node.lineno,
+                                    "device-placement",
+                                    "uncommitted device_put result becomes "
+                                    f"a {fname} shard: every shard must be "
+                                    "committed to its own device or the "
+                                    "whole matrix migrates to device 0 on "
+                                    "first use",
+                                ))
+
+    # -- rule 2: mesh + shard_mesh at the same train call ---------------------
+
+    def _check_train_calls(
+        self, idx: ProjectIndex, fi: FunctionInfo, findings: list[Finding]
+    ) -> None:
+        mod = fi.module
+        params = {
+            a.arg for a in (
+                list(fi.node.args.args) + list(fi.node.args.kwonlyargs)
+                + list(fi.node.args.posonlyargs)
+            )
+        }
+        # local names assigned from constructed (Call) values
+        constructed: set[str] = set()
+        for node in ast.walk(fi.node):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+            ):
+                constructed.add(node.targets[0].id)
+
+        def definitely_set(v: ast.AST) -> bool:
+            if isinstance(v, ast.Constant):  # None / literals
+                return False
+            if isinstance(v, ast.IfExp) and (
+                (isinstance(v.body, ast.Constant) and v.body.value is None)
+                or (
+                    isinstance(v.orelse, ast.Constant)
+                    and v.orelse.value is None
+                )
+            ):
+                # the conditional-exclusivity idiom:
+                # `mesh=None if shard_mesh is not None else build()`
+                return False
+            if isinstance(v, ast.Call):
+                return True
+            if isinstance(v, ast.Name):
+                # bare parameter forwarding: the wrapper inherits the
+                # exclusivity obligation; checked at the outer site
+                return v.id in constructed and v.id not in params
+            return True  # attribute reads etc.: assume live
+
+        for call in ast.walk(fi.node):
+            if not isinstance(call, ast.Call):
+                continue
+            fname = call.func.id if isinstance(call.func, ast.Name) else (
+                call.func.attr if isinstance(call.func, ast.Attribute)
+                else None
+            )
+            if fname is None or not fname.startswith(TRAIN_FAMILY_PREFIX):
+                continue
+            kw = {k.arg: k.value for k in call.keywords if k.arg}
+            if "mesh" in kw and "shard_mesh" in kw and (
+                definitely_set(kw["mesh"]) and definitely_set(kw["shard_mesh"])
+            ):
+                findings.append(Finding(
+                    mod.relpath, call.lineno, "device-placement",
+                    f"mesh and shard_mesh both reach {fname}() here: the "
+                    "layouts are mutually exclusive (train_als raises at "
+                    "runtime; pick the tensor-parallel mesh OR the "
+                    "row-sharded model mesh)",
+                ))

@@ -28,6 +28,10 @@ Annotation grammar (trailing comments, parsed per line):
   consumed like a ``donate_argnums`` buffer; ``donates=<pos> when
   <kwarg>`` restricts it to call sites passing that keyword as a
   literal ``True`` (the conditional-donation wrapper idiom).
+- terminal read: ``oryxlint: sink`` on a use (or read) line — the
+  dataflow ``param-dropped`` rule treats the annotated use as an
+  intentional terminal consumption of the value, even though it is
+  neither a call argument, an attribute store, nor a returned value.
 """
 
 from __future__ import annotations
@@ -46,16 +50,25 @@ ANN_GUARDED = re.compile(
 ANN_DONATES = re.compile(
     r"#\s*oryxlint:\s*donates=(\d+)(?:\s+when\s+([A-Za-z_][A-Za-z0-9_]*))?"
 )
+ANN_SINK = re.compile(r"#\s*oryxlint:\s*sink\b")
 
 
 @dataclass
 class Finding:
-    """One rule violation at a source location."""
+    """One rule violation at a source location.
+
+    ``severity`` and ``fix_hint`` are rule-level metadata attached by
+    ``run_lint`` from the checker catalogs — stable fields of the
+    ``--json`` schema (consumed by tools/precommit.sh for grouped
+    display). The tier-1 gate fails on any active finding regardless of
+    severity; the field is display/triage metadata, not policy."""
 
     path: str  # repo-relative
     line: int
     rule: str
     message: str
+    severity: str = "error"
+    fix_hint: str = ""
 
     def render(self) -> str:
         return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
@@ -65,6 +78,8 @@ class Finding:
             "path": self.path,
             "line": self.line,
             "rule": self.rule,
+            "severity": self.severity,
+            "fix_hint": self.fix_hint,
             "message": self.message,
         }
 
@@ -87,6 +102,8 @@ class SourceModule:
         self.guarded_lines: dict[int, tuple[tuple[str, ...], bool]] = {}
         # def lines annotated donates=<pos> [when <kwarg>]
         self.donates_lines: dict[int, tuple[int, str | None]] = {}
+        # lines annotated `oryxlint: sink` (intentional terminal reads)
+        self.sink_lines: set[int] = set()
         for i, ln in enumerate(self.lines, start=1):
             if "#" not in ln:
                 continue
@@ -111,6 +128,8 @@ class SourceModule:
             m = ANN_DONATES.search(ln)
             if m:
                 self.donates_lines[i] = (int(m.group(1)), m.group(2))
+            if ANN_SINK.search(ln):
+                self.sink_lines.add(i)
 
     def decorated_span(self, node) -> range:
         """Line range covering a def and its decorators (annotations on
@@ -183,10 +202,15 @@ class Project:
 class Checker:
     """Checker SPI: subclasses declare their rule catalog and visit the
     project. ``rules`` maps rule id -> one-line description (surfaced by
-    ``--list-rules`` and validated against suppression comments)."""
+    ``--list-rules`` and validated against suppression comments).
+    ``severities`` (rule id -> "error"|"warning", default "error") and
+    ``fix_hints`` (rule id -> one-line remediation) feed the stable
+    per-finding ``severity``/``fix_hint`` fields of the --json schema."""
 
     name = "checker"
     rules: dict[str, str] = {}
+    severities: dict[str, str] = {}
+    fix_hints: dict[str, str] = {}
 
     def check(self, project: Project) -> list[Finding]:  # pragma: no cover
         raise NotImplementedError
@@ -249,10 +273,25 @@ def run_lint(
     project = Project.load(root)
     cs = checkers if checkers is not None else _all_checkers()
     rules = known_rules(cs)
+    severities = {"unknown-rule": "error"}
+    fix_hints = {
+        "unknown-rule": "fix the rule id in the disable comment "
+        "(see --list-rules)",
+    }
+    for c in cs:
+        severities.update(c.severities)
+        fix_hints.update(c.fix_hints)
     raw: list[Finding] = []
     for c in cs:
         raw.extend(c.check(project))
     raw.extend(_unknown_rule_findings(project, rules))
+    for f in raw:
+        # rule-catalog metadata fills defaults only: a checker that set a
+        # per-finding severity/fix_hint keeps it
+        if f.severity == "error":
+            f.severity = severities.get(f.rule, "error")
+        if not f.fix_hint:
+            f.fix_hint = fix_hints.get(f.rule, "")
     mods = {m.relpath: m for m in project.modules}
     active: list[Finding] = []
     suppressed: list[Finding] = []

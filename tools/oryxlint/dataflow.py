@@ -1,0 +1,477 @@
+"""Interprocedural forward value-flow engine (shared by the dataflow
+checkers: ``param-dropped``, ``device-placement``).
+
+The engine answers one question: *does a tracked value reach a sink on
+every path of its function — and of every callee it is handed to?*
+A sink is a consumption the value cannot silently vanish past:
+
+- a call argument (if the call resolves confidently to a project
+  function and the value is a direct ``Name`` argument, the engine
+  recurses into the callee's parameter instead of trusting the call —
+  the PR 11 ``shard_mesh``-on-resume bug was exactly a wrapper that
+  accepted the parameter and then dropped it on one path);
+- a store into an attribute or subscript (long-lived state);
+- a ``return``/``yield`` carrying the value;
+- use in a branch/loop condition or ``assert`` (the value decided
+  control flow — that is consumption, not a drop);
+- a ``with`` context expression;
+- a line annotated ``# oryxlint: sink`` (intentional terminal read).
+
+Path sensitivity is bounded by outcome merging: a statement sequence
+produces at most four outcome kinds (fall-through consumed/live,
+return consumed/live) plus raise, so branching never explodes.
+``raise`` paths are exempt — error paths do not have to thread config.
+A ``return`` on a path where the value is still live, while a sibling
+path consumes it, is the flagged shape. Values that are *never*
+consumed anywhere are flagged at their definition site.
+
+Taint propagates through plain assignments (``y = x`` tracks ``y``;
+``y = f(x)`` is a call-arg sink), augmented assignment, and
+``partial(...)`` rebinds (callgraph's partial aliases make the wrapped
+callee resolvable, so ``g = partial(train, mesh)``, ``g(...)`` still
+reaches the real parameter). Rebinding a tracked name from an untainted
+expression kills its taint.
+
+Per-function parameter summaries (``param_sunk``) are cached, so caller
+chains cost one analysis per (function, parameter) per lint run.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from tools.oryxlint.callgraph import FunctionInfo, ProjectIndex
+
+MAX_CALL_DEPTH = 8
+
+# outcome kinds for one path bundle through a statement sequence
+FALL = "fall"
+RET = "return"
+RAISE = "raise"
+
+
+@dataclass(frozen=True)
+class Outcome:
+    kind: str  # FALL | RET | RAISE
+    consumed: bool
+    line: int  # for RET-live: the return's line (the drop site)
+
+
+@dataclass
+class Drop:
+    """One path on which a tracked value fails to reach a sink."""
+
+    line: int
+    reason: str
+
+
+class Dataflow:
+    def __init__(self, idx: ProjectIndex):
+        self.idx = idx
+        # (id(FunctionInfo), param) -> (sunk_on_every_path, drop_line|None)
+        self._summaries: dict[tuple[int, str], tuple[bool, int | None]] = {}
+        self._in_progress: set[tuple[int, str]] = set()
+
+    # -- public API -----------------------------------------------------------
+
+    def drops(
+        self, fi: FunctionInfo, name: str, start_line: int
+    ) -> list[Drop]:
+        """Paths on which ``name`` (tainted from the first assignment at
+        ``start_line``) fails to reach a sink inside ``fi`` or any
+        confidently-resolved callee it is handed to."""
+        state = _State(self, fi, {name}, activate_line=start_line)
+        outcomes = state.run(list(fi.node.body))
+        return self._judge(state, outcomes, name, start_line)
+
+    def param_sunk(self, fi: FunctionInfo, param: str) -> tuple[bool, int | None]:
+        """Does parameter ``param`` of ``fi`` reach a sink on every path?
+        Returns (ok, representative drop line when not ok). Optimistic on
+        recursion cycles (an in-progress summary reads as sunk)."""
+        key = (id(fi), param)
+        if key in self._summaries:
+            return self._summaries[key]
+        if key in self._in_progress:
+            return (True, None)
+        self._in_progress.add(key)
+        try:
+            state = _State(self, fi, {param}, activate_line=0)
+            outcomes = state.run(list(fi.node.body))
+            drops = self._judge(state, outcomes, param, fi.node.lineno)
+            result = (not drops, drops[0].line if drops else None)
+        finally:
+            self._in_progress.discard(key)
+        self._summaries[key] = result
+        return result
+
+    # -- verdicts -------------------------------------------------------------
+
+    def _judge(
+        self, state: "_State", outcomes: set[Outcome], name: str, def_line: int
+    ) -> list[Drop]:
+        drops = list(state.drops)
+        consumed_somewhere = state.ever_consumed or any(
+            o.consumed for o in outcomes
+        )
+        if not consumed_somewhere:
+            drops.append(Drop(
+                def_line,
+                f"{name!r} never reaches a sink (no call argument, "
+                "attribute store, or return uses it)",
+            ))
+            return drops
+        for o in outcomes:
+            if o.kind == RET and not o.consumed:
+                drops.append(Drop(
+                    o.line,
+                    f"{name!r} is dropped on the path returning here "
+                    "while another path sinks it",
+                ))
+        return drops
+
+
+class _State:
+    """One tracked-value analysis over one function body."""
+
+    def __init__(
+        self,
+        flow: Dataflow,
+        fi: FunctionInfo,
+        names: set[str],
+        activate_line: int,
+    ):
+        self.flow = flow
+        self.idx = flow.idx
+        self.fi = fi
+        self.mod = fi.module
+        self.seed_names = set(names)
+        # taint is active immediately for parameters (activate_line == 0);
+        # for a config-read assignment it switches on at that statement
+        self.activate_line = activate_line
+        self.active = activate_line == 0
+        self.tainted: set[str] = set(names) if self.active else set()
+        self.ever_consumed = False
+        self.drops: list[Drop] = []
+        self.depth = 0
+
+    # -- sequence walk --------------------------------------------------------
+
+    def run(self, stmts: list[ast.stmt]) -> set[Outcome]:
+        """Outcome kinds of every path through ``stmts``, starting from a
+        single live fall-through path."""
+        return self._seq(stmts, consumed=False)
+
+    def _seq(self, stmts: list[ast.stmt], consumed: bool) -> set[Outcome]:
+        out: set[Outcome] = set()
+        for i, stmt in enumerate(stmts):
+            res = self._stmt(stmt, consumed)
+            fall = [o for o in res if o.kind == FALL]
+            out.update(o for o in res if o.kind != FALL)
+            if not fall:
+                return out  # no path falls through to the next statement
+            consumed = all(o.consumed for o in fall)
+        out.add(Outcome(FALL, consumed, stmts[-1].lineno if stmts else 0))
+        return out
+
+    def _stmt(self, stmt: ast.stmt, consumed: bool) -> set[Outcome]:
+        ln = stmt.lineno
+        if not self.active and ln >= self.activate_line:
+            # the config-read assignment itself switches tracking on
+            if isinstance(stmt, ast.Assign) and ln == self.activate_line:
+                self.active = True
+                self.tainted = set(self.seed_names)
+                return {Outcome(FALL, consumed, ln)}
+        if not self.active:
+            # recurse into compound statements so a read nested inside a
+            # branch still activates
+            for body in _sub_bodies(stmt):
+                res = self._seq(body, consumed)
+                if self.active:
+                    # re-run the statement properly now that taint is on?
+                    # not needed: activation happens AT the assignment, and
+                    # everything before it is untainted by definition
+                    return res
+            return {Outcome(FALL, consumed, ln)}
+
+        if isinstance(stmt, ast.Return):
+            c = consumed or (
+                stmt.value is not None and self._consumes(stmt.value, ln)
+            )
+            return {Outcome(RET, c, ln)}
+        if isinstance(stmt, ast.Raise):
+            return {Outcome(RAISE, True, ln)}
+        if isinstance(stmt, (ast.If,)):
+            if self._consumes(stmt.test, ln):
+                consumed = True
+            b = self._seq(list(stmt.body), consumed)
+            o = self._seq(list(stmt.orelse), consumed)
+            return b | o
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            if isinstance(stmt, ast.While):
+                if self._consumes(stmt.test, ln):
+                    consumed = True
+            else:
+                if self._consumes(stmt.iter, ln):
+                    consumed = True
+                self._kill_target(stmt.target)
+            body = self._seq(list(stmt.body), consumed)
+            # a loop body may run zero times: merge body fall-throughs
+            # with the skip path, but treat in-body consumption as real —
+            # `for chunk in chunks: train(chunk, mesh)` is the idiom, and
+            # an empty work list is not a config drop
+            out = {o for o in body if o.kind != FALL}
+            body_consumed = any(o.consumed for o in body) or consumed
+            out.add(Outcome(FALL, body_consumed, ln))
+            if stmt.orelse:
+                out |= self._seq(list(stmt.orelse), body_consumed)
+            return out
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if self._consumes(item.context_expr, ln):
+                    consumed = True
+                if item.optional_vars is not None:
+                    self._kill_target(item.optional_vars)
+            return self._seq(list(stmt.body), consumed)
+        if isinstance(stmt, ast.Try):
+            body = self._seq(list(stmt.body), consumed)
+            out = {o for o in body if o.kind != FALL}
+            fell = [o for o in body if o.kind == FALL]
+            c = consumed or (bool(fell) and all(o.consumed for o in fell))
+            # handlers: error paths are exempt from the every-path rule,
+            # but consumption inside them still counts as consumption
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._scan_consume(s)
+            if stmt.orelse:
+                for o in self._seq(list(stmt.orelse), c):
+                    if o.kind == FALL:
+                        c = o.consumed
+                    else:
+                        out.add(o)
+            if stmt.finalbody:
+                for o in self._seq(list(stmt.finalbody), c):
+                    if o.kind == FALL:
+                        c = o.consumed
+                    else:
+                        out.add(o)
+            out.add(Outcome(FALL, c, ln))
+            return out
+        if isinstance(stmt, ast.Assign):
+            c = consumed or self._assign(stmt, ln)
+            return {Outcome(FALL, c, ln)}
+        if isinstance(stmt, ast.AugAssign):
+            c = consumed
+            if self._consumes(stmt.value, ln):
+                tgt = stmt.target
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                    self._sink()
+                    c = True
+                elif isinstance(tgt, ast.Name):
+                    self.tainted.add(tgt.id)
+            return {Outcome(FALL, c, ln)}
+        if isinstance(stmt, ast.AnnAssign):
+            c = consumed
+            if stmt.value is not None and self._consumes(stmt.value, ln):
+                if isinstance(stmt.target, (ast.Attribute, ast.Subscript)):
+                    self._sink()
+                    c = True
+                elif isinstance(stmt.target, ast.Name):
+                    self.tainted.add(stmt.target.id)
+            elif isinstance(stmt.target, ast.Name):
+                self.tainted.discard(stmt.target.id)
+            return {Outcome(FALL, c, ln)}
+        if isinstance(stmt, (ast.Assert,)):
+            c = consumed or self._consumes(stmt.test, ln)
+            return {Outcome(FALL, c, ln)}
+        if isinstance(stmt, ast.Expr):
+            c = consumed or self._consumes(stmt.value, ln)
+            return {Outcome(FALL, c, ln)}
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def capturing the value counts as consumption (the
+            # closure carries it onward); its body is not this flow
+            if any(
+                isinstance(n, ast.Name) and n.id in self.tainted
+                for n in ast.walk(stmt)
+            ):
+                self._sink()
+                consumed = True
+            return {Outcome(FALL, consumed, ln)}
+        # anything else (Delete, Global, Match, ...): conservative scan
+        c = consumed or self._scan_consume(stmt)
+        return {Outcome(FALL, c, ln)}
+
+    # -- assignments / taint --------------------------------------------------
+
+    def _assign(self, stmt: ast.Assign, ln: int) -> bool:
+        value_tainted = _mentions(stmt.value, self.tainted)
+        consumed = False
+        if value_tainted:
+            # calls inside the value are sinks in their own right
+            consumed = self._consumes(stmt.value, ln, propagating=True)
+        for tgt in stmt.targets:
+            if isinstance(tgt, ast.Name):
+                if value_tainted:
+                    self.tainted.add(tgt.id)
+                else:
+                    self.tainted.discard(tgt.id)
+            elif isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                if value_tainted:
+                    self._sink()
+                    consumed = True
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                for el in tgt.elts:
+                    self._kill_target(el)
+        return consumed
+
+    def _kill_target(self, tgt: ast.AST) -> None:
+        for n in ast.walk(tgt):
+            if isinstance(n, ast.Name):
+                self.tainted.discard(n.id)
+
+    # -- consumption ----------------------------------------------------------
+
+    def _sink(self) -> None:
+        self.ever_consumed = True
+
+    def _consumes(self, expr: ast.AST, ln: int, propagating: bool = False) -> bool:
+        """Does evaluating ``expr`` consume a tainted value? Sink events
+        are recorded; interprocedural call arguments recurse into the
+        callee's parameter summary."""
+        if not _mentions(expr, self.tainted):
+            return False
+        if ln in self.mod.sink_lines:
+            self._sink()
+            return True
+        consumed = False
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) and _call_mentions(node, self.tainted):
+                if self._call_sinks(node):
+                    consumed = True
+        if consumed:
+            return True
+        if propagating:
+            # a tainted value flowing into a plain assignment is taint
+            # propagation, not consumption
+            return False
+        # non-call direct use (condition, return expression, with item):
+        # the value decided control flow or left the function — consumed
+        self._sink()
+        return True
+
+    def _call_sinks(self, call: ast.Call) -> bool:
+        """A tainted argument reaching a call. Resolvable project callee
+        + direct Name argument -> recurse into the parameter summary;
+        anything else is a conservative sink."""
+        targets = self.idx.resolve_call(self.fi, call) if (
+            self.depth < MAX_CALL_DEPTH
+        ) else []
+        if len(targets) != 1:
+            self._sink()
+            return True
+        tgt = targets[0]
+        params, all_params = _param_names(tgt)
+        offset = self.idx.call_positional_offset(self.mod, call)
+        sunk_any = False
+        for name, param in _direct_args(
+            call, self.tainted, params, all_params, offset
+        ):
+            self.depth += 1
+            try:
+                ok, drop_line = self.flow.param_sunk(tgt, param)
+            finally:
+                self.depth -= 1
+            self._sink()
+            sunk_any = True
+            if not ok:
+                where = f"{tgt.module.relpath}:{drop_line or tgt.node.lineno}"
+                self.drops.append(Drop(
+                    call.lineno,
+                    f"{name!r} is passed to {tgt.qualname}() whose "
+                    f"parameter {param!r} does not reach a sink on every "
+                    f"path ({where})",
+                ))
+        if sunk_any:
+            return True
+        # tainted but not as a direct parameter (an expression argument,
+        # *args, a kwarg the callee absorbs into **kwargs): conservative
+        self._sink()
+        return True
+
+    def _scan_consume(self, node: ast.AST) -> bool:
+        if _mentions(node, self.tainted):
+            self._sink()
+            return True
+        return False
+
+
+# -- small AST helpers --------------------------------------------------------
+
+
+def _mentions(node: ast.AST, names: set[str]) -> bool:
+    if not names:
+        return False
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in names and isinstance(
+            n.ctx, ast.Load
+        ):
+            return True
+    return False
+
+
+def _call_mentions(call: ast.Call, names: set[str]) -> bool:
+    for a in list(call.args) + [kw.value for kw in call.keywords]:
+        if _mentions(a, names):
+            return True
+    return False
+
+
+def _param_names(fi: FunctionInfo) -> tuple[list[str], set[str]]:
+    """(positional parameter names in order, all bindable names incl.
+    keyword-only). ``self``/``cls`` are stripped for methods."""
+    args = fi.node.args
+    names = [a.arg for a in args.posonlyargs] + [a.arg for a in args.args]
+    if fi.cls is not None and names and names[0] in ("self", "cls"):
+        names = names[1:]
+    all_names = set(names) | {a.arg for a in args.kwonlyargs}
+    return names, all_names
+
+
+def _direct_args(
+    call: ast.Call,
+    tainted: set[str],
+    params: list[str],
+    all_params: set[str],
+    offset: int = 0,
+) -> list[tuple[str, str]]:
+    """(tainted name, callee parameter) pairs for direct Name arguments
+    whose parameter binding is unambiguous. ``offset`` shifts positional
+    binding for calls through partial aliases (the partial pre-bound the
+    first ``offset`` positionals). A kwarg the callee has no named
+    parameter for (absorbed into **kwargs) is NOT a direct binding — the
+    caller-side conservative sink covers it."""
+    out: list[tuple[str, str]] = []
+    for i, a in enumerate(call.args):
+        j = i + offset
+        if isinstance(a, ast.Name) and a.id in tainted and j < len(params):
+            out.append((a.id, params[j]))
+    for kw in call.keywords:
+        if (
+            kw.arg is not None
+            and kw.arg in all_params
+            and isinstance(kw.value, ast.Name)
+            and kw.value.id in tainted
+        ):
+            out.append((kw.value.id, kw.arg))
+    return out
+
+
+def _sub_bodies(stmt: ast.stmt) -> list[list[ast.stmt]]:
+    out = []
+    for field in ("body", "orelse", "finalbody"):
+        b = getattr(stmt, field, None)
+        if isinstance(b, list) and b and isinstance(b[0], ast.stmt):
+            out.append(b)
+    for h in getattr(stmt, "handlers", []):
+        out.append(list(h.body))
+    return out
