@@ -88,6 +88,80 @@ def _enable_compile_cache() -> None:
 
 
 # --------------------------------------------------------------------------
+# stage flight recording — the black box of every killable stage
+# --------------------------------------------------------------------------
+#
+# Round-5 post-mortem: `error: _bench_http_body (accel) failed;
+# _bench_train_body (accel) timeout` is the WHOLE diagnostic record of a
+# TPU window that never completed — nothing says which phase wedged. Each
+# stage body now configures an on-disk flight ring (common/flightrec.py)
+# at a dir the SUITE DRIVER chooses (ORYX_BENCH_FLIGHT_DIR), drops
+# bench-stage phase markers as it goes, and on an in-process failure
+# bundles a snapshot whose path rides the stage's parseable error row.
+# A SIGKILLed stage can't write its own last words, so the driver
+# harvests the surviving ring from the parent side instead — either way
+# the next TPU window's artifact names the dying phase.
+
+
+def _stage_flight_dir(body: str) -> str:
+    return os.path.join(tempfile.gettempdir(), "oryx-bench-flight", body)
+
+
+def _flight_stage(stage: str):
+    """Configure this stage subprocess's flight ring and mark the start.
+    Returns the recorder (never raises — a broken black box must not
+    break the measurement it records)."""
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.common.flightrec import configure_flightrec
+
+    flight_dir = os.environ.get("ORYX_BENCH_FLIGHT_DIR") or _stage_flight_dir(
+        stage
+    )
+    rec = configure_flightrec(
+        load_config(overlay={"oryx.monitoring.flight.dir": flight_dir})
+    )
+    rec.record(kind="bench-stage", stage=stage, phase="start")
+    return rec
+
+
+def _flight_phase(rec, stage: str, phase: str) -> None:
+    """Phase marker: the last one in a harvested ring names what a killed
+    stage was doing when it died."""
+    rec.record(kind="bench-stage", stage=stage, phase=phase)
+
+
+def _emit_stage_error(
+    field: str, e: BaseException, rec, base: dict | None = None
+) -> None:
+    """`http_error`-style parseable failure row for a stage: the named
+    error plus the flight-snapshot artifact path, printed BEFORE the
+    exception propagates so even a failed stage leaves JSON evidence.
+    ``base`` carries stage-specific context that must survive into the
+    row (the http stage's phase errors, train's banked warmup fields)."""
+    row: dict = dict(base) if base else {}
+    row[field] = f"{type(e).__name__}: {e}"
+    try:
+        _, path = rec.snapshot(f"bench-{field}")
+        if path:
+            row["flight_artifact"] = path
+    except Exception:  # noqa: BLE001 - the row must print regardless
+        pass
+    print(json.dumps(row), flush=True)
+
+
+def _harvest_stage_flight(body: str) -> str | None:
+    """Driver-side harvest of a failed/killed stage's on-disk ring (the
+    stage process may be a SIGKILLed corpse — this reads only what it
+    already wrote)."""
+    try:
+        from oryx_tpu.common import flightrec
+
+        return flightrec.harvest(_stage_flight_dir(body), stage=body)
+    except Exception:  # noqa: BLE001 - diagnostics never fail the suite
+        return None
+
+
+# --------------------------------------------------------------------------
 # measured body — runs in a subprocess
 # --------------------------------------------------------------------------
 
@@ -616,6 +690,14 @@ def _bench_http_body(sample_rate: float = 1.0) -> None:
             "oryx_tpu.serving.resources.common",
             "oryx_tpu.serving.resources.als",
         ],
+        # the in-process ServingApp re-configures the process-global
+        # flight recorder from ITS config (last-writer-wins); without
+        # this key the stage's ring would silently rebind from the
+        # driver's ORYX_BENCH_FLIGHT_DIR to the default dir and the
+        # driver-side timeout harvest would read a stale, phase-less ring
+        "oryx.monitoring.flight.dir": os.environ.get(
+            "ORYX_BENCH_FLIGHT_DIR", ""
+        ) or _stage_flight_dir("http-lsh" if lsh else "http"),
     }
     cfg = load_config(overlay=base_overlay)
     topics.maybe_create("mem://bench", "OryxUpdate", partitions=1)
@@ -749,12 +831,19 @@ def _bench_http_body(sample_rate: float = 1.0) -> None:
     # stage — after printing a parseable {"http_error": ...} line so even
     # that failure is a named error in the JSON, not a silent rc!=0.
     phase_errors: dict[str, str] = {}
+    flight = _flight_stage("http-lsh" if lsh else "http")
+    stage_name = "http-lsh" if lsh else "http"
 
     def _guard(phase: str, fn, default=None):
+        _flight_phase(flight, stage_name, phase)
         try:
             return fn()
         except Exception as e:  # noqa: BLE001 - named, reported, non-fatal
             phase_errors[phase] = f"{type(e).__name__}: {e}"
+            flight.record(
+                kind="bench-stage", stage=stage_name, phase=phase,
+                error=phase_errors[phase],
+            )
             print(
                 f"http bench phase {phase} failed: {phase_errors[phase]}",
                 file=sys.stderr,
@@ -782,6 +871,7 @@ def _bench_http_body(sample_rate: float = 1.0) -> None:
     # sharing the one model and batcher: cross-loop requests coalesce
     # into the same device dispatches.
     try:
+        _flight_phase(flight, stage_name, "primary")
         serving = _start_serving(0)
         port = serving.port
         phase2_warm = 5.0 if qps_single is not None else warm_s
@@ -790,13 +880,12 @@ def _bench_http_body(sample_rate: float = 1.0) -> None:
         )
     except Exception as e:  # noqa: BLE001 - the stage still fails (rc!=0),
         # but the artifact names the error instead of dying JSON-less
-        err_row = {
-            "http_error": f"primary: {type(e).__name__}: {e}",
-            "platform": platform,
-        }
+        base = {"platform": platform}
         if phase_errors:
-            err_row["http_phase_errors"] = phase_errors
-        print(json.dumps(err_row), flush=True)
+            base["http_phase_errors"] = phase_errors
+        # the dying phase is named by the flight ring's "primary" marker
+        # and http_phase_errors; the row itself carries the raw error
+        _emit_stage_error("http_error", e, flight, base=base)
         raise
 
     # Phase 2b — per-stage latency attribution: a SHORT separate window
@@ -1044,21 +1133,36 @@ def _bench_train_body() -> None:
     # baseline runner consumes the same synthesized dataset for a
     # like-for-like speedup ratio
 
-    platform = jax.devices()[0].platform
-    on_accel = platform not in ("cpu",)
-    if on_accel:
-        # progressive: bank a 1M-interaction row FIRST (small compile,
-        # ~tens of seconds even over the remote-compile tunnel), THEN the
-        # 25M north-star build. The round-5 healthy window lasted ~4 min
-        # and the cold 25M compile alone outlived it — with this stage
-        # marked allow_partial, a wedge mid-25M keeps the 1M TPU row
-        # instead of erasing the stage
-        warmup = _train_once(6_000, 3_700, 1_000_000, platform, on_accel)
-        n_users, n_items, nnz = 162_000, 59_000, 25_000_000
-    else:  # CPU fallback: ML-1M-ish shape so the harness still completes
-        warmup = None
-        n_users, n_items, nnz = 6_000, 3_700, 1_000_000
-    _train_once(n_users, n_items, nnz, platform, on_accel, warmup)
+    rec = _flight_stage("train")
+    warmup = None
+    try:
+        platform = jax.devices()[0].platform
+        on_accel = platform not in ("cpu",)
+        if on_accel:
+            # progressive: bank a 1M-interaction row FIRST (small compile,
+            # ~tens of seconds even over the remote-compile tunnel), THEN the
+            # 25M north-star build. The round-5 healthy window lasted ~4 min
+            # and the cold 25M compile alone outlived it — with this stage
+            # marked allow_partial, a wedge mid-25M keeps the 1M TPU row
+            # instead of erasing the stage
+            _flight_phase(rec, "train", "build-1m-warmup")
+            warmup = _train_once(6_000, 3_700, 1_000_000, platform, on_accel)
+            n_users, n_items, nnz = 162_000, 59_000, 25_000_000
+        else:  # CPU fallback: ML-1M-ish shape so the harness still completes
+            n_users, n_items, nnz = 6_000, 3_700, 1_000_000
+        _flight_phase(rec, "train", f"build-{nnz}")
+        _train_once(n_users, n_items, nnz, platform, on_accel, warmup)
+        _flight_phase(rec, "train", "done")
+    except BaseException as e:  # noqa: BLE001 - the stage still fails
+        # (rc!=0), but the last parseable row names the error + the
+        # flight bundle — and keeps the already-banked warmup row's
+        # fields, so a wedge mid-25M still ships the 1M TPU number —
+        # instead of dying as a bare `error: _bench_train_body` string
+        _emit_stage_error(
+            "train_error", e, rec,
+            base=warmup if isinstance(warmup, dict) else None,
+        )
+        raise
 
 
 def _train_once(
@@ -2053,54 +2157,66 @@ def _bench_shard_body() -> None:
     from oryx_tpu.ops.als import topk_dot_batch
     from oryx_tpu.ops.transfer import sharded_device_put
 
-    platform = jax.devices()[0].platform
-    on_accel = platform not in ("cpu",)
-    n_dev = len(jax.local_devices())
-    n_items, features, batch, k = (
-        (1_000_000, 50, 1024, 10) if on_accel else (200_000, 32, 256, 10)
-    )
-    rng = np.random.default_rng(5)
-    y = rng.standard_normal((n_items, features)).astype(np.float32)
-    xs = jnp.asarray(rng.standard_normal((batch, features)).astype(np.float32))
-    iters = 20 if on_accel else 6
-    qps: dict[int, float] = {}
-    idx_by: dict[int, object] = {}
-    for shards in (1, 2):
-        sm = sharded_device_put(y, shards, dtype=jnp.bfloat16)
-        v, i = topk_dot_batch(xs, sm, k=k)  # warm: compile per shard
-        np.asarray(v)
-        idx_by[shards] = np.asarray(i)
+    rec = _flight_stage("shard")
+    try:
+        platform = jax.devices()[0].platform
+        on_accel = platform not in ("cpu",)
+        n_dev = len(jax.local_devices())
+        n_items, features, batch, k = (
+            (1_000_000, 50, 1024, 10) if on_accel else (200_000, 32, 256, 10)
+        )
+        rng = np.random.default_rng(5)
+        y = rng.standard_normal((n_items, features)).astype(np.float32)
+        xs = jnp.asarray(
+            rng.standard_normal((batch, features)).astype(np.float32)
+        )
+        iters = 20 if on_accel else 6
+        qps: dict[int, float] = {}
+        idx_by: dict[int, object] = {}
+        for shards in (1, 2):
+            _flight_phase(rec, "shard", f"topk-{shards}shard")
+            sm = sharded_device_put(y, shards, dtype=jnp.bfloat16)
+            v, i = topk_dot_batch(xs, sm, k=k)  # warm: compile per shard
+            np.asarray(v)
+            idx_by[shards] = np.asarray(i)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                v, i = topk_dot_batch(xs, sm, k=k)
+                np.asarray(i)
+            dt = time.perf_counter() - t0
+            qps[shards] = batch * iters / dt
+        scaling = qps[2] / qps[1] if qps[1] > 0 else None
+        # the correctness half of the claim rides along: the 2-shard merge
+        # must return the 1-shard view's exact candidate set
+        identical = bool((idx_by[1] == idx_by[2]).all())
+
+        # sharded bucketed train -> runtime train-MFU accounting
+        from oryx_tpu.common.perfstats import get_perfstats
+        from oryx_tpu.ops.als import aggregate_interactions, train_als
+        from oryx_tpu.parallel.mesh import model_mesh
+
+        _flight_phase(rec, "shard", "sharded-train")
+        n_users, nnz = (200_000, 2_000_000) if on_accel else (5_000, 40_000)
+        t_users = rng.integers(0, n_users, nnz).astype(str)
+        t_items = rng.integers(0, n_items // 10, nnz).astype(str)
+        data = aggregate_interactions(
+            t_users, t_items, (rng.random(nnz) + 0.2).astype(np.float32),
+            implicit=True,
+        )
+        train_shards = min(2, n_dev)
         t0 = time.perf_counter()
-        for _ in range(iters):
-            v, i = topk_dot_batch(xs, sm, k=k)
-            np.asarray(i)
-        dt = time.perf_counter() - t0
-        qps[shards] = batch * iters / dt
-    scaling = qps[2] / qps[1] if qps[1] > 0 else None
-    # the correctness half of the claim rides along: the 2-shard merge
-    # must return the 1-shard view's exact candidate set
-    identical = bool((idx_by[1] == idx_by[2]).all())
-
-    # sharded bucketed train -> runtime train-MFU accounting
-    from oryx_tpu.common.perfstats import get_perfstats
-    from oryx_tpu.ops.als import aggregate_interactions, train_als
-    from oryx_tpu.parallel.mesh import model_mesh
-
-    n_users, nnz = (200_000, 2_000_000) if on_accel else (5_000, 40_000)
-    t_users = rng.integers(0, n_users, nnz).astype(str)
-    t_items = rng.integers(0, n_items // 10, nnz).astype(str)
-    data = aggregate_interactions(
-        t_users, t_items, (rng.random(nnz) + 0.2).astype(np.float32),
-        implicit=True,
-    )
-    train_shards = min(2, n_dev)
-    t0 = time.perf_counter()
-    train_als(
-        data, features=features, iterations=3,
-        shard_mesh=model_mesh(train_shards) if train_shards > 1 else None,
-    )
-    train_s = time.perf_counter() - t0
-    train_mfu = get_perfstats().mfu("train")
+        train_als(
+            data, features=features, iterations=3,
+            shard_mesh=model_mesh(train_shards) if train_shards > 1 else None,
+        )
+        train_s = time.perf_counter() - t0
+        train_mfu = get_perfstats().mfu("train")
+        _flight_phase(rec, "shard", "done")
+    except BaseException as e:  # noqa: BLE001 - stage fails rc!=0, but the
+        # last parseable row names the error + flight bundle (the phase
+        # markers in the ring say whether top-k or the sharded train died)
+        _emit_stage_error("shard_error", e, rec)
+        raise
 
     print(
         f"shard scaling: {n_items} items x {features}f, 1-shard "
@@ -2433,6 +2549,23 @@ def _run_bench(
         + f"import sys; sys.path.insert(0, {HERE!r}); "
         + f"import bench; bench._enable_compile_cache(); bench.{body}()"
     )
+    # fresh per-stage flight RING: the stage body records its black box
+    # here, and a timeout (SIGKILL — the child can't write its own last
+    # words) is harvested from this dir by the suite driver. Only the
+    # events-*.jsonl segment files are cleared — a previous round's ring
+    # must not masquerade as this run's, but its harvest/snapshot
+    # artifacts (whose paths the PREVIOUS window's rows banked) are
+    # evidence, pruned by the recorder's own bounded-keep policy instead
+    # of destroyed by the next launch.
+    flight_dir = _stage_flight_dir(body)
+    import glob
+
+    for seg in glob.glob(os.path.join(flight_dir, "events-*.jsonl")):
+        try:
+            os.unlink(seg)
+        except OSError:
+            pass
+    env = dict(env, ORYX_BENCH_FLIGHT_DIR=flight_dir)
     rc, stdout, stderr = _run_subprocess(code, env, timeout)
     sys.stderr.write(stderr)
     status = "ok" if rc == 0 else ("timeout" if rc is None else "failed")
@@ -2465,6 +2598,16 @@ def _merge_kernel(result: dict, kernel: dict) -> None:
 
 
 def _merge_train(result: dict, train: dict) -> None:
+    """A failed build's row carries `train_error` (+ the flight-artifact
+    path) alongside whatever warmup fields were already banked — merge
+    the error evidence, and the regular fields only when a build actually
+    completed (a bare error row must not write null headline keys)."""
+    if "train_error" in train:
+        result["train_error"] = train["train_error"]
+        if "flight_artifact" in train:
+            result["train_flight_artifact"] = train["flight_artifact"]
+        if "value" not in train:
+            return
     result["als_build_seconds"] = train.get("value")
     result["als_build_auc"] = train.get("auc")
     result["als_build_interactions"] = train.get("interactions")
@@ -2531,6 +2674,8 @@ def _merge_http(result: dict, http: dict) -> None:
         result["http_error"] = http["http_error"]
         if "http_phase_errors" in http:
             result["http_phase_errors"] = http["http_phase_errors"]
+        if "flight_artifact" in http:
+            result["http_flight_artifact"] = http["flight_artifact"]
         return
     result.update(http)
 
@@ -2604,7 +2749,14 @@ def _merge_shard(result: dict, row: dict) -> None:
     """Shard-scaling block lands nested, with the 2-shard ratio promoted
     to the compact final line. train_mfu fills in only when the train
     stage didn't already bank a value (setdefault: the dedicated train
-    build's MFU, measured at full scale, outranks this stage's)."""
+    build's MFU, measured at full scale, outranks this stage's). A
+    failed stage's `shard_error` row (no value) merges only the named
+    error + flight-artifact path."""
+    if "shard_error" in row and "value" not in row:
+        result["shard_error"] = row["shard_error"]
+        if "flight_artifact" in row:
+            result["shard_flight_artifact"] = row["flight_artifact"]
+        return
     result["shard"] = {
         key: row[key]
         for key in (
@@ -2665,8 +2817,11 @@ _SUITE_STAGES = (
     ("_bench_fleet_body", 480, False, _merge_fleet, True),
     ("_bench_seq_body", 300, False, _merge_seq, False),
     # shard-scaling: device-only work (catalog generated host-side once,
-    # no serving tier), cheap next to the scale sweep
-    ("_bench_shard_body", 300, False, _merge_shard, False),
+    # no serving tier), cheap next to the scale sweep. allow_partial: a
+    # failed stage prints a parseable {"shard_error": ...} row carrying
+    # the flight-artifact path (the train stage and the http primary
+    # follow the same contract)
+    ("_bench_shard_body", 300, True, _merge_shard, False),
     ("_bench_scale_body", 900, True, _merge_scaling, False),
 )
 
@@ -2769,11 +2924,19 @@ def _run_suite(
             _LATEST_PARTIAL = dict(result)
             print(json.dumps({**result, "interim": True}), flush=True)
         if status != "ok":
+            # harvest the stage's on-disk flight ring (the corpse's phase
+            # markers name what it was doing when killed) and carry the
+            # artifact path in the suite artifact — a timeout row must
+            # explain itself, not just say `timeout` (round-5 lesson)
+            flight_path = _harvest_stage_flight(body)
+            if flight_path:
+                result.setdefault("stage_flight", {})[body] = flight_path
+            suffix = f" (flight: {flight_path})" if flight_path else ""
             if status == "timeout" and granted < cap - 1:
-                errors.append(f"{body} ({tag}) budget-exhausted")
+                errors.append(f"{body} ({tag}) budget-exhausted{suffix}")
                 result["suite_aborted_at"] = body
                 return (result if "metric" in result else None), False
-            errors.append(f"{body} ({tag}) {status}")
+            errors.append(f"{body} ({tag}) {status}{suffix}")
             if status == "timeout" and not force_cpu and not stage_cpu:
                 # a full-cap timeout can be a wedged transport OR a
                 # cold-compile storm (round-4 window post-mortem): probe.

@@ -40,7 +40,7 @@ def _parse_args(argv):
         "command",
         choices=[
             "batch", "speed", "serving", "setup", "tail", "input",
-            "import-pmml", "loadtest", "config", "pod", "fleet",
+            "import-pmml", "loadtest", "config", "pod", "fleet", "flight",
         ],
     )
     p.add_argument(
@@ -343,6 +343,27 @@ def cmd_config(config: Config) -> int:
         elif isinstance(v, bool):
             v = str(v).lower()
         print(f"{path}={v}")
+    return 0
+
+
+def cmd_flight(config: Config) -> int:
+    """Print the configured flight-recorder ring as JSONL, oldest first —
+    the offline face of GET /debug/flight: works on a CORPSE's dir (the
+    process that wrote it need not be alive), so an operator reads a
+    crash-looping replica's last words with
+
+        python -m oryx_tpu.cli flight \\
+            --set oryx.monitoring.flight.dir=/tmp/oryx_tpu/fleet/r0/flight
+    """
+    from oryx_tpu.common.flightrec import read_events
+
+    flight_dir = config.get_string(
+        "oryx.monitoring.flight.dir", "file:/tmp/oryx_tpu/flight"
+    )
+    events = read_events(flight_dir)
+    for ev in events:
+        print(json.dumps(ev))
+    print(f"# {len(events)} event(s) in {flight_dir}", file=sys.stderr)
     return 0
 
 
@@ -1130,6 +1151,7 @@ def main(argv=None) -> int:
         "setup": cmd_setup,
         "tail": cmd_tail,
         "input": cmd_input,
+        "flight": cmd_flight,
     }[args.command](config)
 
 

@@ -198,6 +198,14 @@ class ModelFreshness:
                 generation=gen or 0, lag_s=round(lag_s, 3),
             )
             tr.finish(span)
+        from oryx_tpu.common.flightrec import get_flightrec
+
+        # generation adoptions are the heartbeat of a replica's flight
+        # ring: a corpse harvested mid update-storm shows exactly which
+        # generation it last swapped in, and when
+        get_flightrec().record(
+            kind="generation", generation=gen, lag_s=round(lag_s, 3),
+        )
 
     # -- gauge callbacks ---------------------------------------------------
 

@@ -148,6 +148,23 @@ class Counter:
             return float(fn())  # outside the lock: callables may be slow
         return v
 
+    def series(self) -> dict[tuple, float]:
+        """Snapshot of every series' value keyed by its sorted label
+        tuple (callback-bound series evaluated outside the lock; a
+        failing callback is skipped like a scrape would). The SLO
+        trackers (common/slo.py) sum these to derive good/bad totals
+        without new instrumentation on the request path."""
+        with self._lock:
+            snapshot = dict(self._values)
+            fns = dict(self._fns)
+        out = dict(snapshot)
+        for key, fn in fns.items():
+            try:
+                out[key] = float(fn())
+            except Exception:  # noqa: BLE001 - skip like render() does
+                continue
+        return out
+
     def render(self, openmetrics: bool = False) -> list[str]:
         # OpenMetrics counter contract: the METRIC FAMILY name carries no
         # _total suffix — samples are `<family>_total` — so the HELP/TYPE
@@ -342,6 +359,26 @@ class Histogram:
         out = [(ub, counts[i]) for i, ub in enumerate(self.buckets)]
         out.append((float("inf"), total))
         return out
+
+    def totals_below(self, threshold: float) -> tuple[int, int]:
+        """(observations at/under ``threshold``, total observations)
+        summed across every label set — the latency-SLO numerator/
+        denominator. Uses the largest bucket bound <= threshold (the
+        conservative read when the threshold falls between bounds);
+        a threshold under the first bound counts nothing as fast."""
+        idx = -1
+        for i, ub in enumerate(self.buckets):
+            if ub <= threshold:
+                idx = i
+            else:
+                break
+        with self._lock:
+            total = sum(self._totals.values())
+            if idx < 0:
+                below = 0
+            else:
+                below = sum(c[idx] for c in self._counts.values())
+        return below, total
 
     def exemplar(self, bucket_index: int, **labels: str):
         """(trace_id, value, unix_ts) recorded for the bucket at

@@ -277,6 +277,13 @@ class PerfStats:
             return
         self._c_fallback.inc(n)
         self._fallback_until[kind] = time.monotonic() + self.window_s
+        from oryx_tpu.common.flightrec import get_flightrec
+
+        # episode-limited: a sustained outage records one event per 5s,
+        # not one per degraded request
+        get_flightrec().record(
+            kind="fallback", episode_s=5.0, n=n, dispatch_kind=kind,
+        )
 
     def _prune_window(self, kind: str, now: float) -> None:  # oryxlint: holds=_win_lock
         """Drop window entries older than window_s (caller holds

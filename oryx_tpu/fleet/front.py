@@ -45,10 +45,25 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import math
 import threading
+import time
+from urllib.parse import parse_qs
 
 from oryx_tpu.common.config import Config
+from oryx_tpu.common.flightrec import configure_flightrec, get_flightrec
 from oryx_tpu.common.metrics import GaugeSeriesGone, get_registry
+from oryx_tpu.common.slo import ensure_front_slos
+from oryx_tpu.common.tracing import (
+    configure_tracing,
+    format_traceparent,
+    get_tracer,
+    parse_traceparent,
+    span_forest,
+    stitch_traces,
+    stitched_chrome,
+)
+from oryx_tpu.fleet.observe import federate
 from oryx_tpu.fleet.ring import HashRing
 from oryx_tpu.serving.aserver import (
     MAX_BODY_BYTES,
@@ -58,6 +73,10 @@ from oryx_tpu.serving.aserver import (
 )
 
 log = logging.getLogger(__name__)
+
+# the tracer singleton, bound once like serving/aserver.py: the
+# disabled-tracing cost on the proxy hot path is one attribute read
+_TRACER = get_tracer()
 
 # Response headers the backend's answer carries through the front
 # verbatim (content-type/length are re-derived by the front's writer).
@@ -116,6 +135,9 @@ class ReplicaInfo:
         self.last_reasons: list[str] = []
 
     def snapshot(self) -> dict:
+        # NaN/Inf gauges (mfu on peak-less hosts) render as null: bare
+        # NaN in the /fleet/status body is invalid JSON and breaks every
+        # strict client parser
         return {
             "id": self.id,
             "host": self.host,
@@ -124,8 +146,8 @@ class ReplicaInfo:
             "routable": self.routable,
             "consecutive_failures": self.consecutive_bad,
             "model_generation": self.generation,
-            "staleness_seconds": self.staleness_seconds,
-            "mfu": self.mfu,
+            "staleness_seconds": _finite_or_none(self.staleness_seconds),
+            "mfu": _finite_or_none(self.mfu),
             "update_lag": self.update_lag,
             "shards": self.shards,
             "degraded": self.last_reasons,
@@ -141,6 +163,14 @@ class _FrontApp:
 
     def is_fast(self, path: str) -> bool:  # pragma: no cover - unused
         return False
+
+
+def _finite_or_none(v: float | None) -> float | None:
+    """JSON-safe float: NaN/Inf -> None (json.dumps would emit bare NaN,
+    which strict json.loads rejects)."""
+    if isinstance(v, float) and not math.isfinite(v):
+        return None
+    return v
 
 
 def _states_reader(ref, state: str):
@@ -227,6 +257,13 @@ class FleetFront(AsyncHTTPServer):
         self._prober: threading.Thread | None = None
         self._prober_stop = threading.Event()
         self._register_fleet_metrics()
+        # fleet-plane observability adopts this process's config: span
+        # tracing (front.route trees + traceparent origination), the
+        # flight recorder (ejection/readmission lifecycle events), and
+        # the front-availability SLO burn-rate gauges
+        configure_tracing(config)
+        configure_flightrec(config)
+        ensure_front_slos(config)
 
     # -- metrics -----------------------------------------------------------
 
@@ -281,7 +318,11 @@ class FleetFront(AsyncHTTPServer):
         self._m_requests = reg.counter(
             "oryx_fleet_front_requests_total",
             "Requests the front completed, by replica that answered "
-            "(replica=none: no replica was routable)",
+            "(replica=none: the FRONT answered with its own error — no "
+            "routable replica, or a transport failure on a request that "
+            "could not be retried). The front-availability SLO counts "
+            "replica=none as the bad fraction, so the label must follow "
+            "who actually answered, not who was attempted",
             labeled=True,
         )
         self._m_retries = reg.counter(
@@ -294,6 +335,14 @@ class FleetFront(AsyncHTTPServer):
         self._m_ejections = reg.counter(
             "oryx_fleet_ejections_total",
             "Health-driven replica ejections at the front",
+            labeled=True,
+        )
+        self._m_fed_errors = reg.counter(
+            "oryx_fleet_federation_errors_total",
+            "Replica fetches the fleet federation endpoints "
+            "(/fleet/metrics, /fleet/traces) could not complete, by "
+            "endpoint and replica — that replica's series/spans are "
+            "missing from the federated page",
             labeled=True,
         )
 
@@ -401,6 +450,9 @@ class FleetFront(AsyncHTTPServer):
                     r.id, r.host, r.port,
                 )
                 r.routable = True
+                get_flightrec().record(
+                    kind="readmission", replica=r.id, port=r.port,
+                )
             if r.routable:
                 r.state = "up"
             return
@@ -418,6 +470,15 @@ class FleetFront(AsyncHTTPServer):
             )
             r.routable = False
             self._m_ejections.inc(replica=r.id)
+            # flight event: `replica` carries the SAME id the dead
+            # process stamps on its own events, so a harvested corpse's
+            # last words and the front's ejection join on one key
+            get_flightrec().record(
+                kind="ejection", replica=r.id, port=r.port,
+                probes=r.consecutive_bad,
+                reasons=r.last_reasons
+                or [f"http-{status}" if status else "unreachable"],
+            )
         if not r.routable:
             r.state = kind
 
@@ -545,6 +606,9 @@ class FleetFront(AsyncHTTPServer):
         conn_opt = b""
         upgrade = b""
         h2c_settings = None
+        accept = b""
+        tp_raw = b""
+        tracing = _TRACER.enabled
         fwd_lines: list[bytes] = []
         for ln in head[line_end + 2 : -4].split(b"\r\n"):
             i = ln.find(b":")
@@ -569,6 +633,20 @@ class FleetFront(AsyncHTTPServer):
                     return False
             elif key == b"http2-settings":
                 h2c_settings = ln[i + 1 :].strip()
+            elif key == b"accept":
+                # pulled for /fleet/metrics content negotiation; still
+                # forwarded so replicas negotiate the same dialect
+                accept = ln[i + 1 :].strip()
+                fwd_lines.append(ln)
+            elif key == b"traceparent":
+                # when the front traces, the client's context becomes the
+                # front.route span's PARENT and the forwarded hop carries
+                # the front's own span id instead (injected below) — the
+                # replica's request span then nests under the front's in
+                # the stitched tree. Untraced fronts forward it verbatim.
+                tp_raw = ln[i + 1 :].strip()
+                if not tracing:
+                    fwd_lines.append(ln)
             elif key in _DROP_REQUEST_HEADERS_B:
                 continue
             else:
@@ -601,6 +679,16 @@ class FleetFront(AsyncHTTPServer):
             )
         keep_alive = conn_opt != b"close" and version_b != b"HTTP/1.0"
         path = target.split("?", 1)[0]
+        if path in ("/fleet/metrics", "/fleet/traces"):
+            # fleet-scope fan-out endpoints: async (they fetch every
+            # routable replica over the pooled backend connections)
+            status, payload, ctype, extra = await self._fleet_endpoint(
+                method, path, target, accept.decode("latin-1", "replace")
+            )
+            await self._write_response(
+                writer, status, payload, ctype, method, extra=extra
+            )
+            return keep_alive
         if path == "/metrics" or path.startswith("/fleet/"):
             status, payload, ctype, extra = self._local_endpoint(method, path)
             await self._write_response(
@@ -608,81 +696,145 @@ class FleetFront(AsyncHTTPServer):
             )
             return keep_alive
 
+        span = None
+        if tracing:
+            # the front ORIGINATES a trace when the client sent none;
+            # either way the forwarded hop carries the front's span as
+            # the replica's parent, so /fleet/traces stitches one tree
+            span = _TRACER.start(
+                "front.route",
+                parent=parse_traceparent(tp_raw.decode("latin-1", "replace")),
+                method=method, target=target, policy=self.policy,
+            )
+            if span is not None:
+                fwd_lines.append(
+                    b"traceparent: "
+                    + format_traceparent(span.trace_id, span.span_id).encode(
+                        "ascii"
+                    )
+                )
         tried: set[str] = set()
         last_shed: tuple[bytes, bytes] | None = None
         fwd_block = b"\r\n".join(fwd_lines)
-        for _ in range(len(self.replicas)):
-            r = self._pick(path, tried)
-            if r is None:
-                break
-            try:
-                status, rhead, payload, backend_alive = await self._fast_exchange(
-                    r, method, target, fwd_block, body
-                )
-            except (OSError, asyncio.IncompleteReadError, asyncio.TimeoutError):
-                tried.add(r.id)
-                if method in ("GET", "HEAD"):
-                    # idempotent: safe to replay on another replica; a
-                    # non-idempotent request may have reached the backend
-                    # and must not be double-applied
-                    self._m_retries.inc(reason="connect")
+        try:
+            for _ in range(len(self.replicas)):
+                r = self._pick(path, tried)
+                if r is None:
+                    break
+                t_try = time.monotonic() if span is not None else 0.0
+                try:
+                    status, rhead, payload, backend_alive = await self._fast_exchange(
+                        r, method, target, fwd_block, body, span=span
+                    )
+                except (OSError, asyncio.IncompleteReadError, asyncio.TimeoutError):
+                    if span is not None:
+                        _TRACER.record_interval(
+                            "front.proxy", t_try, parent=span,
+                            replica=r.id, error="connect",
+                        )
+                    tried.add(r.id)
+                    if method in ("GET", "HEAD"):
+                        # idempotent: safe to replay on another replica; a
+                        # non-idempotent request may have reached the backend
+                        # and must not be double-applied
+                        self._m_retries.inc(reason="connect")
+                        if span is not None:
+                            _TRACER.record_interval(
+                                "front.retry", time.monotonic(), parent=span,
+                                reason="connect", replica=r.id,
+                            )
+                        continue
+                    # the client gets the FRONT's own 502 — no replica
+                    # answered, so the series (and the front-availability
+                    # SLO's bad fraction) must say none, not r.id
+                    self._m_requests.inc(replica="none")
+                    if span is not None:
+                        span.attrs["status"] = 502
+                    await self._write_response(
+                        writer,
+                        502,
+                        b'{"status":502,"error":"replica unreachable"}',
+                        "application/json",
+                        method,
+                    )
+                    return keep_alive
+                if span is not None:
+                    _TRACER.record_interval(
+                        "front.proxy", t_try, parent=span,
+                        replica=r.id, status=status,
+                    )
+                if (
+                    status == 503
+                    and self.retry_shed
+                    and b"retry-after" in rhead.lower()
+                ):
+                    # a shed refused the work before doing it — retrying on a
+                    # different replica cannot double-process
+                    tried.add(r.id)
+                    last_shed = (rhead, payload)
+                    self._m_retries.inc(reason="shed")
+                    if span is not None:
+                        _TRACER.record_interval(
+                            "front.retry", time.monotonic(), parent=span,
+                            reason="shed", replica=r.id,
+                        )
                     continue
                 self._m_requests.inc(replica=r.id)
-                await self._write_response(
-                    writer,
-                    502,
-                    b'{"status":502,"error":"replica unreachable"}',
-                    "application/json",
-                    method,
-                )
+                if span is not None:
+                    span.attrs["status"] = status
+                    span.attrs["replica"] = r.id
+                writer.write(rhead + payload if method != "HEAD" else rhead)
+                try:
+                    await writer.drain()
+                except ConnectionError:
+                    return False
+                return keep_alive and backend_alive
+            if last_shed is not None:
+                # every routable replica shed: surface the backpressure (with
+                # its Retry-After) instead of inventing a different error
+                self._m_requests.inc(replica="none")
+                if span is not None:
+                    span.attrs["status"] = 503
+                rhead, payload = last_shed
+                writer.write(rhead + payload if method != "HEAD" else rhead)
+                try:
+                    await writer.drain()
+                except ConnectionError:
+                    return False
                 return keep_alive
-            if (
-                status == 503
-                and self.retry_shed
-                and b"retry-after" in rhead.lower()
-            ):
-                # a shed refused the work before doing it — retrying on a
-                # different replica cannot double-process
-                tried.add(r.id)
-                last_shed = (rhead, payload)
-                self._m_retries.inc(reason="shed")
-                continue
-            self._m_requests.inc(replica=r.id)
-            writer.write(rhead + payload if method != "HEAD" else rhead)
-            try:
-                await writer.drain()
-            except ConnectionError:
-                return False
-            return keep_alive and backend_alive
-        if last_shed is not None:
-            # every routable replica shed: surface the backpressure (with
-            # its Retry-After) instead of inventing a different error
             self._m_requests.inc(replica="none")
-            rhead, payload = last_shed
-            writer.write(rhead + payload if method != "HEAD" else rhead)
-            try:
-                await writer.drain()
-            except ConnectionError:
-                return False
+            if span is not None:
+                span.attrs["status"] = 503
+            await self._write_response(
+                writer,
+                503,
+                b'{"status":503,"error":"no routable replica"}',
+                "application/json",
+                method,
+                extra=(("Retry-After", "1"),),
+            )
             return keep_alive
-        self._m_requests.inc(replica="none")
-        await self._write_response(
-            writer,
-            503,
-            b'{"status":503,"error":"no routable replica"}',
-            "application/json",
-            method,
-            extra=(("Retry-After", "1"),),
-        )
-        return keep_alive
+        finally:
+            if span is not None:
+                _TRACER.finish(span)
+                _TRACER.log_if_slow(span, log)
 
     async def _fast_exchange(
-        self, r: ReplicaInfo, method: str, target: str, fwd_block: bytes, body: bytes
+        self,
+        r: ReplicaInfo,
+        method: str,
+        target: str,
+        fwd_block: bytes,
+        body: bytes,
+        span=None,
     ) -> tuple[int, bytes, bytes, bool]:
         """One forwarded exchange on a pooled connection, raw bytes both
         ways, under ONE whole-exchange deadline (call_later + abort — see
         _handle_conn). Returns (status, verbatim response head, payload,
-        backend keep-alive)."""
+        backend keep-alive). ``span`` (the request's front.route span)
+        parents a front.connect interval when no pooled socket was
+        reusable — pool misses then show up per request in the stitched
+        trace instead of hiding inside proxy time."""
         loop = asyncio.get_running_loop()
         key = (id(loop), r.id)
         pool = self._pools.get(key)
@@ -694,7 +846,12 @@ class FleetFront(AsyncHTTPServer):
                 break
             cand[1].close()
         if conn is None:
+            t_conn = time.monotonic() if span is not None else 0.0
             conn = await asyncio.open_connection(r.host, r.port)
+            if span is not None:
+                _TRACER.record_interval(
+                    "front.connect", t_conn, parent=span, replica=r.id
+                )
         reader, writer = conn
         reusable = False
         t = loop.call_later(self.backend_timeout, writer.transport.abort)
@@ -860,57 +1017,208 @@ class FleetFront(AsyncHTTPServer):
         span=None,
     ) -> tuple[int, bytes, str, tuple[tuple[str, str], ...]]:
         path = target.split("?", 1)[0]
+        if path in ("/fleet/metrics", "/fleet/traces"):
+            return await self._fleet_endpoint(
+                method, path, target, headers.get("accept", "")
+            )
         if path == "/metrics" or path.startswith("/fleet/"):
             return self._local_endpoint(method, path)
+        fspan = None
+        if _TRACER.enabled:
+            # same origination/injection contract as the h1 fast lane:
+            # the replica's request span parents to the front's
+            fspan = _TRACER.start(
+                "front.route",
+                parent=parse_traceparent(headers.get("traceparent")),
+                method=method, target=target, policy=self.policy, proto="h2",
+            )
+            if fspan is not None:
+                headers = dict(headers)
+                headers["traceparent"] = format_traceparent(
+                    fspan.trace_id, fspan.span_id
+                )
         tried: set[str] = set()
         last_shed = None
-        for _ in range(len(self.replicas)):
-            r = self._pick(path, tried)
-            if r is None:
-                break
-            try:
-                status, payload, ctype, extra = await self._proxy_once(
-                    r, method, target, headers, body
+        try:
+            for _ in range(len(self.replicas)):
+                r = self._pick(path, tried)
+                if r is None:
+                    break
+                t_try = time.monotonic() if fspan is not None else 0.0
+                try:
+                    status, payload, ctype, extra = await self._proxy_once(
+                        r, method, target, headers, body
+                    )
+                except (OSError, asyncio.IncompleteReadError, asyncio.TimeoutError):
+                    if fspan is not None:
+                        _TRACER.record_interval(
+                            "front.proxy", t_try, parent=fspan,
+                            replica=r.id, error="connect",
+                        )
+                    tried.add(r.id)
+                    if method in ("GET", "HEAD"):
+                        # idempotent: safe to replay on another replica; a
+                        # non-idempotent request may have reached the backend
+                        # and must not be double-applied
+                        self._m_retries.inc(reason="connect")
+                        if fspan is not None:
+                            _TRACER.record_interval(
+                                "front.retry", time.monotonic(),
+                                parent=fspan, reason="connect", replica=r.id,
+                            )
+                        continue
+                    # front-authored 502: no replica answered (see the
+                    # h1 fast path — the SLO's bad fraction rides this)
+                    self._m_requests.inc(replica="none")
+                    if fspan is not None:
+                        fspan.attrs["status"] = 502
+                    return (
+                        502,
+                        b'{"status":502,"error":"replica unreachable"}',
+                        "application/json",
+                        (),
+                    )
+                if fspan is not None:
+                    _TRACER.record_interval(
+                        "front.proxy", t_try, parent=fspan,
+                        replica=r.id, status=status,
+                    )
+                is_shed = status == 503 and any(
+                    k.lower() == "retry-after" for k, _ in extra
                 )
-            except (OSError, asyncio.IncompleteReadError, asyncio.TimeoutError):
-                tried.add(r.id)
-                if method in ("GET", "HEAD"):
-                    # idempotent: safe to replay on another replica; a
-                    # non-idempotent request may have reached the backend
-                    # and must not be double-applied
-                    self._m_retries.inc(reason="connect")
+                if is_shed and self.retry_shed:
+                    # a shed refused the work before doing it — retrying on a
+                    # different replica cannot double-process
+                    tried.add(r.id)
+                    last_shed = (status, payload, ctype, extra)
+                    self._m_retries.inc(reason="shed")
+                    if fspan is not None:
+                        _TRACER.record_interval(
+                            "front.retry", time.monotonic(), parent=fspan,
+                            reason="shed", replica=r.id,
+                        )
                     continue
                 self._m_requests.inc(replica=r.id)
-                return (
-                    502,
-                    b'{"status":502,"error":"replica unreachable"}',
-                    "application/json",
-                    (),
-                )
-            is_shed = status == 503 and any(
-                k.lower() == "retry-after" for k, _ in extra
-            )
-            if is_shed and self.retry_shed:
-                # a shed refused the work before doing it — retrying on a
-                # different replica cannot double-process
-                tried.add(r.id)
-                last_shed = (status, payload, ctype, extra)
-                self._m_retries.inc(reason="shed")
-                continue
-            self._m_requests.inc(replica=r.id)
-            return status, payload, ctype, extra
-        if last_shed is not None:
-            # every routable replica shed: surface the backpressure (with
-            # its Retry-After) instead of inventing a different error
+                if fspan is not None:
+                    fspan.attrs["status"] = status
+                    fspan.attrs["replica"] = r.id
+                return status, payload, ctype, extra
+            if last_shed is not None:
+                # every routable replica shed: surface the backpressure (with
+                # its Retry-After) instead of inventing a different error
+                self._m_requests.inc(replica="none")
+                if fspan is not None:
+                    fspan.attrs["status"] = 503
+                return last_shed
             self._m_requests.inc(replica="none")
-            return last_shed
-        self._m_requests.inc(replica="none")
-        return (
-            503,
-            b'{"status":503,"error":"no routable replica"}',
-            "application/json",
-            (("Retry-After", "1"),),
+            if fspan is not None:
+                fspan.attrs["status"] = 503
+            return (
+                503,
+                b'{"status":503,"error":"no routable replica"}',
+                "application/json",
+                (("Retry-After", "1"),),
+            )
+        finally:
+            if fspan is not None:
+                _TRACER.finish(fspan)
+                _TRACER.log_if_slow(fspan, log)
+
+    # -- fleet-scope fan-out endpoints -------------------------------------
+
+    async def _fleet_endpoint(
+        self, method: str, path: str, target: str, accept: str
+    ) -> tuple[int, bytes, str, tuple]:
+        """The two federation endpoints: both fetch every ROUTABLE
+        replica over the pooled backend connections (ejected replicas are
+        skipped — their last-known series/spans are not re-exported as if
+        live), merge, and re-export. Unreachable replicas are skipped and
+        counted (oryx_fleet_federation_errors_total); one dead replica
+        must not fail the whole fleet page."""
+        if method not in ("GET", "HEAD"):
+            return (
+                405,
+                b'{"status":405,"error":"method not allowed"}',
+                "application/json",
+                (),
+            )
+        query = parse_qs(target.partition("?")[2])
+        if path == "/fleet/metrics":
+            # OpenMetrics negotiation passes THROUGH: replicas render the
+            # dialect the client asked the front for, so exemplars (legal
+            # only under OpenMetrics) survive federation verbatim
+            wants_om = "application/openmetrics-text" in accept
+            pages = await self._fetch_routable(
+                "/metrics",
+                b"accept: application/openmetrics-text" if wants_om else b"",
+            )
+            text = federate(pages, openmetrics=wants_om)
+            ctype = (
+                "application/openmetrics-text; version=1.0.0; charset=utf-8"
+                if wants_om else "text/plain; version=0.0.4"
+            )
+            return 200, text.encode("utf-8"), ctype, ()
+        # /fleet/traces: fetch each replica's span forest, add the
+        # front's own, stitch by trace id
+        try:
+            limit = int((query.get("limit") or ["0"])[0])
+        except ValueError:
+            return 400, b'{"status":400,"error":"bad limit"}', "application/json", ()
+        suffix = f"?limit={limit}" if limit > 0 else ""
+        pages = await self._fetch_routable("/debug/traces" + suffix, b"")
+        procs: list[tuple[str, list[dict]]] = [
+            ("front", span_forest(_TRACER.snapshot()))
+        ]
+        for rid, text in pages:
+            try:
+                doc = json.loads(text)
+            except json.JSONDecodeError:
+                self._m_fed_errors.inc(endpoint="/fleet/traces", replica=rid)
+                continue
+            forest = doc.get("traces")
+            if isinstance(forest, list):
+                procs.append((rid, forest))
+        if (query.get("format") or [""])[0] == "chrome":
+            body = json.dumps(stitched_chrome(procs), default=str)
+        else:
+            body = json.dumps(
+                {
+                    "enabled": _TRACER.enabled,
+                    "processes": [label for label, _ in procs],
+                    "traces": stitch_traces(procs),
+                },
+                default=str,
+            )
+        return 200, body.encode("utf-8"), "application/json", ()
+
+    async def _fetch_routable(
+        self, path: str, extra_header: bytes
+    ) -> list[tuple[str, str]]:
+        """GET ``path`` from every routable replica CONCURRENTLY (each on
+        its own pooled connection, under its own backend-timeout — the
+        page costs the slowest replica, never the sum, so a hung
+        not-yet-ejected replica can't stall the whole fleet scrape past
+        Prometheus's scrape_timeout); [(replica id, body text)], failures
+        skipped + counted."""
+        endpoint = path.partition("?")[0]
+
+        async def fetch(r: ReplicaInfo) -> tuple[str, str] | None:
+            try:
+                status, _rhead, payload, _alive = await self._fast_exchange(
+                    r, "GET", path, extra_header, b""
+                )
+            except (OSError, asyncio.IncompleteReadError, asyncio.TimeoutError):
+                self._m_fed_errors.inc(endpoint=endpoint, replica=r.id)
+                return None
+            if status != 200:
+                self._m_fed_errors.inc(endpoint=endpoint, replica=r.id)
+                return None
+            return r.id, payload.decode("utf-8", "replace")
+
+        results = await asyncio.gather(
+            *(fetch(r) for r in self.replicas if r.routable)
         )
+        return [x for x in results if x is not None]
 
     # -- front-local endpoints --------------------------------------------
 

@@ -96,6 +96,13 @@ def replica_overlays(
                 "oryx.monitoring.quarantine.dir": os.path.join(
                     data_root, rid, "quarantine"
                 ),
+                # per-replica flight-recorder ring: the black box the
+                # supervisor harvests from a corpse before restarting it
+                # (common/flightrec.py) — sharing one dir would interleave
+                # every replica's last words
+                "oryx.monitoring.flight.dir": os.path.join(
+                    data_root, rid, "flight"
+                ),
             }
         )
         if shards > 1:
@@ -164,6 +171,10 @@ class FleetSupervisor:
         self._next_restart = 0.0  # guarded-by: _op_lock
         self.crash_looping = False
         self._stopping = threading.Event()
+        # flight artifacts harvested from dead replicas (newest last) —
+        # the crash-loop-last-words paths an operator or chaos assertion
+        # reads back
+        self.harvested: list[str] = []  # guarded-by: _op_lock
 
     # -- topology ----------------------------------------------------------
 
@@ -249,7 +260,7 @@ class FleetSupervisor:
             self._poll_locked()
 
     def _poll_locked(self) -> None:  # oryxlint: holds=_op_lock
-        if self._stopping.is_set() or not self.restart or self.crash_looping:
+        if self._stopping.is_set():
             return
         now = time.monotonic()
         for i, p in enumerate(self.procs):
@@ -257,20 +268,32 @@ class FleetSupervisor:
                 continue
             if not self._death_counted[i]:
                 self._death_counted[i] = True
-                fast = now - self._spawned_at[i] < _FAST_FAIL_S
-                if fast:
-                    self._fast_fails += 1
-                    if self._fast_fails >= self.max_fast_fails:
-                        log.error(
-                            "fleet supervisor: replicas crash-looping "
-                            "(rc=%s); giving up on restarts", p.returncode,
-                        )
-                        self.crash_looping = True
-                        return
-                    self._backoff = min(self._backoff * 2, 30.0)
-                else:
-                    self._fast_fails = 0
-                    self._backoff = 1.0
+                # harvest the corpse's flight ring FIRST — before any
+                # restart decision, and regardless of whether restarts
+                # are even enabled: the black box is the point of
+                # observing a death at all (crash-loop last words)
+                self._harvest_flight(i, p.returncode)
+                # fast-fail accounting stays gated exactly as before:
+                # with restarts off (or already crash-looping) a death is
+                # an operator decision, not a loop to detect
+                if self.restart and not self.crash_looping:
+                    fast = now - self._spawned_at[i] < _FAST_FAIL_S
+                    if fast:
+                        self._fast_fails += 1
+                        if self._fast_fails >= self.max_fast_fails:
+                            log.error(
+                                "fleet supervisor: replicas crash-looping "
+                                "(rc=%s); giving up on restarts",
+                                p.returncode,
+                            )
+                            self.crash_looping = True
+                            return
+                        self._backoff = min(self._backoff * 2, 30.0)
+                    else:
+                        self._fast_fails = 0
+                        self._backoff = 1.0
+            if not self.restart or self.crash_looping:
+                continue
             if now < self._next_restart:
                 continue
             log.warning(
@@ -280,6 +303,34 @@ class FleetSupervisor:
             self._next_restart = now + self._backoff
             self.procs[i] = self._spawn(i)
             self._death_counted[i] = False
+
+    def _harvest_flight(self, i: int, returncode) -> None:  # oryxlint: holds=_op_lock
+        """Pack a dead replica's on-disk flight ring into one harvest
+        artifact (common/flightrec.py) and record the death in the
+        supervisor's OWN flight ring — the corpse's last lifecycle events
+        survive the restart that is about to recycle its identity."""
+        rid = str(self.overlays[i]["oryx.fleet.replica.id"])
+        flight_dir = self.overlays[i].get("oryx.monitoring.flight.dir")
+        path = None
+        try:
+            from oryx_tpu.common import flightrec
+
+            if flight_dir:
+                path = flightrec.harvest(
+                    str(flight_dir), replica=rid, returncode=returncode,
+                )
+            flightrec.get_flightrec().record(
+                kind="replica-death", replica=rid,
+                returncode=returncode, harvest=path or "",
+            )
+        except Exception:  # noqa: BLE001 - the black box never kills poll()
+            log.exception("flight harvest for replica %s failed", rid)
+        if path:
+            self.harvested.append(path)
+            log.warning(
+                "fleet supervisor: harvested flight artifact %s from dead "
+                "replica %s (rc=%s)", path, rid, returncode,
+            )
 
     def request_stop(self) -> None:
         """Signal-handler-safe stop request: run() exits on the next

@@ -286,6 +286,21 @@ class ServingApp:
         from oryx_tpu.common.artifact import configure_artifact_relay
 
         configure_artifact_relay(config)
+        # the flight recorder (on-disk lifecycle ring + snapshot bundler,
+        # common/flightrec.py) and the config-declared SLO burn-rate
+        # gauges (common/slo.py) adopt the same config
+        from oryx_tpu.common.flightrec import configure_flightrec
+        from oryx_tpu.common.slo import ensure_serving_slos
+
+        configure_flightrec(config).record(
+            kind="process-start",
+            role="serving",
+            port=config.get_int("oryx.serving.api.port", 0),
+        )
+        ensure_serving_slos(config)
+        # healthz up->degraded edge detection (note_health_state): the
+        # transition automatically triggers a flight snapshot off-thread
+        self._last_health_degraded = False
         self.started_at = time.monotonic()
         self.loop_count = 1  # the async frontend overwrites with its fan-out
         reg = get_registry()
@@ -456,6 +471,26 @@ class ServingApp:
             tag = f"@{self.replica_id}:{self.listen_port}"
             reasons = [r + tag for r in reasons]
         return reasons
+
+    def note_health_state(self, degraded: bool, reasons: list[str]) -> None:
+        """Edge detector behind the automatic flight snapshot: the FIRST
+        probe that sees up→degraded bundles the black box (events, recent
+        spans, dispatch ring, metrics, config fingerprint) on a one-shot
+        daemon thread — by the time a human looks, the evidence of HOW it
+        degraded is already on disk. Called from the (nonblocking)
+        healthz handler; the cheap path is two attribute touches."""
+        prev = self._last_health_degraded
+        self._last_health_degraded = degraded
+        if degraded and not prev:
+            from oryx_tpu.common.flightrec import get_flightrec
+
+            # record + bundle both happen on the snapshot thread: this
+            # handler runs INLINE on the event loop, and the flight dir's
+            # disk may be exactly what is degrading
+            get_flightrec().snapshot_async(
+                "healthz-degraded",
+                event={"kind": "health-degraded", "reasons": reasons},
+            )
 
     def staleness_age(self) -> float | None:
         """Raw age in seconds of the served model's publish stamp (None

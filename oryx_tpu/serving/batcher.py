@@ -504,10 +504,19 @@ class TopKBatcher:
                 # bound. Raised under the lock so the depth check and the
                 # refusal are one decision; the exception renders as
                 # 503 + Retry-After at the app boundary.
+                from oryx_tpu.common.flightrec import get_flightrec
                 from oryx_tpu.common.metrics import get_registry
                 from oryx_tpu.serving.app import ShedLoad
 
                 get_registry().counter("oryx_serving_shed_total").inc()
+                # flight EPISODE marker: one bounded disk append per 5s
+                # per storm (the episode_s gate is a dict probe on every
+                # other shed), so the black box records that a shed storm
+                # happened without per-request I/O under this lock
+                get_flightrec().record(
+                    kind="shed-episode", episode_s=5.0,
+                    queue_depth=len(self._queue),
+                )
                 raise ShedLoad(
                     f"top-k queue saturated ({len(self._queue)} deep)",
                     retry_after_sec=self.retry_after_sec,

@@ -144,6 +144,9 @@ def register(app: ServingApp) -> None:
                 body["mfu"] = round(mfu, 6)
         except Exception:  # noqa: BLE001 - perf accounting is optional
             pass
+        # up->degraded edge: the first degraded probe snapshots the
+        # flight recorder's black box off-thread (app.py note_health_state)
+        a.note_health_state(bool(degraded), degraded)
         return (503 if degraded else 200), body
 
     @app.route("HEAD", "/healthz", nonblocking=True)
@@ -185,6 +188,35 @@ def register(app: ServingApp) -> None:
                 default=str,
             )
         return RawResponse(200, body.encode("utf-8"), "application/json")
+
+    # NOT nonblocking: bundling renders the whole metrics page and writes
+    # the artifact to disk — worker-thread work, never an event loop's
+    @app.route("GET", "/debug/flight")
+    def debug_flight(a: ServingApp, req: Request):
+        """On-demand flight-recorder snapshot (common/flightrec.py): the
+        recent lifecycle-event ring, finished tracing spans, the
+        perfstats dispatch ring, a /metrics snapshot, and the config
+        fingerprint as ONE downloadable artifact — the same bundle a
+        healthz up→degraded transition writes automatically and the
+        fleet supervisor harvests from a corpse. 403 when the recorder
+        is disabled (oryx.monitoring.flight.enabled = false)."""
+        from oryx_tpu.common.flightrec import get_flightrec
+
+        rec = get_flightrec()
+        if not rec.enabled:
+            raise OryxServingException(
+                403, "flight recorder disabled (oryx.monitoring.flight.enabled)"
+            )
+        bundle, path = rec.snapshot("debug-endpoint")
+        if path:
+            req.response_headers.append((
+                "Content-Disposition",
+                f'attachment; filename="{path.rsplit("/", 1)[-1]}"',
+            ))
+        return RawResponse(
+            200, json.dumps(bundle, default=str).encode("utf-8"),
+            "application/json",
+        )
 
     # NOT nonblocking: the handler sleeps for the capture window — that
     # must park a worker thread, never an event loop

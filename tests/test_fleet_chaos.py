@@ -28,3 +28,15 @@ def test_fleet_kill_zero_non_shed_5xx_and_bounded_staleness(tmp_path):
     doc, fn = chaos.SCENARIOS["fleet-kill"]
     problems = fn(str(tmp_path))
     assert problems == []
+
+
+def test_flight_on_kill_harvests_corpse_last_words(tmp_path):
+    """ISSUE 14 satellite: SIGKILL a replica mid update-storm behind the
+    front — the supervisor must harvest a flight artifact containing the
+    corpse's last lifecycle events (generation adoptions), and the
+    front's ejection flight event must carry the same trace-joinable
+    replica id."""
+    chaos = _chaos_module()
+    doc, fn = chaos.SCENARIOS["flight-on-kill"]
+    problems = fn(str(tmp_path))
+    assert problems == []

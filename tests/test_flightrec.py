@@ -1,0 +1,320 @@
+"""Flight recorder (ISSUE 14): bounded on-disk event ring, episode
+rate-limiting, snapshot bundling, corpse harvesting, the supervisor's
+death-time harvest, the /debug/flight endpoint, and the healthz
+up→degraded automatic snapshot."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from oryx_tpu.common.config import load_config
+from oryx_tpu.common.flightrec import (
+    EVENT_KINDS,
+    FlightRecorder,
+    configure_flightrec,
+    harvest,
+    read_events,
+)
+
+
+def _rec(tmp_path, **overlay) -> FlightRecorder:
+    rec = FlightRecorder()
+    rec.configure(load_config(overlay={
+        "oryx.monitoring.flight.dir": str(tmp_path / "flight"),
+        **overlay,
+    }))
+    return rec
+
+
+def test_record_and_read_round_trip(tmp_path):
+    rec = _rec(tmp_path)
+    assert rec.record(kind="generation", generation=7, lag_s=0.5)
+    assert rec.record(kind="wedge", layer="speed", state="wedged")
+    events = rec.events()
+    assert [e["kind"] for e in events] == ["generation", "wedge"]
+    assert events[0]["generation"] == 7
+    assert events[0]["pid"] == os.getpid()
+    assert events[0]["ts_ms"] > 0
+
+
+def test_replica_id_stamps_every_event(tmp_path):
+    rec = _rec(tmp_path, **{"oryx.fleet.replica.id": "r3"})
+    rec.record(kind="generation", generation=1)
+    assert rec.events()[0]["replica"] == "r3"
+
+
+def test_ring_is_bounded_and_rotates(tmp_path):
+    rec = _rec(tmp_path, **{
+        "oryx.monitoring.flight.segment-bytes": 4096,  # clamp floor
+        "oryx.monitoring.flight.segments": 2,
+    })
+    for i in range(400):
+        rec.record(kind="generation", generation=i)
+    flight = tmp_path / "flight"
+    segs = [p for p in flight.iterdir() if p.name.startswith("events-")]
+    assert len(segs) <= 2
+    assert sum(p.stat().st_size for p in segs) <= 2 * 4096 + 512
+    events = rec.events()
+    gens = [e["generation"] for e in events]
+    assert gens[-1] == 399           # newest survives
+    assert 0 not in gens             # oldest rotated out
+    assert gens == sorted(gens)      # oldest-first read order
+
+
+def test_episode_rate_limit_coalesces_bursts(tmp_path):
+    rec = _rec(tmp_path)
+    assert rec.record(kind="shed-episode", episode_s=60.0, queue_depth=1)
+    for _ in range(10):  # the storm: no further disk writes
+        assert not rec.record(kind="shed-episode", episode_s=60.0, queue_depth=2)
+    assert len([e for e in rec.events() if e["kind"] == "shed-episode"]) == 1
+
+
+def test_disabled_recorder_writes_nothing(tmp_path):
+    rec = _rec(tmp_path, **{"oryx.monitoring.flight.enabled": False})
+    assert not rec.record(kind="generation", generation=1)
+    assert not (tmp_path / "flight").exists()
+
+
+def test_restart_resumes_newest_segment(tmp_path):
+    """A restarted process (or co-resident sibling) continues the ring
+    instead of clobbering segment 0."""
+    a = _rec(tmp_path)
+    a.record(kind="generation", generation=1)
+    b = _rec(tmp_path)  # fresh recorder, same dir
+    b.record(kind="generation", generation=2)
+    assert [e["generation"] for e in read_events(str(tmp_path / "flight"))] == [1, 2]
+
+
+def test_read_events_skips_torn_lines(tmp_path):
+    """A writer that died mid-append leaves a torn tail; the NEXT
+    process's resume repairs it, and reads skip the bad fragment instead
+    of losing the ring."""
+    rec = _rec(tmp_path)
+    rec.record(kind="generation", generation=1)
+    seg = next((tmp_path / "flight").glob("events-*.jsonl"))
+    with open(seg, "a", encoding="utf-8") as f:
+        f.write('{"kind": "torn')  # the crash, mid-append, no newline
+    rec2 = _rec(tmp_path)  # restarted process resumes + repairs
+    rec2.record(kind="generation", generation=2)
+    assert [e["generation"] for e in rec2.events()] == [1, 2]
+
+
+def test_snapshot_bundles_the_black_box(tmp_path):
+    rec = _rec(tmp_path)
+    rec.record(kind="health-degraded", reasons=["model-stale"])
+    bundle, path = rec.snapshot("unit-test", extra={"note": "x"})
+    assert path is not None and os.path.exists(path)
+    on_disk = json.load(open(path, encoding="utf-8"))
+    for doc in (bundle, on_disk):
+        assert doc["trigger"] == "unit-test"
+        assert doc["note"] == "x"
+        assert doc["config_fingerprint"]
+        assert any(e["kind"] == "health-degraded" for e in doc["events"])
+        # the metrics snapshot is the live registry's text exposition
+        assert "oryx_" in doc["metrics"]
+    # the snapshot itself is a recorded lifecycle event
+    assert rec.events()[-1]["kind"] == "snapshot"
+
+
+def test_snapshot_dir_stays_bounded(tmp_path):
+    rec = _rec(tmp_path)
+    for i in range(12):
+        rec.snapshot(f"t{i}")
+    snaps = list((tmp_path / "flight" / "snapshots").glob("*.json"))
+    assert len(snaps) <= 8
+
+
+def test_harvest_packs_a_corpse_ring(tmp_path):
+    rec = _rec(tmp_path)
+    rec.record(kind="generation", generation=9)
+    del rec  # the "corpse": only its files remain
+    path = harvest(str(tmp_path / "flight"), replica="r0", returncode=-9)
+    assert path is not None
+    doc = json.load(open(path, encoding="utf-8"))
+    assert doc["replica"] == "r0" and doc["returncode"] == -9
+    assert any(e["kind"] == "generation" for e in doc["events"])
+
+
+def test_harvest_of_missing_dir_returns_none(tmp_path):
+    assert harvest(str(tmp_path / "never-existed")) is None
+
+
+def test_every_cataloged_kind_is_a_string():
+    for kind, doc in EVENT_KINDS.items():
+        assert isinstance(kind, str) and isinstance(doc, str)
+
+
+# -- supervisor harvest -------------------------------------------------------
+
+
+class _Dead:
+    returncode = -9
+
+    def poll(self):
+        return -9
+
+
+def test_supervisor_harvests_corpse_flight_dir(tmp_path):
+    from oryx_tpu.fleet.supervisor import FleetSupervisor
+
+    cfg = load_config(overlay={
+        "oryx.fleet.replicas": 1,
+        "oryx.fleet.base-port": 9400,
+        "oryx.fleet.data-dir": str(tmp_path / "fleet"),
+    })
+    sup = FleetSupervisor(cfg)
+    # the replica overlay names a per-replica flight dir
+    flight_dir = sup.overlays[0]["oryx.monitoring.flight.dir"]
+    assert str(tmp_path / "fleet") in str(flight_dir)
+    # simulate the corpse's ring: events the dead child already wrote
+    child = FlightRecorder()
+    child.configure(load_config(overlay={
+        "oryx.monitoring.flight.dir": str(flight_dir),
+        "oryx.fleet.replica.id": "r0",
+    }))
+    child.record(kind="generation", generation=42)
+    sup._spawn = lambda i: _Dead()  # type: ignore[assignment]
+    sup.procs[0] = _Dead()
+    sup._spawned_at[0] = time.monotonic()
+    sup.poll()
+    assert len(sup.harvested) == 1
+    doc = json.load(open(sup.harvested[0], encoding="utf-8"))
+    assert doc["replica"] == "r0" and doc["returncode"] == -9
+    assert any(
+        e["kind"] == "generation" and e.get("replica") == "r0"
+        for e in doc["events"]
+    )
+    # the stub respawn "dies" instantly too: its death is a NEW death and
+    # harvests once more — but a corpse waiting out the restart backoff
+    # is never re-harvested by every further poll tick
+    sup.poll()
+    sup.poll()
+    sup.poll()
+    assert len(sup.harvested) == 2
+
+
+def test_supervisor_harvests_even_with_restarts_off(tmp_path):
+    """The crash-loop-last-words path must not depend on the restart
+    policy: a kill that sticks (restart=false, the chaos shape) still
+    harvests."""
+    from oryx_tpu.fleet.supervisor import FleetSupervisor
+
+    cfg = load_config(overlay={
+        "oryx.fleet.replicas": 1,
+        "oryx.fleet.base-port": 9401,
+        "oryx.fleet.data-dir": str(tmp_path / "fleet"),
+        "oryx.fleet.supervisor.restart": False,
+    })
+    sup = FleetSupervisor(cfg)
+    child = FlightRecorder()
+    child.configure(load_config(overlay={
+        "oryx.monitoring.flight.dir": str(sup.overlays[0]["oryx.monitoring.flight.dir"]),
+    }))
+    child.record(kind="process-start", role="serving", port=9401)
+    spawns: list[int] = []
+    sup._spawn = lambda i: spawns.append(i) or _Dead()  # type: ignore[assignment]
+    sup.procs[0] = _Dead()
+    sup._spawned_at[0] = time.monotonic()
+    sup.poll()
+    assert len(sup.harvested) == 1
+    assert spawns == []  # harvested, NOT restarted
+    assert not sup.crash_looping
+
+
+# -- serving integration ------------------------------------------------------
+
+
+class _NoModelManager:
+    def __init__(self, config=None):
+        self.config = config
+
+    def consume(self, it):
+        pass
+
+    def get_model(self):
+        return None
+
+
+def _app(tmp_path, **overlay):
+    from oryx_tpu.serving.app import ServingApp
+
+    cfg = load_config(overlay={
+        "oryx.monitoring.flight.dir": str(tmp_path / "flight"),
+        **overlay,
+    })
+    return ServingApp(cfg, _NoModelManager(cfg), None)
+
+
+def _dispatch(app, method, path, query=None):
+    from oryx_tpu.serving.app import Request
+
+    req = Request(
+        method=method, path=path, params={}, query=query or {},
+        body=b"", headers={},
+    )
+    return app.dispatch(req)
+
+
+def test_serving_app_records_process_start(tmp_path):
+    _app(tmp_path)
+    events = read_events(str(tmp_path / "flight"))
+    assert any(
+        e["kind"] == "process-start" and e.get("role") == "serving"
+        for e in events
+    )
+
+
+def test_debug_flight_endpoint_serves_the_bundle(tmp_path):
+    app = _app(tmp_path)
+    status, body, ctype = _dispatch(app, "GET", "/debug/flight")
+    assert status == 200 and ctype == "application/json"
+    doc = json.loads(body)
+    assert doc["trigger"] == "debug-endpoint"
+    assert any(e["kind"] == "process-start" for e in doc["events"])
+    assert "oryx_serving_requests" in doc["metrics"]
+
+
+def test_debug_flight_403_when_disabled(tmp_path):
+    app = _app(tmp_path, **{"oryx.monitoring.flight.enabled": False})
+    status, body, _ = _dispatch(app, "GET", "/debug/flight")
+    assert status == 403
+
+
+def test_healthz_degraded_transition_snapshots_once(tmp_path):
+    app = _app(tmp_path)
+    app.note_health_state(False, [])
+    app.note_health_state(True, ["model-stale@r1:8101"])   # the EDGE
+    app.note_health_state(True, ["model-stale@r1:8101"])   # steady state: no-op
+    deadline = time.time() + 10
+    snap_dir = tmp_path / "flight" / "snapshots"
+    while time.time() < deadline:
+        if snap_dir.exists() and list(snap_dir.glob("flight-healthz-degraded-*.json")):
+            break
+        time.sleep(0.05)
+    snaps = list(snap_dir.glob("flight-healthz-degraded-*.json"))
+    assert len(snaps) == 1, "exactly one snapshot per up->degraded edge"
+    events = read_events(str(tmp_path / "flight"))
+    degraded = [e for e in events if e["kind"] == "health-degraded"]
+    assert len(degraded) == 1
+    assert degraded[0]["reasons"] == ["model-stale@r1:8101"]
+    # recovery re-arms the edge: the NEXT degradation snapshots again
+    app.note_health_state(False, [])
+    app.note_health_state(True, ["device-down"])
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if len(list(snap_dir.glob("flight-healthz-degraded-*.json"))) >= 2:
+            break
+        time.sleep(0.05)
+    assert len(list(snap_dir.glob("flight-healthz-degraded-*.json"))) == 2
+
+
+def test_configure_flightrec_is_the_servingapp_path(tmp_path):
+    """configure_flightrec redirects the process singleton — the
+    ServingApp constructor path the fleet children take."""
+    rec = configure_flightrec(load_config(overlay={
+        "oryx.monitoring.flight.dir": str(tmp_path / "f2"),
+    }))
+    rec.record(kind="process-start", role="test")
+    assert read_events(str(tmp_path / "f2"))

@@ -973,6 +973,113 @@ def test_precommit_script_clean_exit():
     assert "precommit:" in proc.stdout
 
 
+# -- flight-events vocabulary rule (ISSUE 14) --------------------------------
+
+
+def _flight_fixture(tmp_path, source: str, extra_doc_rows: str = ""):
+    """Fixture tree for the flight-events rule: a module + a docs catalog
+    that (by default) documents every registered kind."""
+    import textwrap as _tw
+
+    from oryx_tpu.common.flightrec import EVENT_KINDS
+    from tools.oryxlint.checkers.consistency import flight_findings
+
+    pkg = tmp_path / "oryx_tpu"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "mod.py").write_text(_tw.dedent(source), encoding="utf-8")
+    docs = tmp_path / "docs"
+    docs.mkdir(exist_ok=True)
+    rows = "\n".join(f"| `{k}` | x | x |" for k in sorted(EVENT_KINDS))
+    (docs / "observability.md").write_text(
+        "# Observability\n\n### Flight-recorder event catalog\n\n"
+        "| Kind | Recorded by | Meaning |\n|---|---|---|\n"
+        + rows + "\n" + extra_doc_rows + "\n\n## Next section\n",
+        encoding="utf-8",
+    )
+    project = Project.load(tmp_path)
+    return flight_findings(tmp_path, project)
+
+
+def test_flight_unregistered_kind_at_call_site_caught(tmp_path):
+    findings = _flight_fixture(tmp_path, """
+        from oryx_tpu.common.flightrec import get_flightrec
+
+        def f():
+            get_flightrec().record(kind="ejectoin", replica="r0")
+    """)
+    assert [f.rule for f in findings] == ["flight-events"]
+    assert "'ejectoin'" in findings[0].message
+    assert findings[0].path == "oryx_tpu/mod.py"
+
+
+def test_flight_registered_kind_passes(tmp_path):
+    findings = _flight_fixture(tmp_path, """
+        from oryx_tpu.common.flightrec import get_flightrec
+
+        def f():
+            get_flightrec().record(kind="ejection", replica="r0", port=1)
+    """)
+    assert findings == []
+
+
+def test_flight_non_literal_kind_skipped(tmp_path):
+    # confident-only, like the dataflow checkers: a kind that arrives
+    # through a variable is not flagged
+    findings = _flight_fixture(tmp_path, """
+        from oryx_tpu.common.flightrec import get_flightrec
+
+        def f(kind):
+            get_flightrec().record(kind=kind)
+    """)
+    assert findings == []
+
+
+def test_flight_doc_row_without_registered_kind_caught(tmp_path):
+    findings = _flight_fixture(
+        tmp_path, "x = 1\n", extra_doc_rows="| `ghost-kind` | x | x |"
+    )
+    assert len(findings) == 1
+    assert "ghost-kind" in findings[0].message
+    assert findings[0].path == "docs/observability.md"
+
+
+def test_flight_missing_doc_row_caught(tmp_path):
+    import textwrap as _tw
+
+    from tools.oryxlint.checkers.consistency import flight_findings
+
+    pkg = tmp_path / "oryx_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("x = 1\n", encoding="utf-8")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(_tw.dedent("""
+        ### Flight-recorder event catalog
+
+        | Kind | x |
+        |---|---|
+        | `ejection` | x |
+    """), encoding="utf-8")
+    findings = flight_findings(tmp_path, Project.load(tmp_path))
+    # every registered kind except `ejection` lacks a docs row
+    from oryx_tpu.common.flightrec import EVENT_KINDS
+
+    assert len(findings) == len(EVENT_KINDS) - 1
+    assert all(f.rule == "flight-events" for f in findings)
+
+
+def test_flight_catalog_and_docs_agree_on_the_real_tree():
+    """Both directions on the committed tree: every registered kind has a
+    docs row and vice versa (the whole-tree gate would catch this too —
+    this pins the section parser itself against doc refactors)."""
+    from oryx_tpu.common.flightrec import EVENT_KINDS
+    from tools.oryxlint.checkers.consistency import flight_doc_kinds
+
+    assert flight_doc_kinds(ROOT / "docs" / "observability.md") == set(
+        EVENT_KINDS
+    )
+
+
 # -- the tier-1 whole-tree gate ----------------------------------------------
 
 

@@ -479,6 +479,143 @@ def fleet_kill(tmp: str) -> list[str]:
     return problems
 
 
+@scenario("flight-on-kill",
+          "SIGKILL a replica mid update-storm behind the fleet front; the "
+          "supervisor must harvest a flight artifact holding the corpse's "
+          "last lifecycle events (generation adoptions), and the front's "
+          "ejection flight event must carry the same trace-joinable "
+          "replica id")
+def flight_on_kill(tmp: str) -> list[str]:
+    import http.client
+    import subprocess
+
+    from oryx_tpu.bus.broker import get_broker, topics
+    from oryx_tpu.common import flightrec
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.common.executil import (
+        config_overlay_from_sets,
+        cpu_subprocess_env,
+        free_port_run,
+    )
+    from oryx_tpu.common.freshness import publish_stamp
+    from oryx_tpu.fleet import FleetFront, FleetSupervisor
+
+    bus = f"file://{os.path.join(tmp, 'bus')}"
+    topics.maybe_create(bus, "OryxInput", 1)
+    topics.maybe_create(bus, "OryxUpdate", 1)
+    broker = get_broker(bus)
+
+    def publish_model(gen: int) -> None:
+        broker.send("OryxUpdate", "MODEL", _fleet_model_message(gen))
+        broker.send("OryxUpdate", "TRACE", publish_stamp(generation=gen))
+
+    publish_model(1)
+
+    base_port = free_port_run(2)
+    front_flight = os.path.join(tmp, "front-flight")
+    sets = [
+        "oryx.id=chaos-flight",
+        f"oryx.input-topic.broker={bus}",
+        f"oryx.update-topic.broker={bus}",
+        "oryx.serving.model-manager-class="
+        "oryx_tpu.apps.als.serving.ALSServingModelManager",
+        'oryx.serving.application-resources='
+        '["oryx_tpu.serving.resources.common",'
+        '"oryx_tpu.serving.resources.als"]',
+        "oryx.serving.api.read-only=true",
+        "oryx.serving.api.loops=1",
+        "oryx.fleet.replicas=2",
+        f"oryx.fleet.base-port={base_port}",
+        f"oryx.fleet.data-dir={os.path.join(tmp, 'fleet')}",
+        # the kill must stick: this scenario asserts the HARVEST, which
+        # poll() performs whether or not it then restarts
+        "oryx.fleet.supervisor.restart=false",
+        "oryx.fleet.front.probe-interval-sec=0.2",
+        "oryx.fleet.front.eject-after=1",
+        # the front process's own flight ring (ejection events land here)
+        f"oryx.monitoring.flight.dir={front_flight}",
+    ]
+    cfg = load_config(overlay=config_overlay_from_sets(sets))
+    argv = [x for s in sets for x in ("--set", s)]
+    problems: list[str] = []
+    sup = FleetSupervisor(
+        cfg, argv=argv, env=cpu_subprocess_env(),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    front = None
+    try:
+        sup.start()
+        sup.wait_listening(90)
+        # both replicas model-ready (they consumed MODEL + its stamp, so
+        # the corpse's flight ring holds a generation event to find)
+        for _, host, port in sup.backends():
+            deadline = time.time() + 60
+            while True:
+                c = http.client.HTTPConnection(host, port, timeout=5)
+                c.request("GET", "/ready")
+                r = c.getresponse()
+                r.read()
+                c.close()
+                if r.status == 200:
+                    break
+                if time.time() > deadline:
+                    raise RuntimeError(f"replica :{port} never became ready")
+                time.sleep(0.3)
+        front = FleetFront(cfg, backends=sup.backends(), port=0)
+        front.start()
+        # a short storm so the corpse dies with FRESH generation events
+        for gen in range(2, 6):
+            publish_model(gen)
+            time.sleep(0.2)
+        sup.kill(0)  # SIGKILL mid-storm
+        # supervisor observes the death and harvests the corpse's ring
+        deadline = time.time() + 30
+        while not sup.harvested:
+            sup.poll()
+            if time.time() > deadline:
+                break
+            time.sleep(0.2)
+        if not sup.harvested:
+            problems.append("supervisor never harvested a flight artifact")
+        else:
+            doc = json.load(open(sup.harvested[-1], encoding="utf-8"))
+            events = doc.get("events") or []
+            if doc.get("replica") != "r0":
+                problems.append(
+                    f"harvest names replica {doc.get('replica')!r}, want r0"
+                )
+            if not any(
+                e.get("kind") == "generation" and e.get("replica") == "r0"
+                for e in events
+            ):
+                problems.append(
+                    "harvested events lack the corpse's generation "
+                    f"adoptions (kinds: {sorted({e.get('kind') for e in events})})"
+                )
+        # the front must eject the corpse AND record a flight event whose
+        # replica id joins the harvest
+        deadline = time.time() + 30
+        dead = next(r for r in front.replicas if r.id == "r0")
+        while dead.routable and time.time() < deadline:
+            time.sleep(0.2)
+        if dead.routable:
+            problems.append("killed replica r0 was never ejected")
+        ejections = [
+            e for e in flightrec.read_events(front_flight)
+            if e.get("kind") == "ejection"
+        ]
+        if not any(e.get("replica") == "r0" for e in ejections):
+            problems.append(
+                f"front flight ring lacks an ejection event for r0: "
+                f"{ejections}"
+            )
+    finally:
+        if front is not None:
+            front.close()
+        sup.stop()
+    return problems
+
+
 def _seq_model_message(n_items: int = 6, dim: int = 8) -> str:
     """A small loadable seq MODEL message (GRU weights + inline item
     embeddings) so the speed manager is past its load fraction before

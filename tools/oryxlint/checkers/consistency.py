@@ -1,5 +1,5 @@
 """Project-consistency checkers (rules ``config-keys``, ``metric-docs``,
-``bench-ratchet``).
+``bench-ratchet``, ``flight-events``).
 
 These absorb the one-off tools this repo grew over PRs 4-8 into the
 checker SPI — the old entry points (tools/check_config.py,
@@ -17,10 +17,17 @@ tools/check_metrics.py) remain as thin CLI wrappers:
   exists in bench.py's output vocabulary, and no ``pending`` row has
   outlived a banked artifact of its platform that measures it
   (tools/check_bench.py owns that artifact scan).
+- ``flight-events``: every flight-recorder ``record(kind="...")`` call
+  site uses a kind registered in the ``EVENT_KINDS`` catalog
+  (oryx_tpu/common/flightrec.py), and every cataloged kind has a row in
+  docs/observability.md's flight-recorder event catalog (both
+  directions) — the config-key/metric-docs pattern applied to the black
+  box, so the event schema cannot drift silently.
 """
 
 from __future__ import annotations
 
+import ast
 import re
 from pathlib import Path
 
@@ -253,6 +260,86 @@ def metric_findings(
     return out
 
 
+# Heading of the docs table the flight-event catalog must mirror; rows
+# under it are parsed until the next heading.
+FLIGHT_DOC_HEADING = "### Flight-recorder event catalog"
+FLIGHT_DOC_ROW = re.compile(r"^\|\s*`([a-z0-9\-]+)`\s*\|")
+
+
+def flight_doc_kinds(doc: Path) -> set[str]:
+    """Event kinds documented in the flight-recorder catalog table (the
+    section between its heading and the next heading)."""
+    kinds: set[str] = set()
+    in_section = False
+    for line in doc.read_text(encoding="utf-8").splitlines():
+        if line.strip().startswith("#"):
+            in_section = line.strip() == FLIGHT_DOC_HEADING
+            continue
+        if in_section:
+            m = FLIGHT_DOC_ROW.match(line)
+            if m:
+                kinds.add(m.group(1))
+    return kinds
+
+
+def _flight_call_kinds(tree: ast.AST) -> list[tuple[int, str]]:
+    """(line, kind) for every ``<recv>.record(kind="literal", ...)`` call
+    in a module. The ``kind=`` keyword with a string-literal value is the
+    flight recorder's signature shape (the method makes it keyword-only);
+    non-literal kinds are skipped — confident-only, like the dataflow
+    checkers."""
+    out: list[tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "record"
+        ):
+            continue
+        for kw in node.keywords:
+            if (
+                kw.arg == "kind"
+                and isinstance(kw.value, ast.Constant)
+                and isinstance(kw.value.value, str)
+            ):
+                out.append((node.lineno, kw.value.value))
+    return out
+
+
+def flight_findings(root: Path, project: Project | None = None) -> list[Finding]:
+    from oryx_tpu.common.flightrec import EVENT_KINDS
+
+    doc = root / "docs" / "observability.md"
+    doc_rel = str(doc.relative_to(root))
+    if not doc.exists():
+        return [Finding(doc_rel, 1, "flight-events", "missing observability.md")]
+    out: list[Finding] = []
+    modules = project.modules if project is not None else []
+    for mod in modules:
+        for line, kind in _flight_call_kinds(mod.tree):
+            if kind not in EVENT_KINDS:
+                out.append(Finding(
+                    mod.relpath, line, "flight-events",
+                    f"{kind!r} is not a registered flight-event kind — add "
+                    "it to EVENT_KINDS (oryx_tpu/common/flightrec.py) and "
+                    f"the {doc_rel} event catalog, or fix the typo",
+                ))
+    doc_kinds = flight_doc_kinds(doc)
+    for kind in sorted(set(EVENT_KINDS) - doc_kinds):
+        out.append(Finding(
+            doc_rel, 1, "flight-events",
+            f"{kind}: registered in EVENT_KINDS but missing from the "
+            f"{doc_rel} flight-recorder event catalog",
+        ))
+    for kind in sorted(doc_kinds - set(EVENT_KINDS)):
+        out.append(Finding(
+            doc_rel, 1, "flight-events",
+            f"{kind}: documented in the {doc_rel} flight-recorder event "
+            "catalog but not registered in EVENT_KINDS",
+        ))
+    return out
+
+
 def ratchet_findings(root: Path) -> list[Finding]:
     import json
 
@@ -317,8 +404,17 @@ class ConsistencyChecker(Checker):
             "vocabulary, and pending rows must not outlive a banked "
             "artifact that measures them"
         ),
+        "flight-events": (
+            "flight-recorder record(kind=...) call sites must use a kind "
+            "registered in EVENT_KINDS, and the docs event catalog must "
+            "match the registry in both directions"
+        ),
     }
-    severities = {"metric-docs": "warning", "bench-ratchet": "warning"}
+    severities = {
+        "metric-docs": "warning",
+        "bench-ratchet": "warning",
+        "flight-events": "warning",
+    }
     fix_hints = {
         "config-keys": (
             "declare the key in common/reference.conf (or read/remove the "
@@ -333,6 +429,11 @@ class ConsistencyChecker(Checker):
             "pending_since, or lock the measured baseline and drop the "
             "pending flag"
         ),
+        "flight-events": (
+            "register the kind in EVENT_KINDS "
+            "(oryx_tpu/common/flightrec.py) and add/remove its row in the "
+            "docs/observability.md flight-recorder event catalog"
+        ),
     }
 
     def check(self, project: Project) -> list[Finding]:
@@ -344,4 +445,5 @@ class ConsistencyChecker(Checker):
         out.extend(config_findings(root, texts))
         out.extend(metric_findings(root, texts))
         out.extend(ratchet_findings(root))
+        out.extend(flight_findings(root, project))
         return out
