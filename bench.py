@@ -698,6 +698,12 @@ def _bench_http_body(sample_rate: float = 1.0) -> None:
         "oryx.monitoring.flight.dir": os.environ.get(
             "ORYX_BENCH_FLIGHT_DIR", ""
         ) or _stage_flight_dir("http-lsh" if lsh else "http"),
+        # live shadow-rescore sampling ON for the stage: the primary
+        # window's own responses feed oryx_live_recall_at_k, reported as
+        # live_recall_at_10 — the runtime quality claim measured under
+        # the same load the qps claim rides
+        "oryx.monitoring.quality.sample-rate": 0.05,
+        "oryx.monitoring.quality.window-sec": 600,
     }
     cfg = load_config(overlay=base_overlay)
     topics.maybe_create("mem://bench", "OryxUpdate", partitions=1)
@@ -1064,6 +1070,16 @@ def _bench_http_body(sample_rate: float = 1.0) -> None:
         "mfu": round(http_mfu, 4) if http_mfu is not None else None,
         "peak_flops": peak,
     }
+    # live shadow-rescore recall of the stage's OWN primary-window
+    # responses (common/qualitystats.py; sampler armed in base_overlay)
+    # — nightly, bench, and the runtime gauge share one recall
+    # vocabulary. None when no sample landed (sampler off / tiny window).
+    from oryx_tpu.common.qualitystats import get_qualitystats
+
+    _qs = get_qualitystats()
+    _qs.flush(5.0)
+    _live = _qs.live_recall()
+    out["live_recall_at_10"] = round(_live, 4) if _live == _live else None
     if lsh:
         # the 437-qps "With LSH" table row was measured on a 32-core Xeon;
         # this host's core count is recorded so the per-core ratio is

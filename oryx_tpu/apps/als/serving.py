@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from oryx_tpu.api import AbstractServingModelManager, ServingModel
 from oryx_tpu.common.config import Config
-from oryx_tpu.common.tracing import get_tracer
+from oryx_tpu.common.tracing import current_span, get_tracer
 from oryx_tpu.ops.als import compute_updated_xu
 from oryx_tpu.apps.als.common import ALSConfig
 from oryx_tpu.serving.app import chain_future
@@ -901,10 +901,29 @@ class ALSServingModel(ServingModel):
 
     # -- queries -----------------------------------------------------------
 
+    def _shadow_sample(
+        self, vec, pairs, how_many, exclude, cosine, mode, trace_id,
+        snapshot_fn,
+    ) -> None:
+        """Offer this served response to the live quality sampler
+        (common/qualitystats.py): a config-gated fraction is re-scored
+        exactly on the sampler's drain thread. Called AFTER the response
+        is final, on the post pool / host-path caller thread — never the
+        batcher dispatcher — and rescorer-filtered responses are skipped
+        (their exact reference would need the rescorer replayed)."""
+        from oryx_tpu.common.qualitystats import get_qualitystats
+
+        get_qualitystats().maybe_sample(
+            vec, pairs, how_many=how_many, exclude=exclude, cosine=cosine,
+            score_mode=mode, trace_id=trace_id, snapshot_fn=snapshot_fn,
+        )
+
     def _top_n_plan(self, user_vector, how_many, exclude, rescorer, cosine):
         """Shared front half of top_n/top_n_async: either ("done", pairs)
         for paths resolved synchronously on the host, or
         ("fut", batcher_future, post_fn) for the device path."""
+        span = current_span()
+        trace_id = span.trace_id if span is not None else None
         if self.sample_rate < 1.0:
             # LSH candidate subsampling: score only items whose partition is
             # within the Hamming ball of the query's (the reference's
@@ -944,7 +963,21 @@ class ALSServingModel(ServingModel):
                     scores = cosine_scale(scores, norms)
                 vals, top = select_topk(scores, min(k, rows.size))
                 idx = rows[top]
-            return "done", _trim_pairs(vals, idx, ids, how_many, exclude, rescorer)
+            pairs = _trim_pairs(vals, idx, ids, how_many, exclude, rescorer)
+            if rescorer is None and pairs:
+                # LSH live recall: the exact reference is a fresh full-
+                # store snapshot, taken on the sampler's drain thread
+                store = self.state.y
+
+                def lsh_snapshot():
+                    mat, snap_ids, _v = store.snapshot()
+                    return np.asarray(mat, dtype=np.float32), snap_ids, len(snap_ids)
+
+                self._shadow_sample(
+                    user_vector, pairs, how_many, exclude, cosine, "lsh",
+                    trace_id, lsh_snapshot,
+                )
+            return "done", pairs
 
         host_norms = None
         if cosine:
@@ -971,6 +1004,19 @@ class ALSServingModel(ServingModel):
         )
 
         def _post(result):
+            pairs = _post_pairs(result)
+            if rescorer is None and pairs:
+                # device-path live recall: the exact reference is the
+                # row-aligned host mirror the response was re-ranked
+                # against (no copy; the drain reads it by reference)
+                self._shadow_sample(
+                    user_vector, pairs, how_many, exclude, cosine,
+                    self._effective_mode, trace_id,
+                    lambda: (host_mat, ids, n),
+                )
+            return pairs
+
+        def _post_pairs(result):
             vals, idx = result
             vals, idx = np.asarray(vals), np.asarray(idx)
             if int(y.shape[0]) > n:

@@ -40,6 +40,12 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
         self.min_fraction = config.get_float("oryx.speed.min-model-load-fraction", 0.8)
         self.state: ALSState | None = None
         self._not_ready_log = RateLimitCheck(60.0)
+        # the speed tier sees the raw event stream: it feeds the live
+        # input sketch the drift gauges compare against the served
+        # generation's training profile (common/qualitystats.py)
+        from oryx_tpu.common.qualitystats import configure_qualitystats
+
+        configure_qualitystats(config)
 
     # -- update-topic consumption ------------------------------------------
 
@@ -70,6 +76,11 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
         users, items, vals, tss = parse_events(new_data)
         if len(vals) == 0:
             return []
+        # input drift: fold this micro-batch's item events into the live
+        # windowed sketch (one hash per event, micro-batch granularity)
+        from oryx_tpu.common.qualitystats import get_qualitystats
+
+        get_qualitystats().note_input_events(items, tss)
         # same strength transform the batch model was trained with — folding
         # raw strengths into a log1p-trained model would overweight them
         agg = aggregate_interactions(

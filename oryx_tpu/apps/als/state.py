@@ -13,6 +13,7 @@ lazily (version-stamped) — queries are one matmul + top_k over the arena.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import NamedTuple
 
@@ -398,6 +399,29 @@ class ALSState:
 # shared update-topic consumption (speed + serving tiers)
 # ---------------------------------------------------------------------------
 
+def _adopt_quality_profile(art, item_ids) -> None:
+    """Hand the artifact's training profile (qualityProfile extension,
+    stamped by the batch tier) to the live quality tracker so this
+    process's drift gauges compare against the generation it now serves.
+    Best-effort: a model without a profile just reads NaN drift."""
+    try:
+        prof = art.get_extension("qualityProfile", None)
+        if not prof:
+            return
+        from oryx_tpu.common.qualitystats import (
+            TrainingProfile, get_qualitystats,
+        )
+
+        qs = get_qualitystats()
+        qs.set_training_profile(TrainingProfile.from_json(prof))
+        if item_ids:
+            qs.note_catalog(item_ids)
+    except Exception:  # noqa: BLE001 - drift telemetry never fails a model load
+        logging.getLogger(__name__).warning(
+            "could not adopt quality profile", exc_info=True
+        )
+
+
 def apply_update_message(
     state: ALSState | None,
     key: str | None,
@@ -461,6 +485,7 @@ def apply_update_message(
             if with_known_items:
                 for u, items in art.content.get("knownItems", {}).items():
                     state.add_known_items(u, items)
+        _adopt_quality_profile(art, yids)
     elif key == "UP":
         if state is None:
             return None  # updates before any model: nothing to apply to

@@ -222,6 +222,9 @@ class SeqUpdate(MLUpdate):
         self._m_epochs.set(epochs)
         return model, epochs, vocab
 
+    def eval_metric_name(self) -> str:
+        return "hit_rate_at_10"
+
     def _artifact_from_model(self, model, hyperparams: dict[str, Any]) -> ModelArtifact:
         art = ModelArtifact(
             "seq",
@@ -232,12 +235,50 @@ class SeqUpdate(MLUpdate):
             tensors={"E": model.e, **model.params},
         )
         art.set_extension("ItemIDs", list(model.item_ids))
+        self._attach_quality_profile(art, model)
         return art
+
+    def _attach_quality_profile(self, art: ModelArtifact, model) -> None:
+        """Stamp the generation's training profile (the ALS pattern,
+        apps/als/batch.py): the window's item-event sketch + event rate,
+        new-item fraction vs the previous generation's vocabulary, and a
+        sample of hidden-state·Eᵀ scores for prediction-drift. Never
+        fails a build."""
+        try:
+            from oryx_tpu.common.qualitystats import build_training_profile
+
+            items, tss = getattr(self, "_window_events", (None, None))
+            if items is None or len(items) == 0:
+                return
+            e = np.asarray(model.e)
+            scores = None
+            if len(e):
+                # same statistic as the live side (mean of served top-k):
+                # sampled embedding rows stand in for hidden states (the
+                # speed tier blends targets toward h with magnitudes
+                # matched to trained row norms, so e-rows are the honest
+                # cheap proxy), scored over the whole vocabulary
+                rng = np.random.default_rng(7)
+                h = e[rng.integers(0, len(e), 32)]
+                k = min(10, len(e))
+                full = h @ e.T
+                part = -np.partition(-full, k - 1, axis=1)[:, :k]
+                scores = part.mean(axis=1)
+            profile = build_training_profile(
+                items,
+                timestamps_ms=tss,
+                prev_item_ids=self._prev_item_ids,
+                scores=scores,
+            )
+            art.set_extension("qualityProfile", profile.to_json())
+        except Exception:  # noqa: BLE001 - the profile must never fail a build
+            log.warning("seq quality profile build failed", exc_info=True)
 
     def build_model(
         self, train: Sequence[KeyMessage], hyperparams: dict[str, Any]
     ) -> ModelArtifact:
         users, sess, items, tss = parse_session_events(train)
+        self._window_events = (items, tss)  # quality-profile window inputs
         sessions = item_sequences(
             sessionize(users, sess, items, tss,
                        max_events=self.seq.max_session_events)
@@ -476,6 +517,7 @@ class SeqUpdate(MLUpdate):
         t_merge = time.monotonic()
         train_msgs, test_msgs = self.split_train_test(list(new_data))
         users, sess, items, tss = self._parse_to_str(train_msgs)
+        self._window_events = (items, tss)  # quality-profile window inputs
         if pending is not None and len(pending[3]):
             # the previous generation's holdout is persisted history the
             # from-scratch path would train on: fold it in now
@@ -574,6 +616,7 @@ class SeqUpdate(MLUpdate):
 
         root = Path(strip_scheme(model_dir))
         staged = art.write(mkdirs(root / ".incremental") / str(timestamp_ms))
+        self.note_eval(score)  # the stamp carries this generation's hit-rate
         self.promote_and_publish(staged, root, timestamp_ms, update_producer)
         delete_recursively(root / ".incremental")
         self._prev_item_ids = list(model.item_ids)

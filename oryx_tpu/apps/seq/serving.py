@@ -205,6 +205,10 @@ class SeqServingModel(ServingModel):
         if n == 0:
             out.set_result([])
             return out
+        from oryx_tpu.common.tracing import current_span
+
+        span = current_span()
+        trace_id = span.trace_id if span is not None else None
         k = min(n, how_many + len(exclude) + 8)
         fut = TopKBatcher.shared().submit_nowait(
             h, k, y_dev, host_mat=host_mat, valid_rows=n,
@@ -239,6 +243,19 @@ class SeqServingModel(ServingModel):
                 pairs.append([ident, float(vals[j])])
                 if len(pairs) == how_many:
                     break
+            if pairs:
+                # live recall: offer the served page to the shadow
+                # rescore sampler (post-pool thread, never the batcher
+                # dispatcher; the exact reference is the row-aligned
+                # host mirror, read by reference on the drain thread)
+                from oryx_tpu.common.qualitystats import get_qualitystats
+
+                get_qualitystats().maybe_sample(
+                    np.asarray(h, dtype=np.float32), pairs,
+                    how_many=how_many, exclude=exclude,
+                    score_mode="exact", trace_id=trace_id,
+                    snapshot_fn=lambda: (host_mat, ids, n),
+                )
             return pairs
 
         return chain_future(fut, _post, executor=post_pool())

@@ -61,6 +61,12 @@ class SeqSpeedModelManager(AbstractSpeedModelManager):
             "Sessions (new or extended) the seq speed tier folded into "
             "the serving state as item-embedding row deltas",
         )
+        # the speed tier sees the raw event stream: it feeds the live
+        # input sketch the drift gauges compare against the served
+        # generation's training profile (common/qualitystats.py)
+        from oryx_tpu.common.qualitystats import configure_qualitystats
+
+        configure_qualitystats(config)
 
     # -- update-topic consumption ------------------------------------------
 
@@ -84,6 +90,11 @@ class SeqSpeedModelManager(AbstractSpeedModelManager):
         users, sess, items, tss = parse_session_events(new_data)
         if len(tss) == 0:
             return []
+        # input drift: fold this micro-batch's item events into the live
+        # windowed sketch (one hash per event, micro-batch granularity)
+        from oryx_tpu.common.qualitystats import get_qualitystats
+
+        get_qualitystats().note_input_events(items, tss)
         window = self.seq.window
         # transitions: (context item lists, target item), context = the
         # remembered tail + this window's not-yet-folded items. The tails

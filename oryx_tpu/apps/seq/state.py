@@ -109,6 +109,9 @@ def apply_seq_update(
             state.recount()
         else:
             state.set_expected(state.items.ids())
+        from oryx_tpu.apps.als.state import _adopt_quality_profile
+
+        _adopt_quality_profile(art, item_ids)
         e = art.tensors.get("E") if art.tensors else None
         if e is not None and item_ids and len(e) == len(item_ids):
             state.items.bulk_set(item_ids, np.asarray(e, dtype=np.float32))

@@ -168,6 +168,12 @@ def _parse_args(argv):
         "topic as a MODEL so running speed/serving layers pick it up",
     )
     p.add_argument(
+        "--kind", action="append", default=None, metavar="EVENT_KIND",
+        help="flight: only print events of these kinds (repeatable) — "
+        "reading a ring for just quality-alarm/ejection events is the "
+        "debugging loop those events exist for",
+    )
+    p.add_argument(
         "--set",
         action="append",
         default=[],
@@ -346,7 +352,7 @@ def cmd_config(config: Config) -> int:
     return 0
 
 
-def cmd_flight(config: Config) -> int:
+def cmd_flight(config: Config, kinds: list[str] | None = None) -> int:
     """Print the configured flight-recorder ring as JSONL, oldest first —
     the offline face of GET /debug/flight: works on a CORPSE's dir (the
     process that wrote it need not be alive), so an operator reads a
@@ -354,16 +360,34 @@ def cmd_flight(config: Config) -> int:
 
         python -m oryx_tpu.cli flight \\
             --set oryx.monitoring.flight.dir=/tmp/oryx_tpu/fleet/r0/flight
-    """
-    from oryx_tpu.common.flightrec import read_events
 
+    ``--kind`` (repeatable) filters to just those event kinds — the
+    incident loop is usually "show me the quality-alarm and ejection
+    events", not the whole ring. Unknown kinds fail loudly instead of
+    silently printing nothing."""
+    from oryx_tpu.common.flightrec import EVENT_KINDS, read_events
+
+    if kinds:
+        unknown = sorted(set(kinds) - set(EVENT_KINDS))
+        if unknown:
+            print(
+                f"unknown flight event kind(s): {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(EVENT_KINDS))})",
+                file=sys.stderr,
+            )
+            return 2
     flight_dir = config.get_string(
         "oryx.monitoring.flight.dir", "file:/tmp/oryx_tpu/flight"
     )
     events = read_events(flight_dir)
+    total = len(events)
+    if kinds:
+        wanted = set(kinds)
+        events = [ev for ev in events if ev.get("kind") in wanted]
     for ev in events:
         print(json.dumps(ev))
-    print(f"# {len(events)} event(s) in {flight_dir}", file=sys.stderr)
+    tail = f" ({total} total)" if kinds else ""
+    print(f"# {len(events)} event(s) in {flight_dir}{tail}", file=sys.stderr)
     return 0
 
 
@@ -1145,13 +1169,14 @@ def main(argv=None) -> int:
         raw = list(argv if argv is not None else sys.argv[1:])
         raw.remove("serving")
         return cmd_serving(config, raw)
+    if args.command == "flight":
+        return cmd_flight(config, args.kind)
     return {
         "batch": cmd_batch,
         "speed": cmd_speed,
         "setup": cmd_setup,
         "tail": cmd_tail,
         "input": cmd_input,
-        "flight": cmd_flight,
     }[args.command](config)
 
 

@@ -66,6 +66,15 @@ EVENT_KINDS: dict[str, str] = {
     "replica-death": "the fleet supervisor observed a replica corpse",
     "snapshot": "a flight snapshot bundle was written",
     "bench-stage": "a bench stage/phase lifecycle marker",
+    "quality-alarm": (
+        "live model quality degraded: the quality SLO's fast burn rate "
+        "crossed the alarm threshold while windowed live recall sat "
+        "below the floor"
+    ),
+    "drift-alarm": (
+        "live input/prediction drift against the served generation's "
+        "training profile crossed the alarm threshold"
+    ),
 }
 
 _SEGMENT_PREFIX = "events-"
@@ -111,7 +120,14 @@ class FlightRecorder:
         raw_dir = config.get_string(
             "oryx.monitoring.flight.dir", "file:/tmp/oryx_tpu/flight"
         )
-        self.dir = _strip_scheme(raw_dir) if raw_dir else None
+        new_dir = _strip_scheme(raw_dir) if raw_dir else None
+        if new_dir != self.dir:
+            # a different dir is a different ring: episode rate-limit
+            # state from the old ring must not suppress the new ring's
+            # first events (an episode marker the new ring never saw)
+            with self._lock:
+                self._last_episode.clear()
+        self.dir = new_dir
         self.segment_bytes = max(
             4096,
             config.get_int(

@@ -50,12 +50,23 @@ FRESHNESS_BUCKETS = (
 )
 
 
-def publish_stamp(generation: int | None = None) -> str:
+def publish_stamp(
+    generation: int | None = None, quality: dict | None = None
+) -> str:
     """Serialize a publish-time stamp. Carries the publisher's current
-    span context (the batch generation's span) when tracing is on."""
+    span context (the batch generation's span) when tracing is on, and
+    the generation's eval scorecard (``quality``: metric name -> value,
+    e.g. ``{"auc": 0.87}``) so every consuming tier can report what the
+    batch harness measured for the model it is serving."""
     stamp: dict = {"published_ms": int(time.time() * 1000)}
     if generation is not None:
         stamp["generation"] = generation
+    if quality:
+        stamp["quality"] = {
+            str(k): float(v)
+            for k, v in quality.items()
+            if isinstance(v, (int, float)) and v == v
+        }
     ctx = tracing.current_span()
     if ctx is not None:
         stamp["traceparent"] = tracing.format_traceparent(
@@ -90,7 +101,18 @@ class ModelFreshness:
         self.generation: int | None = None
         self.published_ms: float | None = None
         self.loaded_ms: float | None = None
+        # the served generation's eval scorecard from its publish stamp
+        # (metric name -> value), None until a quality-stamped model loads
+        self.quality: dict | None = None
         reg = get_registry()
+        self._g_quality = reg.gauge(
+            "oryx_generation_quality",
+            "Eval metrics the batch harness measured for the model "
+            "generation currently being served (from the publish stamp's "
+            "quality scorecard), by metric name (e.g. auc, "
+            "hit_rate_at_10)",
+            labeled=True,
+        )
         self._h_lag = reg.histogram(
             "oryx_update_to_serve_seconds",
             "Lag from model publish on the update topic to swapped in for "
@@ -186,10 +208,31 @@ class ModelFreshness:
         lag_s = max(0.0, (now_ms - published_ms) / 1000.0)
         self._h_lag.observe(lag_s)
         gen = stamp.get("generation")
+        quality = stamp.get("quality")
+        quality = {
+            str(k): float(v)
+            for k, v in quality.items()
+            if isinstance(v, (int, float))
+        } if isinstance(quality, dict) else None
         with self._lock:
             self.generation = int(gen) if isinstance(gen, (int, float)) else None
             self.published_ms = published_ms
             self.loaded_ms = now_ms
+            self.quality = quality
+        # the scorecard gauge describes exactly the generation being
+        # served: drop the previous generation's series first, so a
+        # card-less generation doesn't silently keep exporting its
+        # predecessor's numbers
+        self._g_quality.clear_values()
+        if quality:
+            for metric, value in quality.items():
+                self._g_quality.set(value, metric=metric)
+        # generation boundary for the live-quality sample windows: the
+        # shadow recall/score windows describe the OLD generation's
+        # answers and must not be attributed to this one
+        from oryx_tpu.common.qualitystats import get_qualitystats
+
+        get_qualitystats().note_generation(self.generation)
         tr = tracing.get_tracer()
         if tr.enabled:
             parent = tracing.parse_traceparent(stamp.get("traceparent"))

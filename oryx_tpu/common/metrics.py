@@ -242,6 +242,15 @@ class Gauge:
         with self._lock:
             self._fns[_label_key(labels)] = fn
 
+    def clear_values(self) -> None:
+        """Drop every set() series (callback-bound series stay) — for a
+        gauge whose label sets enumerate state that was wholly replaced,
+        e.g. the served generation's quality-scorecard metrics: a new
+        generation without some metric must not keep exporting its
+        predecessor's value under that label."""
+        with self._lock:
+            self._values.clear()
+
     def value(self, **labels: str) -> float:
         key = _label_key(labels)
         with self._lock:  # snapshot like render(); see Counter.value

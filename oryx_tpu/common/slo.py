@@ -19,6 +19,10 @@ hot path):
 - ``front-availability``: fraction of
   ``oryx_fleet_front_requests_total`` answered by a replica
   (``replica="none"`` means the client saw the front's own 503).
+- ``quality``: fraction of shadow-rescored responses
+  (``common/qualitystats.py``) whose measured recall held the
+  ``oryx.monitoring.slo.quality.recall-floor`` — the live model-quality
+  objective a degraded generation burns.
 
 Exported as ``oryx_slo_burn_rate{slo,window}`` and
 ``oryx_slo_error_budget_remaining{slo}``. A burn rate of 1.0 means
@@ -63,6 +67,10 @@ class SloTracker:
         self.slow_s = slow_s
         self._lock = threading.Lock()
         self._samples: deque[tuple[float, float, float]] = deque()  # guarded-by: _lock
+        # last source-read failure, surfaced on /fleet/status so broken
+        # SLO math (a renamed counter, a raising callback) can't hide
+        # behind a silently-flat burn rate
+        self.last_error: str | None = None
 
     def reconfigure(
         self, objective: float, fast_s: float, slow_s: float
@@ -78,7 +86,11 @@ class SloTracker:
                 return
             try:
                 total, bad = self.source()
-            except Exception:  # noqa: BLE001 - a scrape never fails on SLO math
+            except Exception as e:  # noqa: BLE001 - a scrape never fails on SLO math
+                # ...but it must never fail SILENTLY either: count it and
+                # keep the last error readable (/fleet/status slo_errors)
+                self.last_error = f"{type(e).__name__}: {e}"
+                _sample_errors().inc(slo=self.slo)
                 return
             self._samples.append((now, float(total), float(bad)))
             horizon = now - self.slow_s * 1.25 - 60.0
@@ -121,6 +133,29 @@ class SloTracker:
         if budget <= 0:
             return 1.0
         return 1.0 - self._bad_fraction(self.slow_s) / budget
+
+
+def _sample_errors():
+    """The (lazily registered) sample-error counter: one series per SLO
+    whose source read raised during a scrape."""
+    return get_registry().counter(
+        "oryx_slo_sample_errors_total",
+        "SLO source reads that raised during burn-rate sampling, by SLO "
+        "— a nonzero rate means that SLO's burn math is running on stale "
+        "samples (see /fleet/status slo_errors for the last error)",
+        labeled=True,
+    )
+
+
+def sample_errors() -> dict[str, str]:
+    """slo -> last source-read error string, for every tracker that has
+    one (the /fleet/status surface of the error counter)."""
+    with _trackers_lock:
+        return {
+            name: t.last_error
+            for name, t in _trackers.items()
+            if t.last_error
+        }
 
 
 # -- sources over the existing metric families ------------------------------
@@ -228,6 +263,34 @@ def ensure_serving_slos(config) -> None:
         "serving-latency",
         config.get_float("oryx.monitoring.slo.latency.objective", 0.99),
         _serving_latency(threshold),
+        fast_s, slow_s,
+    )
+
+
+def _quality_source() -> tuple[float, float]:
+    """(shadow samples, samples below the recall floor) — cumulative
+    totals the live quality sampler (common/qualitystats.py) counts."""
+    reg = get_registry()
+    total = sum(reg.counter("oryx_quality_samples_total").series().values())
+    bad = sum(
+        reg.counter("oryx_quality_bad_samples_total").series().values()
+    )
+    return total, bad
+
+
+def ensure_quality_slo(config) -> None:
+    """Register the live model-quality SLO (called by the quality
+    sampler's configure when shadow sampling is on): a shadow sample is
+    bad when its measured recall fell below the configured floor, so the
+    burn rate answers "is the served model's live quality degrading
+    faster than the objective allows" — the canary gate's quality leg."""
+    if not config.get_bool("oryx.monitoring.slo.enabled", True):
+        return
+    fast_s, slow_s = _windows(config)
+    _ensure(
+        "quality",
+        config.get_float("oryx.monitoring.slo.quality.objective", 0.95),
+        _quality_source,
         fast_s, slow_s,
     )
 

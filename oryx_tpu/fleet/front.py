@@ -132,6 +132,13 @@ class ReplicaInfo:
         self.mfu: float | None = None
         self.update_lag: int | None = None
         self.shards: int | None = None
+        # the replica's live-quality scorecard from its /healthz body
+        # (windowed shadow-rescore recall, generation eval metrics,
+        # drift) — federated verbatim into /fleet/status so trained-vs-
+        # live skew is visible fleet-wide
+        self.quality: dict | None = None
+        # the replica's own SLO source-read failures (slo -> last error)
+        self.slo_errors: dict | None = None
         self.last_reasons: list[str] = []
 
     def snapshot(self) -> dict:
@@ -150,6 +157,8 @@ class ReplicaInfo:
             "mfu": _finite_or_none(self.mfu),
             "update_lag": self.update_lag,
             "shards": self.shards,
+            "quality": self.quality,
+            "slo_errors": self.slo_errors,
             "degraded": self.last_reasons,
         }
 
@@ -416,6 +425,10 @@ class FleetFront(AsyncHTTPServer):
                 int(sh) if isinstance(sh, (int, float))
                 else (1 if status in (200, 503) else None)
             )
+            q = body.get("quality")
+            r.quality = q if isinstance(q, dict) else None
+            se = body.get("slo_errors")
+            r.slo_errors = se if isinstance(se, dict) else None
             r.last_reasons = [str(x) for x in body.get("degraded") or []]
         if r.generation is not None:
             self._g_gen.set(float(r.generation), replica=r.id)
@@ -1229,10 +1242,16 @@ class FleetFront(AsyncHTTPServer):
             text = get_registry().render_prometheus()
             return 200, text.encode("utf-8"), "text/plain; version=0.0.4", ()
         if path == "/fleet/status" and method in ("GET", "HEAD"):
+            from oryx_tpu.common import slo
+
             body = json.dumps(
                 {
                     "policy": self.policy,
                     "shards": self.expect_shards,
+                    # SLO source reads that raised (slo -> last error):
+                    # broken burn-rate math must be visible, not a
+                    # silently flat gauge (oryx_slo_sample_errors_total)
+                    "slo_errors": slo.sample_errors(),
                     "replicas": [r.snapshot() for r in self.replicas],
                 }
             )

@@ -132,6 +132,24 @@ class MLUpdate(BatchLayerUpdate):
         """Config-valued hyperparameter ranges (name -> scalar/list/dict)."""
         return {}
 
+    def eval_metric_name(self) -> str:
+        """Name of the number ``evaluate`` returns (e.g. "auc",
+        "hit_rate_at_10") — the label the generation's quality scorecard
+        carries through the publish stamp into
+        ``oryx_generation_quality{metric}`` on every consuming tier."""
+        return "score"
+
+    def note_eval(self, score: float | None) -> None:
+        """Remember the winning candidate's eval score so the publish
+        stamp that follows can carry the generation's scorecard. Every
+        publish path (candidate search, app incremental_update
+        overrides) calls this just before promote_and_publish; a
+        non-finite score clears the card instead of stamping a lie."""
+        if score is not None and np.isfinite(score):
+            self._last_eval = {self.eval_metric_name(): float(score)}
+        else:
+            self._last_eval = None
+
     def split_train_test(
         self, data: Sequence[KeyMessage]
     ) -> tuple[Sequence[KeyMessage], Sequence[KeyMessage]]:
@@ -358,6 +376,9 @@ class MLUpdate(BatchLayerUpdate):
                 best_i, paths[best_i], cand_root, pod_groups
             )
 
+        # the winner's eval rides the publish stamp as the generation's
+        # quality scorecard (best_score is -inf on a NaN-tolerant pick)
+        self.note_eval(best_score if np.isfinite(best_score) else None)
         model = self.promote_and_publish(
             paths[best_i], root, timestamp_ms, update_producer
         )
@@ -534,4 +555,10 @@ class MLUpdate(BatchLayerUpdate):
             generation = int(Path(model_path).name)
         except (TypeError, ValueError):
             generation = None
-        producer.send("TRACE", publish_stamp(generation=generation))
+        producer.send(
+            "TRACE",
+            publish_stamp(
+                generation=generation,
+                quality=getattr(self, "_last_eval", None),
+            ),
+        )

@@ -298,6 +298,12 @@ class ServingApp:
             port=config.get_int("oryx.serving.api.port", 0),
         )
         ensure_serving_slos(config)
+        # live model-quality plane (common/qualitystats.py): shadow
+        # rescore sampling of served responses, drift gauges, and the
+        # quality SLO — adopt the same config and pre-register families
+        from oryx_tpu.common.qualitystats import configure_qualitystats
+
+        configure_qualitystats(config)
         # healthz up->degraded edge detection (note_health_state): the
         # transition automatically triggers a flight snapshot off-thread
         self._last_health_degraded = False

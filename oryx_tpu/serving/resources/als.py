@@ -330,6 +330,12 @@ def register(app: ServingApp) -> None:
         st = model.state
         known = st.known_items_snapshot()
         mb = (st.x.nbytes() + st.y.nbytes()) / 1e6
+        # MEASURED live recall beside the configured sample rate: the
+        # knob says what was asked for, the shadow-rescore window says
+        # what the traffic actually got (n/a before the first sample)
+        from oryx_tpu.common.qualitystats import get_qualitystats
+
+        live = get_qualitystats().live_recall()
         return [
             ("users (X rows)", len(st.x)),
             ("items (Y rows)", len(st.y)),
@@ -338,6 +344,7 @@ def register(app: ServingApp) -> None:
             ("users with known items", len(known)),
             ("known-item pairs", sum(len(s) for s in known.values())),
             ("LSH sample rate", model.sample_rate),
+            ("live recall@10 (measured)", f"{live:.4f}" if live == live else "n/a"),
             ("host factor arenas", f"{mb:.1f} MB"),
         ]
 

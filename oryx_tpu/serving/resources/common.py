@@ -144,6 +144,27 @@ def register(app: ServingApp) -> None:
                 body["mfu"] = round(mfu, 6)
         except Exception:  # noqa: BLE001 - perf accounting is optional
             pass
+        try:
+            from oryx_tpu.common.qualitystats import get_qualitystats
+
+            # live quality scorecard: windowed shadow-rescore recall,
+            # sample/drop accounting, the served generation's stamped
+            # eval metrics, and drift vs its training profile — the
+            # fleet front's prober copies this into /fleet/status
+            body["quality"] = get_qualitystats().healthz_section()
+        except Exception:  # noqa: BLE001 - a probe never 500s on quality
+            pass
+        try:
+            from oryx_tpu.common import slo
+
+            # SLO source reads that raised in THIS process (slo -> last
+            # error): federated per replica into /fleet/status so broken
+            # burn math is visible fleet-wide, not just on the front
+            errs = slo.sample_errors()
+            if errs:
+                body["slo_errors"] = errs
+        except Exception:  # noqa: BLE001 - a probe never 500s on slo state
+            pass
         # up->degraded edge: the first degraded probe snapshots the
         # flight recorder's black box off-thread (app.py note_health_state)
         a.note_health_state(bool(degraded), degraded)

@@ -358,11 +358,20 @@ def test_front_originates_and_injects_traceparent(tmp_path):
         tp = a.seen_traceparent["/x/join"]
         assert tp is not None and tp.split("-")[1] == client_trace
         assert tp.split("-")[2] != "ab" * 8  # the front's hop, not the client's
-        # the front's own ring now holds the joined front.route tree
-        spans = [
-            s for s in get_tracer().snapshot() if s.trace_id == client_trace
-        ]
-        assert {s.name for s in spans} >= {"front.route", "front.proxy"}
+        # the front's own ring now holds the joined front.route tree.
+        # Bounded wait: the route span finishes in the handler's finally
+        # AFTER the response bytes drain, so a client can legitimately
+        # read the full response a tick before the span lands in the ring
+        deadline = time.time() + 5
+        while True:
+            spans = [
+                s for s in get_tracer().snapshot()
+                if s.trace_id == client_trace
+            ]
+            if {s.name for s in spans} >= {"front.route", "front.proxy"}:
+                break
+            assert time.time() < deadline, {s.name for s in spans}
+            time.sleep(0.02)
         # /fleet/traces stitches the stub's foreign spans + the front's
         status, _, body = _get(front.port, "/fleet/traces")
         doc = json.loads(body)

@@ -1,0 +1,543 @@
+"""Live model-quality observability (ISSUE 15): shadow rescore
+sampling, drift detection, per-generation scorecards, the quality SLO,
+and the degraded-model chaos loop.
+
+The acceptance shape: a corrupted generation must drop the MEASURED
+live recall below the floor, burn the quality SLO, and land a
+quality-alarm flight event with the generation id — while sampling
+stays provably off the request path (a saturated shadow queue drops
+samples, never requests)."""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+
+import numpy as np
+import pytest
+
+from oryx_tpu.common.config import load_config
+from oryx_tpu.common.metrics import get_registry
+from oryx_tpu.common.qualitystats import (
+    QualityStats,
+    TrainingProfile,
+    build_training_profile,
+    sketch_of,
+)
+
+
+def _qs(**overlay) -> QualityStats:
+    cfg = load_config(overlay={
+        "oryx.monitoring.quality.sample-rate": 1.0,
+        "oryx.monitoring.quality.window-sec": 60,
+        **overlay,
+    })
+    qs = QualityStats()
+    qs.configure(cfg)
+    return qs
+
+
+def _corpus(n=64, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    mat = rng.standard_normal((n, f)).astype(np.float32)
+    ids = [f"i{j}" for j in range(n)]
+    return mat, ids
+
+
+def _served(mat, ids, vec, k=10):
+    scores = mat @ vec
+    order = np.argsort(-scores)[:k]
+    return [(ids[int(j)], float(scores[j])) for j in order]
+
+
+# ---- shadow rescore sampling ------------------------------------------------
+
+
+def test_shadow_sample_exact_answer_scores_recall_one():
+    qs = _qs()
+    mat, ids = _corpus()
+    vec = np.random.default_rng(1).standard_normal(8).astype(np.float32)
+    assert qs.maybe_sample(
+        vec, _served(mat, ids, vec), how_many=10,
+        score_mode="exact", snapshot_fn=lambda: (mat, ids, len(ids)),
+    )
+    assert qs.flush(10)
+    assert qs.live_recall() == pytest.approx(1.0)
+    assert qs.live_recall("exact") == pytest.approx(1.0)
+    # an unseen mode's window is empty -> NaN, never a confident number
+    assert math.isnan(qs.live_recall("quantized"))
+
+
+def test_shadow_sample_wrong_answer_counts_bad_and_margin():
+    qs = _qs()
+    mat, ids = _corpus()
+    vec = np.random.default_rng(2).standard_normal(8).astype(np.float32)
+    exact = _served(mat, ids, vec)
+    worst = exact[-1][1] - 10.0  # served scores far below the true top
+    wrong = [(i, worst) for i, _ in _served(mat, ids, -vec)]
+    c_bad = get_registry().counter("oryx_quality_bad_samples_total")
+    bad_before = sum(c_bad.series().values())
+    qs.maybe_sample(
+        vec, wrong, how_many=10, score_mode="quantized",
+        snapshot_fn=lambda: (mat, ids, len(ids)),
+    )
+    assert qs.flush(10)
+    r = qs.live_recall("quantized")
+    assert r < 0.5
+    assert sum(c_bad.series().values()) == bad_before + 1
+    # margin: the approximation gave up real score -> lands off the 0 bucket
+    h = get_registry().histogram("oryx_live_score_margin")
+    assert h.count() >= 1
+
+
+def test_shadow_recall_respects_exclusions():
+    """The exact reference applies the SAME exclusion trim serving did:
+    a served page that correctly skipped excluded ids must score 1.0,
+    not be penalized for missing them."""
+    qs = _qs()
+    mat, ids = _corpus()
+    vec = np.random.default_rng(3).standard_normal(8).astype(np.float32)
+    full = _served(mat, ids, vec, k=14)
+    exclude = {full[0][0], full[2][0]}
+    served = [(i, s) for i, s in full if i not in exclude][:10]
+    qs.maybe_sample(
+        vec, served, how_many=10, exclude=exclude, score_mode="exact",
+        snapshot_fn=lambda: (mat, ids, len(ids)),
+    )
+    assert qs.flush(10)
+    assert qs.live_recall() == pytest.approx(1.0)
+
+
+def test_saturated_queue_drops_samples_never_blocks():
+    qs = _qs(**{"oryx.monitoring.quality.max-queue": 2})
+    mat, ids = _corpus()
+    vec = np.random.default_rng(4).standard_normal(8).astype(np.float32)
+    served = _served(mat, ids, vec)
+    drops = get_registry().counter("oryx_quality_sample_drops_total")
+    before = drops.value()
+    qs.drain_gate.set()  # park the drain: the burst must overflow
+    try:
+        t0 = time.monotonic()
+        accepted = sum(
+            qs.maybe_sample(
+                vec, served, how_many=10,
+                snapshot_fn=lambda: (mat, ids, len(ids)),
+            )
+            for _ in range(20)
+        )
+        elapsed = time.monotonic() - t0
+    finally:
+        qs.drain_gate.clear()
+    assert accepted <= 3  # queue bound (+ at most one in the drain's hand)
+    assert drops.value() - before >= 17
+    assert elapsed < 1.0  # put_nowait never blocked
+    assert qs.flush(10)
+
+
+def test_sampler_off_is_free():
+    qs = _qs(**{"oryx.monitoring.quality.sample-rate": 0.0})
+    mat, ids = _corpus()
+    assert not qs.maybe_sample(
+        np.zeros(8, np.float32), [("i0", 1.0)], how_many=10,
+        snapshot_fn=lambda: (mat, ids, len(ids)),
+    )
+    assert qs.samples_processed() == 0
+
+
+# ---- OpenMetrics round-trip -------------------------------------------------
+
+
+def test_quality_families_openmetrics_roundtrip_with_exemplar():
+    """Every new family renders through the strict OpenMetrics reference
+    parser, and the recall-margin histogram carries a trace exemplar."""
+    parser = pytest.importorskip("prometheus_client.openmetrics.parser")
+    qs = _qs()
+    mat, ids = _corpus()
+    vec = np.random.default_rng(5).standard_normal(8).astype(np.float32)
+    qs.maybe_sample(
+        vec, _served(mat, ids, vec), how_many=10, score_mode="exact",
+        trace_id="abc123def4567890", snapshot_fn=lambda: (mat, ids, len(ids)),
+    )
+    assert qs.flush(10)
+    qs.note_catalog(ids)
+    qs.set_training_profile(
+        build_training_profile(ids, np.ones(len(ids)), scores=mat @ vec)
+    )
+    qs.note_input_events(ids[:16], np.arange(16) * 1000)
+    # force the scorecard + SLO-error families to exist regardless of
+    # test ordering (process-global registry)
+    from oryx_tpu.common import slo
+    from oryx_tpu.common.freshness import model_freshness
+
+    model_freshness()
+    slo._sample_errors()
+    text = get_registry().render_prometheus(openmetrics=True)
+    families = {
+        f.name: f for f in parser.text_string_to_metric_families(text)
+    }
+    for name in (
+        "oryx_live_recall_at_k",
+        "oryx_live_score_margin",
+        "oryx_quality_samples",
+        "oryx_quality_bad_samples",
+        "oryx_quality_sample_drops",
+        "oryx_input_drift",
+        "oryx_prediction_drift",
+        "oryx_generation_quality",
+        "oryx_slo_sample_errors",
+    ):
+        assert name in families, f"{name} missing from OpenMetrics page"
+    margins = families["oryx_live_score_margin"]
+    exemplars = [
+        s.exemplar for s in margins.samples
+        if s.name.endswith("_bucket") and s.exemplar is not None
+    ]
+    assert exemplars, "recall-margin histogram lost its trace exemplar"
+    assert exemplars[0].labels["trace_id"] == "abc123def4567890"
+
+
+# ---- training profile + drift ----------------------------------------------
+
+
+def test_training_profile_roundtrips_and_sketch_is_normalized():
+    ids = [f"i{j}" for j in range(100)]
+    p = build_training_profile(
+        ids, np.arange(100) + 1.0,
+        timestamps_ms=np.arange(1_000, 101_000, 1_000),
+        prev_item_ids=ids[:50],
+        scores=np.random.default_rng(0).standard_normal(64),
+    )
+    q = TrainingProfile.from_json(p.to_json())
+    assert q.events_per_sec == pytest.approx(p.events_per_sec)
+    assert q.new_item_fraction == pytest.approx(0.5)
+    assert q.score_mean == pytest.approx(p.score_mean)
+    assert sum(q.item_sketch) == pytest.approx(1.0, abs=1e-3)
+    assert sketch_of([]).sum() == 0.0  # empty window: zeros, not NaN
+
+
+def test_drift_signals_move_with_distribution_shift(tmp_path):
+    from oryx_tpu.common import flightrec
+
+    rec = flightrec.get_flightrec()
+    rec.dir = str(tmp_path)
+    rec.enabled = True
+    with rec._lock:
+        # the global recorder's episode rate-limit may still be armed by
+        # an earlier test's drift-alarm (the e2e suites publish profiled
+        # generations); this test must observe ITS alarm
+        rec._last_episode.pop("drift-alarm", None)
+    qs = _qs(**{"oryx.monitoring.quality.drift.alarm-threshold": 0.4})
+    ids = [f"i{j}" for j in range(200)]
+    qs.set_training_profile(
+        build_training_profile(ids, np.ones(200), scores=np.zeros(8))
+    )
+    qs.note_catalog(ids)
+    # same shape as training -> near-zero popularity drift
+    qs.note_input_events(ids)
+    low = qs.input_drift("item-popularity")
+    assert low == pytest.approx(0.0, abs=0.05)
+    assert qs.input_drift("new-item-fraction") == 0.0
+    # a hot-item storm on an unseen item: the popularity sketch
+    # concentrates into one bucket (shape shift, what the TV distance
+    # detects) and every event is on an item the model never trained on
+    alien = ["alien-hot"] * 400
+    qs.note_input_events(alien)
+    assert qs.input_drift("item-popularity") > 0.4
+    assert qs.input_drift("new-item-fraction") > 0.5
+    events = [
+        e for e in flightrec.read_events(str(tmp_path))
+        if e.get("kind") == "drift-alarm"
+    ]
+    assert events, "drift past the threshold recorded no drift-alarm"
+    assert events[-1]["signal"].startswith(("input:", "prediction:"))
+
+
+def test_drift_is_nan_without_profile_or_window():
+    qs = _qs()
+    assert math.isnan(qs.input_drift("item-popularity"))
+    assert math.isnan(qs.prediction_drift("score-mean"))
+    qs.set_training_profile(TrainingProfile(item_sketch=[1.0] * 4))
+    assert math.isnan(qs.input_drift("item-popularity"))  # no live window
+
+
+def test_als_artifact_carries_profile_and_serving_adopts_it():
+    """ALSUpdate stamps qualityProfile into the artifact; the serving
+    state's MODEL apply hands it to the live tracker."""
+    import oryx_tpu.common.qualitystats as qmod
+    from oryx_tpu.common.artifact import ModelArtifact
+    from oryx_tpu.ops.als import InteractionData
+    from oryx_tpu.apps.als.batch import ALSUpdate
+    from oryx_tpu.apps.als.state import apply_update_message
+
+    cfg = load_config(overlay={"oryx.id": "qprof"})
+    upd = ALSUpdate(cfg, mesh=None)
+    upd._window_tss = np.arange(10_000, 20_000, 100)
+    rng = np.random.default_rng(0)
+    n_u, n_i, f = 12, 20, 4
+    agg = InteractionData(
+        user_ids=[f"u{j}" for j in range(n_u)],
+        item_ids=[f"i{j}" for j in range(n_i)],
+        users=rng.integers(0, n_u, 100).astype(np.int32),
+        items=rng.integers(0, n_i, 100).astype(np.int32),
+        values=np.ones(100, np.float32),
+    )
+
+    class M:
+        x = rng.standard_normal((n_u, f)).astype(np.float32)
+        y = rng.standard_normal((n_i, f)).astype(np.float32)
+        user_ids = agg.user_ids
+        item_ids = agg.item_ids
+
+    art = upd._artifact_from_model(
+        M, {"features": f, "lambda": 0.1, "alpha": 1.0}, agg
+    )
+    prof_json = art.get_extension("qualityProfile")
+    assert prof_json, "artifact lacks the qualityProfile extension"
+    prof = TrainingProfile.from_json(prof_json)
+    assert prof.events_per_sec and prof.events_per_sec > 0
+    assert prof.score_mean is not None
+
+    # serving adoption: apply the artifact as a MODEL message and the
+    # process tracker must hold the same profile + catalog
+    prev = qmod._default
+    qmod._default = QualityStats()
+    try:
+        apply_update_message(None, "MODEL", art.to_string())
+        adopted = qmod._default.profile
+        assert adopted is not None
+        assert adopted.item_sketch == pytest.approx(prof.item_sketch)
+        with qmod._default._lock:
+            assert qmod._default._known_items == set(agg.item_ids)
+    finally:
+        qmod._default = prev
+
+
+# ---- scorecards --------------------------------------------------------------
+
+
+def test_publish_stamp_quality_feeds_gauge_and_freshness():
+    from oryx_tpu.common.freshness import model_freshness, publish_stamp
+
+    mf = model_freshness()
+    mf.note_loaded("MODEL", "m1")
+    mf.note_stamp(publish_stamp(generation=777, quality={"auc": 0.87}))
+    assert mf.generation == 777
+    assert mf.quality == {"auc": 0.87}
+    g = get_registry().gauge("oryx_generation_quality")
+    assert g.value(metric="auc") == pytest.approx(0.87)
+    # a card-less generation must not keep exporting its predecessor's
+    # scorecard under "currently served"
+    mf.note_loaded("MODEL", "m2")
+    mf.note_stamp(publish_stamp(generation=778))
+    assert mf.quality is None
+    assert g.value(metric="auc") == 0.0  # series dropped
+
+
+def test_generation_swap_resets_live_sample_windows():
+    """A new generation's adoption clears the shadow recall/score
+    windows: a healthy rollback must never inherit (and be alarmed for)
+    the corrupted predecessor's bad samples."""
+    import oryx_tpu.common.qualitystats as qmod
+    from oryx_tpu.common.freshness import model_freshness, publish_stamp
+
+    qs = _qs()
+    mat, ids = _corpus()
+    vec = np.random.default_rng(9).standard_normal(8).astype(np.float32)
+    qs.maybe_sample(
+        vec, _served(mat, ids, vec), how_many=10,
+        snapshot_fn=lambda: (mat, ids, len(ids)),
+    )
+    assert qs.flush(10)
+    assert qs.live_recall() == pytest.approx(1.0)
+    prev = qmod._default
+    qmod._default = qs
+    try:
+        mf = model_freshness()
+        mf.note_loaded("MODEL", "m-reset")
+        mf.note_stamp(publish_stamp(generation=999))
+    finally:
+        qmod._default = prev
+    assert math.isnan(qs.live_recall())  # window is generation-scoped
+
+
+def test_mlupdate_note_eval_rides_the_stamp():
+    from oryx_tpu.apps.als.batch import ALSUpdate
+
+    cfg = load_config(overlay={"oryx.id": "stampq"})
+    upd = ALSUpdate(cfg, mesh=None)
+    assert upd.eval_metric_name() == "auc"  # implicit default
+    upd.note_eval(0.91)
+
+    sent = []
+
+    class P:
+        def send(self, key, msg):
+            sent.append((key, msg))
+
+    upd.send_publish_stamp("/models/123456", P())
+    (key, msg), = sent
+    assert key == "TRACE"
+    stamp = json.loads(msg)
+    assert stamp["generation"] == 123456
+    assert stamp["quality"] == {"auc": 0.91}
+    # a NaN eval clears the card instead of stamping a lie
+    upd.note_eval(float("nan"))
+    upd.send_publish_stamp("/models/123457", P())
+    assert "quality" not in json.loads(sent[-1][1])
+
+
+# ---- quality SLO + sample-error satellite -----------------------------------
+
+
+def test_quality_slo_burns_on_bad_samples():
+    from oryx_tpu.common import slo
+
+    cfg = load_config(overlay={
+        "oryx.monitoring.slo.fast-window-sec": 60,
+        "oryx.monitoring.slo.quality.objective": 0.95,
+    })
+    slo.ensure_quality_slo(cfg)
+    t = slo.tracker("quality")
+    assert t is not None
+    t.burn_rate(t.fast_s)  # baseline ring sample
+    time.sleep(slo._MIN_SAMPLE_GAP_S + 0.02)
+    c_all = get_registry().counter("oryx_quality_samples_total")
+    c_bad = get_registry().counter("oryx_quality_bad_samples_total")
+    for _ in range(20):
+        c_all.inc(score_mode="quantized")
+        c_bad.inc(score_mode="quantized")
+    assert t.burn_rate(t.fast_s) > 5  # all-bad burns far past the page line
+
+
+def test_slo_sample_errors_counted_and_surfaced():
+    from oryx_tpu.common import slo
+
+    c = get_registry().counter("oryx_slo_sample_errors_total")
+    before = c.value(slo="broken-source")
+
+    def exploding():
+        raise RuntimeError("metric renamed out from under the SLO")
+
+    t = slo.SloTracker("broken-source", 0.99, exploding, 1.0, 2.0)
+    with slo._trackers_lock:
+        slo._trackers["broken-source"] = t
+    try:
+        assert t.burn_rate(t.fast_s) == 0.0  # never raises out
+        assert c.value(slo="broken-source") == before + 1
+        assert "metric renamed" in t.last_error
+        assert "broken-source" in slo.sample_errors()
+    finally:
+        with slo._trackers_lock:
+            slo._trackers.pop("broken-source", None)
+
+
+# ---- serving surfaces -------------------------------------------------------
+
+
+def _als_model_message(gen: int, corrupted: bool = False) -> str:
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent.parent))
+    from tools.chaos import _quality_model_message
+
+    return _quality_model_message(gen, corrupted)
+
+
+def test_healthz_quality_section_and_console_row():
+    from oryx_tpu.serving.app import Request, ServingApp
+    from oryx_tpu.apps.als.serving import ALSServingModelManager
+
+    cfg = load_config(overlay={
+        "oryx.id": "qhealthz",
+        "oryx.serving.application-resources": [
+            "oryx_tpu.serving.resources.common",
+            "oryx_tpu.serving.resources.als",
+        ],
+        "oryx.monitoring.quality.sample-rate": 1.0,
+    })
+    manager = ALSServingModelManager(cfg)
+    app = ServingApp(cfg, manager, input_producer=None)
+    manager.consume_key_message("MODEL", _als_model_message(1))
+
+    status, body, _ = app.dispatch(
+        Request("GET", "/healthz", {}, {}, b"", {})
+    )
+    assert status == 200
+    doc = json.loads(body)
+    assert "quality" in doc
+    q = doc["quality"]
+    assert {"live_recall_at_10", "samples", "dropped", "sample_rate"} <= set(q)
+    json.dumps(q)  # strictly JSON-finite
+
+    status, body, _ = app.dispatch(
+        Request("GET", "/console", {}, {}, b"", {})
+    )
+    assert status == 200
+    assert b"live recall@10 (measured)" in body
+    manager.close()
+
+
+def test_fleet_status_carries_quality_and_slo_errors():
+    from oryx_tpu.fleet.front import FleetFront
+
+    cfg = load_config(overlay={"oryx.id": "qfleet"})
+    front = FleetFront(
+        cfg, backends=[("r0", "127.0.0.1", 18099)], port=0
+    )
+    front.replicas[0].quality = {"live_recall_at_10": 0.97, "samples": 12}
+    status, body, ctype, _ = front._local_endpoint("GET", "/fleet/status")
+    assert status == 200 and ctype == "application/json"
+    doc = json.loads(body)
+    assert "slo_errors" in doc
+    assert doc["replicas"][0]["quality"] == {
+        "live_recall_at_10": 0.97, "samples": 12
+    }
+
+
+# ---- cli flight --kind ------------------------------------------------------
+
+
+def test_cli_flight_kind_filter(tmp_path, capsys):
+    from oryx_tpu.common.flightrec import FlightRecorder
+    from oryx_tpu.cli import main as cli_main
+
+    rec = FlightRecorder()
+    rec.dir = str(tmp_path)
+    rec.record(kind="ejection", replica="r0")
+    rec.record(kind="generation", generation=5)
+    rec.record(kind="quality-alarm", generation=5, live_recall=0.1)
+
+    rc = cli_main([
+        "flight", "--kind", "quality-alarm", "--kind", "ejection",
+        "--set", f"oryx.monitoring.flight.dir={tmp_path}",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    kinds = [json.loads(line)["kind"] for line in out]
+    assert kinds == ["ejection", "quality-alarm"]
+
+    # unknown kinds fail loudly instead of printing an empty ring
+    rc = cli_main([
+        "flight", "--kind", "no-such-kind",
+        "--set", f"oryx.monitoring.flight.dir={tmp_path}",
+    ])
+    assert rc == 2
+
+
+# ---- the end-to-end acceptance loop -----------------------------------------
+
+
+def test_chaos_degraded_model_scenario(tmp_path):
+    """Corrupted generation -> live recall collapse -> quality SLO fast
+    burn -> quality-alarm flight event with the generation id, with
+    sampling provably off the request path (tools/chaos.py
+    degraded-model, run in-process)."""
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent.parent))
+    from tools.chaos import SCENARIOS
+
+    _doc, fn = SCENARIOS["degraded-model"]
+    problems = fn(str(tmp_path))
+    assert problems == [], "\n".join(problems)

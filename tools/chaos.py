@@ -616,6 +616,220 @@ def flight_on_kill(tmp: str) -> list[str]:
     return problems
 
 
+def _quality_model_message(gen: int, corrupted: bool = False) -> str:
+    """A publishable ALS artifact for the degraded-model scenario. The
+    corrupted form is adversarial to int8 per-row quantization: one
+    huge noise coordinate per Y row blows up the row scale so every
+    signal coordinate quantizes to 0 — quantized selection degenerates
+    to ties while the exact scores (user vectors are 0 in the noise
+    dimension) are untouched, so the served candidates stop containing
+    the true top items and MEASURED live recall collapses. A real-world
+    stand-in for any generation whose geometry breaks the serving
+    approximation."""
+    import numpy as np
+
+    from oryx_tpu.common.artifact import ModelArtifact
+
+    rng = np.random.default_rng(gen)
+    n_users, n_items, f = 64, 512, 16
+    x = rng.standard_normal((n_users, f)).astype(np.float32)
+    x[:, 0] = 0.0  # exact scores never read the noise dimension
+    y = rng.standard_normal((n_items, f)).astype(np.float32)
+    if corrupted:
+        y[:, 0] = 1000.0 * rng.choice([-1.0, 1.0], size=n_items)
+    else:
+        y[:, 0] = 0.0
+    art = ModelArtifact(
+        "als",
+        extensions={
+            "features": str(f), "lambda": "0.001", "alpha": "1.0",
+            "implicit": "true", "logStrength": "false",
+        },
+        tensors={"X": x, "Y": y},
+    )
+    art.set_extension("XIDs", [f"u{j}" for j in range(n_users)])
+    art.set_extension("YIDs", [f"i{j}" for j in range(n_items)])
+    return art.to_string()
+
+
+@scenario("degraded-model",
+          "publish a deliberately noise-corrupted generation behind a "
+          "quantized serving model with shadow rescore sampling on: live "
+          "recall must drop below the floor, the quality SLO fast burn "
+          "must fire, and a quality-alarm flight event must land with "
+          "the generation id — while a parallel load window shows no "
+          "added request latency versus sampler-off and a saturated "
+          "shadow queue drops samples instead of slowing requests")
+def degraded_model(tmp: str) -> list[str]:
+    import math
+
+    from oryx_tpu.common import flightrec, slo
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.common.freshness import model_freshness, publish_stamp
+    from oryx_tpu.common.metrics import get_registry
+    from oryx_tpu.common.qualitystats import get_qualitystats
+    from oryx_tpu.serving.app import Request, ServingApp
+    from oryx_tpu.apps.als.serving import ALSServingModelManager
+
+    flight_dir = os.path.join(tmp, "flight")
+    recall_floor = 0.9
+    cfg = load_config(overlay={
+        "oryx.id": "chaos-quality",
+        "oryx.serving.api.score-mode": "quantized",
+        'oryx.serving.application-resources':
+            ["oryx_tpu.serving.resources.common",
+             "oryx_tpu.serving.resources.als"],
+        "oryx.monitoring.quality.sample-rate": 1.0,
+        "oryx.monitoring.quality.window-sec": 60,
+        "oryx.monitoring.quality.max-queue": 64,
+        "oryx.monitoring.quality.alarm-burn-rate": 5,
+        "oryx.monitoring.slo.quality.objective": 0.95,
+        "oryx.monitoring.slo.quality.recall-floor": recall_floor,
+        "oryx.monitoring.slo.fast-window-sec": 60,
+        "oryx.monitoring.flight.dir": flight_dir,
+    })
+    manager = ALSServingModelManager(cfg)
+    app = ServingApp(cfg, manager, input_producer=None)
+    qs = get_qualitystats()
+    mf = model_freshness()
+
+    def publish(gen: int, corrupted: bool) -> None:
+        msg = _quality_model_message(gen, corrupted)
+        manager.consume_key_message("MODEL", msg)
+        # the freshness handshake the update listener would perform:
+        # load completes, then the publish stamp claims it (carrying the
+        # generation id + scorecard the alarm event must name)
+        mf.note_loaded("MODEL", msg)
+        mf.note_stamp(publish_stamp(generation=gen, quality={"auc": 0.9}))
+
+    def drive(n: int, how_many: int = 10) -> tuple[int, list[float]]:
+        errors, lat = 0, []
+        for j in range(n):
+            req = Request(
+                "GET", f"/recommend/u{j % 64}",
+                {}, {"howMany": [str(how_many)]}, b"", {},
+            )
+            t0 = time.perf_counter()
+            status, _body, _ct = app.dispatch(req)
+            lat.append(time.perf_counter() - t0)
+            if status != 200:
+                errors += 1
+        return errors, lat
+
+    def pctl(lat: list[float], q: float) -> float:
+        s = sorted(lat)
+        return s[min(len(s) - 1, int(q * len(s)))]
+
+    problems: list[str] = []
+    drops = get_registry().counter("oryx_quality_sample_drops_total")
+
+    publish(1, corrupted=False)
+    errors, _ = drive(8)  # warm the compiled dispatch shapes
+    if errors:
+        problems.append(f"{errors} non-200s during warmup")
+        return problems
+
+    # -- phase 1: healthy generation, sampler on --------------------------
+    errors, _ = drive(32)
+    qs.flush(30)
+    good_recall = qs.live_recall()
+    if errors:
+        problems.append(f"{errors} non-200s under the healthy generation")
+    if math.isnan(good_recall) or good_recall < recall_floor:
+        problems.append(
+            f"healthy quantized generation measured live recall "
+            f"{good_recall!r}, want >= {recall_floor}"
+        )
+
+    # -- phase 2: sampling is off the hot path ----------------------------
+    # (a) identical request windows, sampler off vs on. A systemic
+    # per-request leak (the exact rescore running inline would add an
+    # O(N.F) matmul to EVERY request) inflates the whole distribution;
+    # compare median and p90 rather than the window max so one scheduler
+    # /GC stall in a 128-sample window cannot impersonate a leak.
+    qs.sample_rate = 0.0
+    _, lat_off = drive(128)
+    qs.sample_rate = 1.0
+    _, lat_on = drive(128)
+    qs.flush(30)
+    if (
+        pctl(lat_on, 0.5) > pctl(lat_off, 0.5) * 2.0 + 0.005
+        or pctl(lat_on, 0.9) > pctl(lat_off, 0.9) * 2.0 + 0.010
+    ):
+        problems.append(
+            "sampler-on latency window (p50 "
+            f"{pctl(lat_on, 0.5) * 1e3:.2f}ms / p90 "
+            f"{pctl(lat_on, 0.9) * 1e3:.2f}ms) vs off (p50 "
+            f"{pctl(lat_off, 0.5) * 1e3:.2f}ms / p90 "
+            f"{pctl(lat_off, 0.9) * 1e3:.2f}ms) — sampling is loading "
+            "the request path"
+        )
+    # (b) a saturated shadow queue must DROP samples, never block
+    # requests: park the drain and burst past the queue bound
+    drops_before = drops.value()
+    qs.drain_gate.set()
+    try:
+        errors, _ = drive(80)
+    finally:
+        qs.drain_gate.clear()
+    qs.flush(30)
+    dropped = drops.value() - drops_before
+    if errors:
+        problems.append(f"{errors} non-200s while the shadow queue was full")
+    if dropped <= 0:
+        problems.append(
+            "saturated shadow queue dropped no samples "
+            f"(drops moved by {dropped})"
+        )
+
+    # -- phase 3: the corrupted generation --------------------------------
+    publish(2, corrupted=True)
+    # waves with real gaps so the SLO ring (one sample per 50ms minimum)
+    # records the burn as the drain scores each wave
+    for _ in range(3):
+        errors, _ = drive(32)
+        if errors:
+            problems.append(f"{errors} non-200s under the corrupted generation")
+            break
+        qs.flush(30)
+        time.sleep(0.12)
+    bad_recall = qs.live_recall()
+    if not (bad_recall < recall_floor):
+        problems.append(
+            f"corrupted generation still measures live recall "
+            f"{bad_recall!r}, want < {recall_floor}"
+        )
+    tracker = slo.tracker("quality")
+    if tracker is None:
+        problems.append("quality SLO tracker never registered")
+    else:
+        burn = tracker.burn_rate(tracker.fast_s)
+        if burn < 5:
+            problems.append(
+                f"quality SLO fast burn rate {burn:.2f} never crossed the "
+                "alarm threshold (5)"
+            )
+    alarms = [
+        e for e in flightrec.read_events(flight_dir)
+        if e.get("kind") == "quality-alarm"
+    ]
+    if not alarms:
+        problems.append("no quality-alarm flight event was recorded")
+    elif not any(e.get("generation") == 2 for e in alarms):
+        problems.append(
+            f"quality-alarm events lack the corrupted generation id: "
+            f"{alarms}"
+        )
+    manager.close()
+    # leave a fresh SLO ring sample behind: this scenario drove hundreds
+    # of requests through the process-global trackers, and a later
+    # same-process burn-rate reader must difference against a
+    # post-storm sample — exactly what a production scrape cadence
+    # guarantees and a single in-process test run otherwise wouldn't
+    get_registry().render_prometheus()
+    return problems
+
+
 def _seq_model_message(n_items: int = 6, dim: int = 8) -> str:
     """A small loadable seq MODEL message (GRU weights + inline item
     embeddings) so the speed manager is past its load fraction before

@@ -69,10 +69,14 @@ REQUIRED_BENCH_FIELDS = (
     "approx_recall_at_10",
     "quantized_recall_at_10",
     "lsh_measured_recall_at_10",
+    # the live shadow-rescore sampler's runtime recall (ISSUE 15): bench
+    # http, tools/quality_nightly.py, and oryx_live_recall_at_k share
+    # this one vocabulary
+    "live_recall_at_10",
     "shard_topk_scaling_2shard",
     "train_mfu",
 )
-REQUIRED_DOC_TOKENS = ("score_mode", "shard")
+REQUIRED_DOC_TOKENS = ("score_mode", "shard", "signal")
 
 
 # -- collectors (shared with the thin CLI wrappers) --------------------------

@@ -27,6 +27,57 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
+def _live_sampler_fields(
+    n_items: int = 20_000, features: int = 50, n_queries: int = 64
+) -> dict:
+    """Drive the RUNTIME shadow-rescore sampler (common/qualitystats.py)
+    through a real quantized ALSServingModel — the same request path
+    production samples — and report its windowed recall. This is what
+    makes the nightly artifact and the live oryx_live_recall_at_k gauge
+    one vocabulary: both numbers come out of the identical sampler code
+    on the identical serve pipeline."""
+    import numpy as np
+
+    from oryx_tpu.common.config import load_config
+    from oryx_tpu.common.qualitystats import QualityStats
+    from oryx_tpu.apps.als.serving import ALSServingModel
+    from oryx_tpu.apps.als.state import ALSState
+
+    cfg = load_config(overlay={
+        "oryx.monitoring.quality.sample-rate": 1.0,
+        "oryx.monitoring.quality.window-sec": 600,
+        "oryx.monitoring.quality.max-queue": max(256, n_queries),
+    })
+    rng = np.random.default_rng(29)
+    state = ALSState(features, implicit=True)
+    ids = [f"i{j}" for j in range(n_items)]
+    state.y.bulk_set(ids, rng.standard_normal((n_items, features)).astype(np.float32))
+    state.set_expected([], ids)
+    model = ALSServingModel(state, score_mode="quantized")
+    qs = QualityStats()
+    qs.configure(cfg)
+    # route this model's shadow samples into the PRIVATE tracker so the
+    # nightly number never mixes with the process-global window
+    import oryx_tpu.common.qualitystats as _qmod
+
+    prev = _qmod._default
+    _qmod._default = qs
+    try:
+        for _ in range(n_queries):
+            q = rng.standard_normal(features).astype(np.float32)
+            model.top_n(q, 10)
+        qs.flush(60)
+    finally:
+        _qmod._default = prev
+        model.close()
+        qs.close()
+    live = qs.live_recall()
+    return {
+        "live_recall_at_10": round(live, 4) if live == live else None,
+        "live_recall_samples": qs.samples_processed(),
+    }
+
+
 def main() -> int:
     round_no = int(sys.argv[1]) if len(sys.argv) > 1 else 0
     out_path = Path(__file__).resolve().parent.parent / (
@@ -142,6 +193,7 @@ def main() -> int:
     RandomManager.use_test_seed(1)
     t0 = time.perf_counter()
     rr = evaluate_score_mode_recall()
+    live = _live_sampler_fields()
     record(
         "score_mode_recall",
         {
@@ -156,6 +208,12 @@ def main() -> int:
             "n_items": rr.n_items,
             "n_queries": rr.n_queries,
             "approx_recall_target": rr.approx_recall_target,
+            # the RUNTIME sampler's numbers on the same class of corpus:
+            # nightly and production share one recall vocabulary
+            # (oryx_live_recall_at_k == live_recall_at_10 here and in
+            # bench's http stage), so a nightly regression and a live
+            # pager fire on the same definition
+            **live,
             "wall_s": round(time.perf_counter() - t0, 1),
         },
         rr.green,
