@@ -120,14 +120,33 @@ def _flight_stage(stage: str):
     rec = configure_flightrec(
         load_config(overlay={"oryx.monitoring.flight.dir": flight_dir})
     )
+    _STAGE_PHASE[stage] = ("start", time.monotonic())
     rec.record(kind="bench-stage", stage=stage, phase="start")
     return rec
 
 
+# stage -> (current phase, monotonic entry time); each phase marker then
+# carries how long the PREVIOUS phase ran, so a harvested ring reads as a
+# phase timeline, not just a last-known position
+_STAGE_PHASE: dict[str, tuple[str, float]] = {}
+
+
 def _flight_phase(rec, stage: str, phase: str) -> None:
     """Phase marker: the last one in a harvested ring names what a killed
-    stage was doing when it died."""
-    rec.record(kind="bench-stage", stage=stage, phase=phase)
+    stage was doing when it died, and ``prev_phase``/``prev_s`` name what
+    it had just finished and how long that took — a timed-out TPU stage's
+    autopsy shows both the wedged phase and the durations leading up to
+    it."""
+    now = time.monotonic()
+    prev = _STAGE_PHASE.get(stage)
+    _STAGE_PHASE[stage] = (phase, now)
+    if prev is not None:
+        rec.record(
+            kind="bench-stage", stage=stage, phase=phase,
+            prev_phase=prev[0], prev_s=round(now - prev[1], 6),
+        )
+    else:
+        rec.record(kind="bench-stage", stage=stage, phase=phase)
 
 
 def _emit_stage_error(

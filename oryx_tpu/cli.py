@@ -41,6 +41,7 @@ def _parse_args(argv):
         choices=[
             "batch", "speed", "serving", "setup", "tail", "input",
             "import-pmml", "loadtest", "config", "pod", "fleet", "flight",
+            "perf",
         ],
     )
     p.add_argument(
@@ -103,7 +104,7 @@ def _parse_args(argv):
     p.add_argument("--conf", help="user config file (HOCON-like key paths)")
     p.add_argument(
         "--url",
-        help="loadtest: base URL of a running serving layer "
+        help="loadtest/perf: base URL of a running serving layer "
         "(default http://localhost:<oryx.serving.api.port>)",
     )
     p.add_argument(
@@ -388,6 +389,208 @@ def cmd_flight(config: Config, kinds: list[str] | None = None) -> int:
         print(json.dumps(ev))
     tail = f" ({total} total)" if kinds else ""
     print(f"# {len(events)} event(s) in {flight_dir}{tail}", file=sys.stderr)
+    return 0
+
+
+# Families the `perf` report reads (common/perfattr.py registers them).
+# Suffixed sample names (`_bucket`/`_sum`/`_count`) are built by
+# concatenation so each family literal appears once and stays joined to
+# the docs/observability.md metric reference table by tools/oryxlint.
+_PHASE_FAMILY = "oryx_request_phase_seconds"
+_IDLE_FAMILY = "oryx_device_idle_gap_seconds"
+_COMPILE_HIST = "oryx_xla_compile_seconds"
+_COMPILE_TOTAL = "oryx_xla_compiles_total"
+
+
+def _parse_metric_sample(
+    line: str,
+) -> tuple[str, dict[str, str], float] | None:
+    """One exposition sample line -> (name, labels, value); None for
+    unparseable lines. Exemplars (`... # {...}`) are dropped. Good enough
+    for the perfattr families (label values never contain `,` or `#`)."""
+    brace = line.find("{")
+    space = line.find(" ")
+    if brace != -1 and (space == -1 or brace < space):
+        end = line.find("}", brace)
+        if end < 0:
+            return None
+        name = line[:brace]
+        labels: dict[str, str] = {}
+        for part in line[brace + 1 : end].split(","):
+            k, eq, v = part.partition("=")
+            if eq:
+                labels[k.strip()] = v.strip().strip('"')
+        rest = line[end + 1 :]
+    elif space > 0:
+        name, labels, rest = line[:space], {}, line[space:]
+    else:
+        return None
+    toks = rest.split("#", 1)[0].split()
+    if not toks:
+        return None
+    try:
+        return name, labels, float(toks[0])
+    except ValueError:
+        return None
+
+
+def _bucket_quantile(
+    buckets: list[tuple[float, float]], q: float
+) -> float | None:
+    """Nearest-rank quantile estimate from cumulative histogram buckets
+    (sorted by upper bound): the upper bound of the bucket holding the
+    rank. +Inf means the quantile is beyond the largest finite bound."""
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    target = q * total
+    for bound, cum in buckets:
+        if cum >= target:
+            return bound
+    return buckets[-1][0]
+
+
+def _fmt_bound_ms(bound: float | None, buckets: list[tuple[float, float]]) -> str:
+    if bound is None:
+        return "-"
+    if bound == float("inf"):
+        finite = [b for b, _ in buckets if b != float("inf")]
+        return f">{finite[-1] * 1000:.3g}ms" if finite else "inf"
+    return f"{bound * 1000:.3g}ms"
+
+
+def render_perf_report(text: str) -> str:
+    """Pure renderer: /metrics exposition text -> the ``oryx perf``
+    report (testable without a live replica). Phase p50/p99 are
+    bucket-upper-bound estimates, phase share is share of summed phase
+    seconds, idle-gap causes rank by total attributed seconds."""
+    from oryx_tpu.fleet.observe import parse_exposition
+
+    families, _ = parse_exposition(text)
+
+    def samples(family: str) -> list[tuple[str, dict[str, str], float]]:
+        f = families.get(family)
+        if f is None:
+            return []
+        out = []
+        for line in f.samples.get("", []):
+            parsed = _parse_metric_sample(line)
+            if parsed is not None:
+                out.append(parsed)
+        return out
+
+    lines: list[str] = []
+
+    # -- request phase budget ---------------------------------------------
+    buckets: dict[str, list[tuple[float, float]]] = {}
+    sums: dict[str, float] = {}
+    counts: dict[str, float] = {}
+    for name, labels, value in samples(_PHASE_FAMILY):
+        phase = labels.get("phase", "")
+        if name == _PHASE_FAMILY + "_bucket":
+            le = labels.get("le", "+Inf")
+            bound = float("inf") if le in ("+Inf", "inf") else float(le)
+            buckets.setdefault(phase, []).append((bound, value))
+        elif name == _PHASE_FAMILY + "_sum":
+            sums[phase] = value
+        elif name == _PHASE_FAMILY + "_count":
+            counts[phase] = value
+    lines.append(f"latency budget ({_PHASE_FAMILY})")
+    total_s = sum(sums.values())
+    if counts:
+        lines.append(
+            f"  {'phase':<16}{'count':>8}{'p50':>10}{'p99':>10}{'share':>8}"
+        )
+        for phase in sorted(
+            counts, key=lambda p: sums.get(p, 0.0), reverse=True
+        ):
+            bs = sorted(buckets.get(phase, []))
+            share = sums.get(phase, 0.0) / total_s if total_s else 0.0
+            lines.append(
+                f"  {phase:<16}{int(counts[phase]):>8}"
+                f"{_fmt_bound_ms(_bucket_quantile(bs, 0.50), bs):>10}"
+                f"{_fmt_bound_ms(_bucket_quantile(bs, 0.99), bs):>10}"
+                f"{share:>7.1%}"
+            )
+    else:
+        lines.append("  (no phase samples yet)")
+
+    # -- device idle gaps --------------------------------------------------
+    gap_sums: dict[str, float] = {}
+    gap_counts: dict[str, float] = {}
+    for name, labels, value in samples(_IDLE_FAMILY):
+        cause = labels.get("cause", "")
+        if name == _IDLE_FAMILY + "_sum":
+            gap_sums[cause] = value
+        elif name == _IDLE_FAMILY + "_count":
+            gap_counts[cause] = value
+    lines.append("")
+    lines.append(f"device idle gaps ({_IDLE_FAMILY})")
+    gap_total = sum(gap_sums.values())
+    if gap_sums:
+        lines.append(f"  {'cause':<18}{'gaps':>8}{'total':>12}{'share':>8}")
+        for cause in sorted(gap_sums, key=gap_sums.__getitem__, reverse=True):
+            share = gap_sums[cause] / gap_total if gap_total else 0.0
+            lines.append(
+                f"  {cause:<18}{int(gap_counts.get(cause, 0)):>8}"
+                f"{gap_sums[cause]:>11.3f}s{share:>7.1%}"
+            )
+    else:
+        lines.append("  (no idle-gap samples yet)")
+
+    # -- XLA compiles ------------------------------------------------------
+    comp_n: dict[str, float] = {}
+    comp_s: dict[str, float] = {}
+    for name, labels, value in samples(_COMPILE_TOTAL):
+        if name == _COMPILE_TOTAL:
+            comp_n[labels.get("kind", "")] = value
+    for name, labels, value in samples(_COMPILE_HIST):
+        if name == _COMPILE_HIST + "_sum":
+            comp_s[labels.get("kind", "")] = value
+    lines.append("")
+    lines.append(f"xla compiles ({_COMPILE_TOTAL})")
+    if comp_n:
+        lines.append(f"  {'kind':<12}{'compiles':>10}{'total':>12}{'mean':>10}")
+        for kind in sorted(comp_n):
+            n, s = comp_n[kind], comp_s.get(kind, 0.0)
+            mean = f"{s / n * 1000:.3g}ms" if n else "-"
+            lines.append(
+                f"  {kind:<12}{int(n):>10}{s:>11.3f}s{mean:>10}"
+            )
+    else:
+        lines.append("  (no compiles recorded yet)")
+
+    return "\n".join(lines) + "\n"
+
+
+def cmd_perf(config: Config, url: str | None = None) -> int:
+    """Live latency budget of one replica, read from its ``/metrics``:
+    phase p50/p99 shares, top idle-gap causes, compile counts — the CLI
+    face of the perfattr plane (common/perfattr.py) for an operator
+    without a Prometheus in reach:
+
+        python -m oryx_tpu.cli perf --url http://replica-3:8080
+    """
+    import urllib.request
+
+    base = url or (
+        f"http://localhost:{config.get_int('oryx.serving.api.port', 8080)}"
+    )
+    if "://" not in base:
+        base = "http://" + base  # bare host:port
+    target = base.rstrip("/") + "/metrics"
+    try:
+        with urllib.request.urlopen(target, timeout=10) as resp:
+            text = resp.read().decode("utf-8", "replace")
+    except Exception as e:  # noqa: BLE001 - a report fetch fails as a row
+        print(
+            f"fetch {target} failed: {type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    print(render_perf_report(text), end="")
     return 0
 
 
@@ -1171,6 +1374,8 @@ def main(argv=None) -> int:
         return cmd_serving(config, raw)
     if args.command == "flight":
         return cmd_flight(config, args.kind)
+    if args.command == "perf":
+        return cmd_perf(config, args.url)
     return {
         "batch": cmd_batch,
         "speed": cmd_speed,

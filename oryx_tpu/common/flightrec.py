@@ -75,6 +75,15 @@ EVENT_KINDS: dict[str, str] = {
         "live input/prediction drift against the served generation's "
         "training profile crossed the alarm threshold"
     ),
+    "compile-storm": (
+        "XLA recompile rate crossed the configured threshold within the "
+        "rolling window — a shape-signature churn (generation swap, "
+        "k-bucket spread) is stealing device time"
+    ),
+    "profile-capture": (
+        "a latency fast-burn triggered an automatic bounded profile "
+        "window (perfstats summary + phase budget) into the ring"
+    ),
 }
 
 _SEGMENT_PREFIX = "events-"

@@ -102,10 +102,15 @@ class DispatchRecord:
         self.valid_rows = valid_rows
         self.capacity_rows = capacity_rows
         # the metric the smoke contract pins: real rows over the
-        # capacity-padded shape actually scored — never > 1
-        self.occupancy = (
-            min(1.0, valid_rows / capacity_rows) if capacity_rows > 0 else 1.0
-        )
+        # capacity-padded shape actually scored — always in [0, 1], never
+        # NaN: a zero-capacity or empty dispatch (drained shutdown batch,
+        # a caller passing garbage rows) must not poison the histogram
+        # with a >1.0 or non-finite sample
+        if capacity_rows > 0 and valid_rows > 0:
+            occ = valid_rows / capacity_rows
+            self.occupancy = min(1.0, occ) if occ == occ else 0.0
+        else:
+            self.occupancy = 0.0
         self.trace_id = trace_id
         # serving score mode (exact | quantized | approx) when the
         # dispatcher labels it; None for unlabeled kinds (train)

@@ -201,6 +201,17 @@ def tracker(slo: str) -> SloTracker | None:
         return _trackers.get(slo)
 
 
+def current_burn(slo: str, fast: bool = True) -> float | None:
+    """The named SLO's current fast- (or slow-) window burn rate, or None
+    when the tracker is not registered in this process. Trackers are
+    scrape-driven and sample-gated, so this is cheap enough for gated
+    hot-path probes (perfattr's burn-triggered profile capture)."""
+    t = tracker(slo)
+    if t is None:
+        return None
+    return t.burn_rate(t.fast_s if fast else t.slow_s)
+
+
 def _ensure(
     slo: str,
     objective: float,

@@ -25,6 +25,17 @@ to stages for exactly this reason. This module is the substrate:
 Tracing is OFF by default (``oryx.monitoring.tracing.enabled``); every
 instrumentation site guards on ``tracer.enabled``, so the disabled cost is
 one attribute read per request.
+
+Span-name families emitted by the serving hot path (the /fleet/traces
+waterfall groups on these): ``http.request`` roots with ``http.parse`` /
+``http.auth`` / ``http.dispatch`` / ``http.respond`` stages, the
+batcher's ``batcher.queue_wait`` / ``batcher.device`` /
+``batcher.host_score``, ``batcher.compile_stall`` (the first dispatch of
+a new shape signature — XLA trace+compile blocking the dispatcher; see
+common/perfattr.py), and ``phase.<name>`` children replayed from each
+request's phase ledger (``phase.parse`` … ``phase.write``) so the
+latency-budget phases line up under the request root even when a phase
+ran on another thread.
 """
 
 from __future__ import annotations

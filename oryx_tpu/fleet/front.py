@@ -139,6 +139,11 @@ class ReplicaInfo:
         self.quality: dict | None = None
         # the replica's own SLO source-read failures (slo -> last error)
         self.slo_errors: dict | None = None
+        # the replica's live latency budget from its /healthz body
+        # (per-phase p50/p99/share + ranked idle-gap causes, common/
+        # perfattr.py) — federated verbatim into /fleet/status so "where
+        # does the millisecond go" is answerable fleet-wide
+        self.latency_budget: dict | None = None
         self.last_reasons: list[str] = []
 
     def snapshot(self) -> dict:
@@ -159,6 +164,7 @@ class ReplicaInfo:
             "shards": self.shards,
             "quality": self.quality,
             "slo_errors": self.slo_errors,
+            "latency_budget": self.latency_budget,
             "degraded": self.last_reasons,
         }
 
@@ -429,6 +435,8 @@ class FleetFront(AsyncHTTPServer):
             r.quality = q if isinstance(q, dict) else None
             se = body.get("slo_errors")
             r.slo_errors = se if isinstance(se, dict) else None
+            lb = body.get("latency_budget")
+            r.latency_budget = lb if isinstance(lb, dict) else None
             r.last_reasons = [str(x) for x in body.get("degraded") or []]
         if r.generation is not None:
             self._g_gen.set(float(r.generation), replica=r.id)
@@ -1243,6 +1251,7 @@ class FleetFront(AsyncHTTPServer):
             return 200, text.encode("utf-8"), "text/plain; version=0.0.4", ()
         if path == "/fleet/status" and method in ("GET", "HEAD"):
             from oryx_tpu.common import slo
+            from oryx_tpu.fleet.observe import merge_latency_budgets
 
             body = json.dumps(
                 {
@@ -1252,6 +1261,15 @@ class FleetFront(AsyncHTTPServer):
                     # broken burn-rate math must be visible, not a
                     # silently flat gauge (oryx_slo_sample_errors_total)
                     "slo_errors": slo.sample_errors(),
+                    # fleet-level phase/idle-gap waterfall merged from the
+                    # per-replica healthz latency_budget sections
+                    "latency_budget": merge_latency_budgets(
+                        [
+                            r.latency_budget
+                            for r in self.replicas
+                            if r.latency_budget is not None
+                        ]
+                    ),
                     "replicas": [r.snapshot() for r in self.replicas],
                 }
             )

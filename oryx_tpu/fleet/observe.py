@@ -168,3 +168,68 @@ def federate(pages: list[tuple[str, str]], openmetrics: bool = False) -> str:
     if openmetrics:
         out.append("# EOF")
     return "\n".join(out) + "\n"
+
+
+def merge_latency_budgets(budgets: list[dict]) -> dict:
+    """Per-replica latency budgets (healthz ``latency_budget`` sections,
+    shape of ``perfattr.PerfAttr.budget()``) -> one fleet-level summary.
+
+    Phase percentiles cannot be averaged exactly from summaries, so the
+    merge is deliberately honest about what it is: per-phase counts sum,
+    p50/p99 are count-weighted means of the replica percentiles (an
+    operator-grade approximation, labelled as such by the key names), and
+    ``share`` is recomputed from the merged totals so the fleet waterfall
+    still sums to ~1.0. Idle-gap cause seconds sum directly.
+    """
+    phases: dict[str, dict[str, float]] = {}
+    gaps: dict[str, float] = {}
+    window_s = 0.0
+    for b in budgets:
+        if not isinstance(b, dict):
+            continue
+        window_s = max(window_s, float(b.get("window_s") or 0.0))
+        for name, row in (b.get("phases") or {}).items():
+            if not isinstance(row, dict):
+                continue
+            n = float(row.get("count") or 0.0)
+            if n <= 0:
+                continue
+            agg = phases.setdefault(
+                name, {"count": 0.0, "_p50_w": 0.0, "_p99_w": 0.0}
+            )
+            agg["count"] += n
+            agg["_p50_w"] += n * float(row.get("p50_ms") or 0.0)
+            agg["_p99_w"] += n * float(row.get("p99_ms") or 0.0)
+        for cause, row in (b.get("idle_gaps") or {}).items():
+            if isinstance(row, dict):
+                gaps[cause] = gaps.get(cause, 0.0) + float(
+                    row.get("seconds") or 0.0
+                )
+    total_ms = sum(
+        a["_p50_w"] for a in phases.values()
+    )  # count-weighted p50 mass approximates each phase's time share
+    out_phases = {}
+    for name, a in phases.items():
+        n = a["count"]
+        out_phases[name] = {
+            "count": int(n),
+            "p50_ms": round(a["_p50_w"] / n, 3),
+            "p99_ms": round(a["_p99_w"] / n, 3),
+            "share": round(a["_p50_w"] / total_ms, 4) if total_ms else 0.0,
+        }
+    gap_total = sum(gaps.values())
+    out_gaps = {
+        cause: {
+            "seconds": round(sec, 6),
+            "share": round(sec / gap_total, 4) if gap_total else 0.0,
+        }
+        for cause, sec in sorted(
+            gaps.items(), key=lambda kv: kv[1], reverse=True
+        )
+    }
+    return {
+        "window_s": window_s,
+        "replicas": sum(1 for b in budgets if isinstance(b, dict)),
+        "phases": out_phases,
+        "idle_gaps": out_gaps,
+    }

@@ -25,6 +25,7 @@ from oryx_tpu.api import ServingModelManager
 from oryx_tpu.bus.api import TopicProducer
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.metrics import GaugeSeriesGone, get_registry
+from oryx_tpu.common.perfattr import swap_ledger
 from oryx_tpu.common.tracing import configure_tracing, swap_current
 
 
@@ -174,6 +175,12 @@ class Request:
     # when tracing is enabled; dispatch installs it as the thread-current
     # span so batcher/bus instrumentation parents to it
     trace: Any = None
+    # the request's phase ledger (common/perfattr.py PhaseLedger), created
+    # by the frontend at parse time and flushed by it after the response
+    # bytes are written; dispatch installs it as the thread-current ledger
+    # so the batcher stamps queue/pad/device phases without signature
+    # threading. None when dispatched outside an HTTP frontend.
+    ledger: Any = None
     # extra RESPONSE headers accumulated during dispatch (Retry-After on
     # sheds, Warning on stale-model responses); frontends read this after
     # the response renders. A side channel rather than a wider render
@@ -281,6 +288,12 @@ class ServingApp:
         from oryx_tpu.common.perfstats import configure_perfstats
 
         configure_perfstats(config)
+        # latency attribution (phase budgets, idle-gap classification,
+        # compile-storm + burn-triggered capture knobs) adopts the same
+        # config and pre-registers its families
+        from oryx_tpu.common.perfattr import configure_perfattr
+
+        configure_perfattr(config)
         # the update-topic listener's artifact relay adopts the fleet's
         # distribution mode (shared per-host cache vs per-process decode)
         from oryx_tpu.common.artifact import configure_artifact_relay
@@ -539,17 +552,25 @@ class ServingApp:
         either a rendered (status, body, content_type) tuple or a Deferred
         of one (the async frontend awaits it off-thread)."""
         start = time.monotonic()
-        if req.trace is not None:
-            # install the request span as this thread's current span for
-            # the synchronous handler call, so instrumentation below it
-            # (batcher submit) parents without signature threading
-            prev = swap_current(req.trace)
-            try:
+        # install the request's phase ledger as this thread's current one
+        # for the synchronous handler call, so the batcher's submit path
+        # attaches it to the pending request without signature threading
+        prev_ledger = swap_ledger(req.ledger)
+        try:
+            if req.trace is not None:
+                # install the request span as this thread's current span
+                # for the synchronous handler call, so instrumentation
+                # below it (batcher submit) parents without signature
+                # threading
+                prev = swap_current(req.trace)
+                try:
+                    resp = self._dispatch(req)
+                finally:
+                    swap_current(prev)
+            else:
                 resp = self._dispatch(req)
-            finally:
-                swap_current(prev)
-        else:
-            resp = self._dispatch(req)
+        finally:
+            swap_ledger(prev_ledger)
         if isinstance(resp, Deferred):
             rendered: Future = Future()
 
@@ -694,6 +715,28 @@ def _to_csv_rows(value: Any) -> list[list]:
 
 
 def _render(result: Any, req: Request) -> tuple[int, bytes, str]:
+    """Serialize one handler result to wire bytes, stamping the ledger's
+    serialize phase (both the sync path and deferred completion render
+    through here, so the stamp site is single).
+
+    The stamp anchors at the ledger's last phase end, not at render
+    entry: on the deferred path the slice between the batcher's device
+    fetch and this call — result distribution, the post-processing pool
+    hop, top-n trim/ID translation — is host-side result handling, and
+    charging it to serialize keeps the phase budget tiling the request
+    (>=95% of wall-clock, the attribution contract) instead of leaving
+    an invisible gap between device and serialize."""
+    if req.ledger is None:
+        return _render_body(result, req)
+    t0 = time.monotonic()
+    tail = req.ledger.last_end()
+    start = tail if tail is not None and tail < t0 else t0
+    out = _render_body(result, req)
+    req.ledger.add("serialize", time.monotonic() - start, start=start)
+    return out
+
+
+def _render_body(result: Any, req: Request) -> tuple[int, bytes, str]:
     if isinstance(result, RawResponse):
         return result.status, result.body, result.content_type
     if result is None:

@@ -42,6 +42,7 @@ import weakref
 from concurrent.futures import ThreadPoolExecutor
 from urllib.parse import parse_qs, urlsplit
 
+from oryx_tpu.common.perfattr import PhaseLedger, get_perfattr
 from oryx_tpu.common.tracing import (
     format_traceparent,
     get_tracer,
@@ -377,9 +378,10 @@ class AsyncHTTPServer:
                 if len(head) > MAX_HEADER_BYTES:
                     await self._simple_response(writer, 400, b"headers too large")
                     return
-                # head received: the parse stage (and the request span)
-                # starts here when tracing is on
-                t_parse = time.monotonic() if _TRACER.enabled else 0.0
+                # head received: the parse stage (and, when tracing is on,
+                # the request span) starts here — the phase ledger needs
+                # the stamp regardless of tracing
+                t_parse = time.monotonic()
                 if task is not None:
                     ls.conns[task] = False  # request in flight
 
@@ -508,6 +510,7 @@ class AsyncHTTPServer:
         headers: dict[str, str],
         body: bytes,
         span=None,
+        ledger=None,
     ) -> tuple[int, bytes, str, tuple[tuple[str, str], ...]]:
         """Auth + gzip-decode + route dispatch, shared by every loop's
         HTTP/1.1 handler and the HTTP/2 streams (serving/http2.py):
@@ -515,7 +518,10 @@ class AsyncHTTPServer:
 
         ``span`` is the request span when the h1 path already opened one;
         h2 streams call with span=None and (when tracing is on) get a
-        request span owned — opened AND finished — here."""
+        request span owned — opened AND finished — here. ``ledger``
+        follows the same ownership rule: the h1 path passes the one it
+        created at parse time; h2 streams get one created AND flushed
+        here (their frame writes aren't observable per request)."""
         tr = _TRACER
         own_span = False
         if span is None and tr.enabled:
@@ -525,10 +531,17 @@ class AsyncHTTPServer:
                 method=method, target=target, proto="h2",
             )
             own_span = True
+        own_ledger = ledger is None
+        if ledger is None:
+            ledger = PhaseLedger(trace=span)
+        elif span is not None and ledger.trace is None:
+            ledger.trace = span
+            ledger.trace_id = span.trace_id
         try:
             if self.auth is not None:
-                t_auth = time.monotonic() if span is not None else 0.0
+                t_auth = time.monotonic()
                 verdict = self.auth.check(method, target, headers.get("authorization"))
+                ledger.add("auth", time.monotonic() - t_auth, start=t_auth)
                 if span is not None:
                     tr.record_interval("http.auth", t_auth, parent=span)
                 if verdict is not True:
@@ -562,6 +575,7 @@ class AsyncHTTPServer:
                 body=body,
                 headers=headers,
                 trace=span,
+                ledger=ledger,
             )
             loop = asyncio.get_running_loop()
             dspan = (
@@ -604,6 +618,8 @@ class AsyncHTTPServer:
                 ))
             return status, payload, ctype, tuple(hdrs)
         finally:
+            if own_ledger:
+                get_perfattr().observe_request(ledger)
             if own_span:
                 tr.finish(span)
                 tr.log_if_slow(span, log)
@@ -630,14 +646,22 @@ class AsyncHTTPServer:
             )
             if parse_start:
                 tr.record_interval("http.parse", parse_start, parent=span)
+        ledger = PhaseLedger(trace=span)
+        if parse_start:
+            # head received -> request line/headers/body fully parsed
+            ledger.add(
+                "parse", time.monotonic() - parse_start, start=parse_start
+            )
         status, payload, ctype, extra = await self._process(
-            method, target, headers, body, span=span
+            method, target, headers, body, span=span, ledger=ledger
         )
         gzip_ok = "gzip" in headers.get("accept-encoding", "").lower()
-        t_resp = time.monotonic() if span is not None else 0.0
+        t_resp = time.monotonic()
         await self._write_response(
             writer, status, payload, ctype, method, gzip_ok=gzip_ok, extra=extra
         )
+        ledger.add("write", time.monotonic() - t_resp, start=t_resp)
+        get_perfattr().observe_request(ledger)
         if span is not None:
             tr.record_interval("http.respond", t_resp, parent=span)
             tr.finish(span, status=status)

@@ -45,6 +45,11 @@ import time
 from concurrent.futures import Future
 
 from oryx_tpu.common import faults
+from oryx_tpu.common.perfattr import (
+    classify_idle_gap,
+    current_ledger,
+    get_perfattr,
+)
 from oryx_tpu.common.perfstats import get_perfstats
 from oryx_tpu.common.tracing import current_span, get_tracer
 from oryx_tpu.serving.futureutil import try_set_exception, try_set_result
@@ -61,6 +66,11 @@ _TRACER = get_tracer()
 # resolved device group records FLOPs/bytes/wall/occupancy, every host
 # fallback zeroes the live MFU window
 _PERF = get_perfstats()
+
+# process-singleton latency attribution (common/perfattr.py): per-request
+# phase stamps (queue_wait/batch_wait/pad/device/host_fallback), device
+# idle-gap classification, and XLA compile telemetry
+_PA = get_perfattr()
 
 
 def _dispatch_bytes(padded: int, features: int, y, kb: int) -> float:
@@ -175,7 +185,7 @@ class _Pending:
     __slots__ = (
         "vec", "k", "y", "future", "host_mat", "cosine", "host_norms",
         "recall", "valid_rows", "score_mode", "t_enq", "trace_parent",
-        "dev_span",
+        "dev_span", "ledger",
     )
 
     def __init__(self, vec, k, y, future, host_mat=None, cosine=False,
@@ -197,12 +207,16 @@ class _Pending:
         # quantized | approx) — labels the dispatch's perfstats record so
         # per-mode throughput/latency are separable on /metrics
         self.score_mode = score_mode
-        # tracing (only populated while tracing is enabled): enqueue time
-        # for the queue-wait span, the submitting request's span as
-        # parent, and a one-element box holding the in-flight device span
+        # enqueue time: always stamped at submit — the queue_wait phase
+        # stamp needs it regardless of tracing. trace_parent/dev_span are
+        # only populated while tracing is enabled (the submitting
+        # request's span as parent, and a one-element box holding the
+        # in-flight device span); ledger is the submitting request's
+        # PhaseLedger (common/perfattr.py), or None off the request path
         self.t_enq = 0.0
         self.trace_parent = None
         self.dev_span = None
+        self.ledger = None
 
     def take_dev_span(self):
         """Claim the in-flight device span, exactly once: the dispatcher's
@@ -237,12 +251,16 @@ class _Pending:
             return False
         try:
             tr = _TRACER
-            t0 = time.monotonic() if tr.enabled else 0.0
+            t0 = time.monotonic()
             result = host_topk(
                 self.vec, self.k, self.host_mat, self.cosine,
                 self.host_norms,
             )
-            if tr.enabled and self.t_enq:
+            if self.ledger is not None:
+                self.ledger.add(
+                    "host_fallback", time.monotonic() - t0, start=t0
+                )
+            if tr.enabled:
                 tr.record_interval(
                     "batcher.host_score", t0, parent=self.trace_parent,
                     k=self.k,
@@ -320,6 +338,18 @@ class TopKBatcher:
         self._probing = False  # guarded-by: _lock
         self._probe_started = 0.0  # guarded-by: _lock
         self._last_y = None  # guarded-by: _lock
+        # idle-gap attribution (common/perfattr.py): _gap_mark is when the
+        # device was last known busy (dispatch issued / results fetched);
+        # the accumulators hold measured slices of the idle time since —
+        # cond waits (empty queue), resolve fetch/distribution tails
+        # (host serialize), and down-window backoff. Classified and reset
+        # at the next dispatch issue (_launch), reset whenever the device
+        # finishes work (_resolve).
+        self._gap_mark = time.monotonic()  # guarded-by: _lock
+        self._gap_wait = 0.0  # guarded-by: _lock
+        self._gap_resolve = 0.0  # guarded-by: _lock
+        self._gap_down = 0.0  # guarded-by: _lock
+        self._down_since = 0.0  # guarded-by: _lock
         # observability: dispatch count + coalesced-request count let a
         # /metrics scrape compute the achieved mean batch size;
         # host_fallbacks counts requests actually scored on the host.
@@ -490,11 +520,24 @@ class TopKBatcher:
             vec, int(k), y, fut, host_mat, cosine, host_norms,
             float(recall), valid_rows, score_mode,
         )
+        # queue-wait measures from here to the dispatcher picking the
+        # batch up; the ledger is the submitting request's (thread-local,
+        # installed by ServingApp.dispatch_nowait — None off the request
+        # path, e.g. bench/probe submits)
+        p.t_enq = time.monotonic()
+        p.ledger = current_ledger()
+        if p.ledger is not None:
+            # the slice between the last stamped phase (parse/auth) and
+            # this enqueue is routing + handler pre-work building the
+            # query (model lookup, user-vector fetch) — charge it to
+            # parse so the budget keeps tiling the request wall-clock
+            # instead of leaking it between auth and queue_wait
+            tail = p.ledger.last_end()
+            if tail is not None and tail < p.t_enq:
+                p.ledger.add("parse", p.t_enq - tail, start=tail)
         if _TRACER.enabled:
             # parent = the submitting request's span (thread-current, set
-            # by ServingApp.dispatch_nowait); queue-wait measures from here
-            # to the dispatcher picking the batch up
-            p.t_enq = time.monotonic()
+            # by ServingApp.dispatch_nowait)
             p.trace_parent = current_span()
         with self._cond:
             if self._closed:
@@ -582,7 +625,10 @@ class TopKBatcher:
         while True:
             with self._cond:
                 while not self._queue and not self._closed and not inflight:
+                    t_w = time.monotonic()
                     self._cond.wait()
+                    # empty-queue idle accounting for the gap classifier
+                    self._gap_wait += time.monotonic() - t_w
                 if self._closed and not self._queue and not inflight:
                     return
                 if self._thread is not me:
@@ -630,9 +676,12 @@ class TopKBatcher:
         from oryx_tpu.ops.als import topk_dot_batch
 
         tr = _TRACER
+        # queue-wait ends now: the dispatcher owns the batch
+        t_pick = time.monotonic()
+        for p in batch:
+            if p.ledger is not None and p.t_enq:
+                p.ledger.add("queue_wait", t_pick - p.t_enq, start=p.t_enq)
         if tr.enabled:
-            # queue-wait ends now: the dispatcher owns the batch
-            t_pick = time.monotonic()
             for p in batch:
                 if p.t_enq:
                     tr.record_interval(
@@ -654,6 +703,7 @@ class TopKBatcher:
             self.coalesced += len(batch)
 
         launched = []
+        gap_pending = True  # classify the inter-dispatch idle gap once
         for (_, kb, recall), group in groups.items():
             # failures stay inside their group: a bad shape / OOM against
             # one target matrix must not fail requests scoring another
@@ -680,6 +730,7 @@ class TopKBatcher:
                     padded, kb, recall, tuple(y.shape),
                     str(getattr(y, "dtype", "")),
                 )
+                first_compile = False
                 with self._cond:
                     # recovery probes re-test against the latest matrix;
                     # the probe thread reads it under the same lock
@@ -693,12 +744,22 @@ class TopKBatcher:
                         # until it resolves) so it doesn't misread the
                         # compile as a wedged transport and permanently
                         # fail the device path over to host scoring
+                        first_compile = True
                         self._compiling[shape_key] = (
                             time.monotonic() + self.compile_timeout
                         )
+                for p in group:
+                    if p.ledger is not None:
+                        # picked -> this group starts forming
+                        p.ledger.add("batch_wait", t0 - t_pick, start=t_pick)
+                t_pad = time.monotonic()
                 xs = np.zeros((padded, y.shape[1]), dtype=np.float32)
                 for i, p in enumerate(group):
                     xs[i] = p.vec
+                pad_s = time.monotonic() - t_pad
+                for p in group:
+                    if p.ledger is not None:
+                        p.ledger.add("pad", pad_s, start=t_pad)
                 if tr.enabled:
                     # device span: dispatch issue until the host fetch
                     # resolves (_resolve); one span per request so every
@@ -709,6 +770,24 @@ class TopKBatcher:
                                 "batcher.device", parent=p.trace_parent,
                                 k=kb, batch=b, rows=padded,
                             )]
+                t_disp = time.monotonic()
+                if gap_pending:
+                    # the idle gap between the previous dispatch finishing
+                    # and this one being issued, split by measured cause
+                    gap_pending = False
+                    with self._lock:
+                        gap = t_disp - self._gap_mark
+                        causes = classify_idle_gap(
+                            gap, wait_s=self._gap_wait,
+                            serialize_s=self._gap_resolve,
+                            down_s=self._gap_down,
+                        )
+                        self._gap_wait = 0.0
+                        self._gap_resolve = 0.0
+                        self._gap_down = 0.0
+                        self._gap_mark = t_disp
+                    for cause, s in causes.items():
+                        _PA.record_idle_gap(cause, s)
                 vals, idx = topk_dot_batch(
                     jnp.asarray(xs), y, k=kb, recall=recall
                 )
@@ -717,6 +796,29 @@ class TopKBatcher:
                     idx.copy_to_host_async()
                 except AttributeError:  # non-jax array (tests with stubs)
                     pass
+                t_issued = time.monotonic()
+                with self._lock:
+                    # the device is busy from here: the next idle gap
+                    # starts when its results land (_resolve)
+                    self._gap_mark = max(self._gap_mark, t_issued)
+                if first_compile:
+                    # the jit call traces+compiles synchronously on the
+                    # first dispatch of a shape, then enqueues: the call
+                    # duration IS the compile stall (a warm call returns
+                    # in microseconds). Feed the compile telemetry, charge
+                    # the stall to the device's idle account, and mark it
+                    # as a distinct waterfall span — the first dispatch
+                    # after a generation swap lands here by construction
+                    # (a new matrix identity is a new shape signature).
+                    compile_s = t_issued - t_disp
+                    _PA.record_compile("serving", compile_s)
+                    _PA.record_idle_gap("compile_stall", compile_s)
+                    if tr.enabled:
+                        tr.record_interval(
+                            "batcher.compile_stall", t_disp, t_issued,
+                            parent=group[0].trace_parent,
+                            k=kb, rows=padded,
+                        )
                 # per-dispatch cost accounting, finalized at resolve time
                 # (wall-clock runs dispatch → host fetch materialized):
                 # occupancy = real rows / the capacity-padded view shape
@@ -727,6 +829,7 @@ class TopKBatcher:
                     b, padded, int(n_rows), int(y.shape[0]),
                     tp.trace_id if tp is not None else None,
                     group[0].score_mode,
+                    t_disp,
                 )
                 launched.append((group, kb, vals, idx, shape_key, cost))
             except Exception as e:
@@ -771,14 +874,16 @@ class TopKBatcher:
         try:
             vals = np.asarray(vals_dev)
             idx = np.asarray(idx_dev)
+            t_fetch = time.monotonic()
             # results are on the host: the dispatch's device work + fetch
             # is complete — record its cost (FLOPs/bytes/wall/occupancy)
             # into the live perf accounting
-            t0, flops, bytes_moved, b, padded, valid, cap, trace_id, mode = cost
+            (t0, flops, bytes_moved, b, padded, valid, cap, trace_id,
+             mode, t_disp) = cost
             _PERF.record_dispatch(
                 "serving",
                 flops=flops, bytes_moved=bytes_moved,
-                wall_s=time.monotonic() - t0, rows=b, padded_rows=padded,
+                wall_s=t_fetch - t0, rows=b, padded_rows=padded,
                 valid_rows=valid, capacity_rows=cap, trace_id=trace_id,
                 t_start=t0, score_mode=mode,
             )
@@ -791,16 +896,32 @@ class TopKBatcher:
             with self._cond:
                 self._compiled_shapes.add(shape_key)
                 self._compiling.pop(shape_key, None)
+                # the device finished this dispatch when the fetch landed:
+                # the next idle gap starts here. Earlier accumulator
+                # slices predate the device finishing — outside the new
+                # gap window by construction — so they reset with it.
+                if t_fetch > self._gap_mark:
+                    self._gap_mark = t_fetch
+                    self._gap_wait = 0.0
+                    self._gap_resolve = 0.0
+                    self._gap_down = 0.0
             for i, p in enumerate(group):
                 k_eff = min(p.k, kb)
                 span = p.take_dev_span()
                 if span is not None:
                     _TRACER.finish(span)
+                if p.ledger is not None:
+                    # dispatch issue -> results fetched to host
+                    p.ledger.add("device", t_fetch - t_disp, start=t_disp)
                 # the watchdog may have host-resolved this request while the
                 # fetch above sat on a wedged transport — and may win the
                 # race BETWEEN a done() check and the set; try_set absorbs
                 # the lost race instead of failing the rest of the group
                 try_set_result(p.future, (vals[i, :k_eff], idx[i, :k_eff]))
+            with self._lock:
+                # result-distribution tail: host work the device idles
+                # behind (the host_serialize slice of the next gap)
+                self._gap_resolve += time.monotonic() - t_fetch
         except Exception as e:
             log.exception("batcher group resolve failed (k=%d)", kb)
             with self._cond:
@@ -834,6 +955,7 @@ class TopKBatcher:
                 # dispatcher owns plus the whole queue on the host.
                 self.device_failovers += 1
                 self._device_down.set()
+                self._down_since = now  # idle-gap failover_backoff window
                 self._probe_at = time.monotonic() + self.probe_interval
                 stuck = list(self._inflight.values()) + self._queue
                 self._inflight.clear()
@@ -922,6 +1044,11 @@ class TopKBatcher:
                 if ok and self._device_down.is_set():
                     log.warning("device probe succeeded — resuming device path")
                     self._device_down.clear()
+                    if self._down_since:
+                        # the whole down window was device idle by fiat:
+                        # charge it to failover_backoff in the next gap
+                        self._gap_down += time.monotonic() - self._down_since
+                        self._down_since = 0.0
 
         threading.Thread(
             target=probe, name="oryx-topk-probe", daemon=True

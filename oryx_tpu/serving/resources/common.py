@@ -165,6 +165,16 @@ def register(app: ServingApp) -> None:
                 body["slo_errors"] = errs
         except Exception:  # noqa: BLE001 - a probe never 500s on slo state
             pass
+        try:
+            from oryx_tpu.common.perfattr import get_perfattr
+
+            # live latency budget: per-phase p50/p99/share over the
+            # rolling window plus ranked idle-gap causes — the fleet
+            # front's prober copies this into /fleet/status, and `oryx
+            # perf` renders the same shape from /metrics
+            body["latency_budget"] = get_perfattr().healthz_section()
+        except Exception:  # noqa: BLE001 - a probe never 500s on perfattr
+            pass
         # up->degraded edge: the first degraded probe snapshots the
         # flight recorder's black box off-thread (app.py note_health_state)
         a.note_health_state(bool(degraded), degraded)

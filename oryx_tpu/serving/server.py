@@ -14,6 +14,7 @@ from __future__ import annotations
 import gzip
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
@@ -22,6 +23,7 @@ from oryx_tpu.bus.api import ConsumeDataIterator, TopicProducer
 from oryx_tpu.bus.broker import get_broker
 from oryx_tpu.common.classutil import load_instance_of
 from oryx_tpu.common.config import Config
+from oryx_tpu.common.perfattr import PhaseLedger, get_perfattr
 from oryx_tpu.common.tracing import (
     format_traceparent,
     get_tracer,
@@ -321,18 +323,27 @@ def _make_handler(app: ServingApp, auth: Authenticator | None):
             log.debug("http: " + fmt, *args)
 
         def _handle(self, method: str) -> None:
+            # phase ledger from the first byte we act on: parse covers the
+            # body drain + URL split + gzip decode (the auth exchange is
+            # stamped separately below)
+            ledger = PhaseLedger()
+            t_parse0 = time.monotonic()
+            parse_s = 0.0
             # drain the body FIRST, even for requests that will 401 —
             # leaving unread bytes on a keep-alive socket desyncs the next
             # request on the connection (digest clients always see a 401
             # on their first exchange, so this path is routine, not rare)
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
+            parse_s += time.monotonic() - t_parse0
             if auth is not None:
                 # DIGEST by default (reference InMemoryRealm parity); the
                 # check returns a fresh challenge on any failure/staleness
+                t_auth = time.monotonic()
                 verdict = auth.check(
                     method, self.path, self.headers.get("Authorization")
                 )
+                ledger.add("auth", time.monotonic() - t_auth, start=t_auth)
                 if verdict is not True:
                     payload = b'{"status":401,"error":"unauthorized"}'
                     self.send_response(401)
@@ -342,6 +353,7 @@ def _make_handler(app: ServingApp, auth: Authenticator | None):
                     self.end_headers()
                     self.wfile.write(payload)
                     return
+            t_parse1 = time.monotonic()
             split = urlsplit(self.path)
             if self.headers.get("Content-Encoding", "").lower() == "gzip" and body:
                 import zlib
@@ -367,6 +379,9 @@ def _make_handler(app: ServingApp, auth: Authenticator | None):
                 body=body,
                 headers={k.lower(): v for k, v in self.headers.items()},
             )
+            parse_s += time.monotonic() - t_parse1
+            ledger.add("parse", parse_s, start=t_parse0)
+            req.ledger = ledger
             tr = get_tracer()
             span = None
             if tr.enabled:
@@ -376,10 +391,13 @@ def _make_handler(app: ServingApp, auth: Authenticator | None):
                     method=method, target=self.path, frontend="threaded",
                 )
                 req.trace = span
+                ledger.trace = span
+                ledger.trace_id = span.trace_id
             status, payload, ctype = app.dispatch(req)
             if span is not None:
                 tr.finish(span, status=status)
                 tr.log_if_slow(span, log)
+            t_write = time.monotonic()
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             if span is not None:
@@ -404,6 +422,10 @@ def _make_handler(app: ServingApp, auth: Authenticator | None):
             self.end_headers()
             if method != "HEAD":
                 self.wfile.write(payload)
+            # write covers headers + (gzip'd) payload hitting the socket;
+            # the flush after it is the ledger's single exit point
+            ledger.add("write", time.monotonic() - t_write, start=t_write)
+            get_perfattr().observe_request(ledger)
 
         def do_GET(self):
             self._handle("GET")

@@ -177,6 +177,27 @@ def test_record_dispatch_occupancy_and_mfu():
     assert over.occupancy == 1.0
 
 
+def test_record_dispatch_occupancy_degenerate_inputs():
+    """Regression (ISSUE 17): a zero-capacity or empty dispatch must
+    never observe a >1.0 or NaN occupancy sample — degenerate inputs
+    read as 0.0 (no real data streamed), not as a perfect batch."""
+    ps = _fresh_perfstats()
+    cases = [
+        dict(valid_rows=5, capacity_rows=0),    # zero capacity
+        dict(valid_rows=0, capacity_rows=128),  # empty dispatch
+        dict(valid_rows=0, capacity_rows=0),    # both degenerate
+        dict(valid_rows=-3, capacity_rows=64),  # nonsense negative
+    ]
+    for kw in cases:
+        r = ps.record_dispatch(
+            "serving", flops=1.0, bytes_moved=0, wall_s=0.001,
+            rows=1, padded_rows=1, **kw,
+        )
+        assert r.occupancy == 0.0, kw
+        assert not math.isnan(r.occupancy)
+        assert r.occupancy <= 1.0
+
+
 def test_mfu_nan_without_peak_and_zero_during_fallback():
     ps = _fresh_perfstats(window_s=0.2)
     ps.record_dispatch(

@@ -42,6 +42,7 @@ DOC_ROW = _rule.DOC_ROW
 IGNORE = _rule.METRIC_IGNORE
 REQUIRED_BENCH_FIELDS = _rule.REQUIRED_BENCH_FIELDS
 REQUIRED_DOC_TOKENS = _rule.REQUIRED_DOC_TOKENS
+REQUIRED_PERFATTR_FAMILIES = _rule.REQUIRED_PERFATTR_FAMILIES
 
 
 def code_metric_names() -> dict[str, str]:

@@ -76,7 +76,19 @@ REQUIRED_BENCH_FIELDS = (
     "shard_topk_scaling_2shard",
     "train_mfu",
 )
-REQUIRED_DOC_TOKENS = ("score_mode", "shard", "signal")
+REQUIRED_DOC_TOKENS = ("score_mode", "shard", "signal", "phase", "cause")
+
+# Hot-path latency-attribution vocabulary (ISSUE 17): the perfattr
+# families (common/perfattr.py) must stay BOTH registered in code and
+# documented — dashboards, `oryx perf`, and the latency-budget runbook
+# all key on these exact names, so a rename must fail tier-1 loudly
+# rather than silently orphan them.
+REQUIRED_PERFATTR_FAMILIES = (
+    "oryx_request_phase_seconds",
+    "oryx_device_idle_gap_seconds",
+    "oryx_xla_compile_seconds",
+    "oryx_xla_compiles_total",
+)
 
 
 # -- collectors (shared with the thin CLI wrappers) --------------------------
@@ -183,6 +195,28 @@ def metric_doc_problems(
             f"{name}: documented in docs/observability.md but not found "
             "anywhere under oryx_tpu/"
         )
+    problems.extend(perfattr_family_problems(set(code), doc_names))
+    return problems
+
+
+def perfattr_family_problems(
+    code_names: set[str], doc_names: set[str]
+) -> list[str]:
+    """The latency-attribution families must exist on both sides — the
+    generic drift checks only see names that exist SOMEWHERE, so a family
+    deleted from both code and docs would otherwise pass silently."""
+    problems: list[str] = []
+    for name in REQUIRED_PERFATTR_FAMILIES:
+        if name not in code_names:
+            problems.append(
+                f"{name}: required latency-attribution family not "
+                "registered anywhere under oryx_tpu/ (common/perfattr.py)"
+            )
+        if name not in doc_names:
+            problems.append(
+                f"{name}: required latency-attribution family missing "
+                "from the docs/observability.md metric reference table"
+            )
     return problems
 
 
@@ -246,6 +280,8 @@ def metric_findings(
             f"{name} documented in {doc_rel} but not found anywhere under "
             "oryx_tpu/",
         ))
+    for problem in perfattr_family_problems(set(code), doc_names):
+        out.append(Finding(doc_rel, 1, "metric-docs", problem))
     bench = root / "bench.py"
     bench_text = bench.read_text(encoding="utf-8") if bench.exists() else ""
     for name in REQUIRED_BENCH_FIELDS:
