@@ -77,6 +77,21 @@ def _dispatch_update(handler, km: KeyMessage) -> None:
         except Exception:
             _log.exception("ignoring bad MODEL-CHUNK message")
         return
+    if km.key in ("MODEL", "MODEL-REF", "TRACE"):
+        # staged-adoption gate (common/modelgate.py): on a canary or
+        # held replica the gate buffers each model until its stamp names
+        # a generation, then adopts/holds/refuses it. Off (the default)
+        # this is one attribute read; a consumed message is the gate's
+        # to deliver later through _dispatch_model below.
+        from oryx_tpu.common.modelgate import get_model_gate
+
+        gate = get_model_gate()
+        if gate.active:
+            try:
+                if gate.offer(handler, km):
+                    return
+            except Exception:  # pragma: no cover - defensive
+                _log.exception("model gate failed; dispatching ungated")
     if km.key == "TRACE":
         # framework-level publish stamp (common/freshness.py): follows its
         # MODEL/MODEL-REF on the update topic and feeds the
@@ -89,6 +104,14 @@ def _dispatch_update(handler, km: KeyMessage) -> None:
         except Exception:
             _log.exception("ignoring bad TRACE publish stamp")
         return
+    _dispatch_model(handler, km)
+
+
+def _dispatch_model(handler, km: KeyMessage) -> None:
+    """The retry/park/freshness leg of _dispatch_update, factored out so
+    the model gate can deliver an adopted generation through the exact
+    same machinery (and a parked MODEL-REF's late re-dispatch re-enters
+    HERE, below the gate — the gate already decided to adopt it)."""
     retries = 3 if km.key in ("MODEL", "MODEL-REF") else 0
     for attempt in range(retries + 1):
         try:
@@ -122,7 +145,7 @@ def _dispatch_update(handler, km: KeyMessage) -> None:
                             km.message,
                         )
                         relay.park(
-                            km.message, lambda: _dispatch_update(handler, km)
+                            km.message, lambda: _dispatch_model(handler, km)
                         )
                         parked = True
                 if not parked:

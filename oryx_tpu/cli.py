@@ -823,7 +823,7 @@ def cmd_fleet(config: Config, args, raw_argv: list[str]) -> int:
     out to the replicas. Dead replicas are restarted with backoff; a
     crash-looping fleet exits nonzero (docs/operations.md "Running a
     serving fleet")."""
-    from oryx_tpu.fleet import FleetFront, FleetSupervisor
+    from oryx_tpu.fleet import FleetController, FleetFront, FleetSupervisor
 
     overlay = {}
     if args.replicas is not None:
@@ -838,6 +838,7 @@ def cmd_fleet(config: Config, args, raw_argv: list[str]) -> int:
         config = config.overlay(overlay)
     sup = FleetSupervisor(config, argv=_fleet_child_flags(raw_argv))
     front = None
+    controller = None
     prev_term = signal.signal(signal.SIGTERM, lambda *_: sup.request_stop())
     rc = 0
     try:
@@ -845,6 +846,12 @@ def cmd_fleet(config: Config, args, raw_argv: list[str]) -> int:
         sup.wait_listening(timeout=120)
         front = FleetFront(config, backends=sup.backends())
         front.start()
+        # the closed control loop over both: canary rollout + promotion
+        # gating when oryx.fleet.canary.enabled, SLO-burn autoscaling
+        # when oryx.fleet.autoscale.enabled (a no-op thread otherwise —
+        # it still mirrors crash-loop give-ups into /fleet/status)
+        controller = FleetController(config, sup, front)
+        controller.start()
         print(
             f"fleet: {len(sup.ports())} replicas on ports "
             f"{sup.ports()[0]}..{sup.ports()[-1]}, front :{front.port} "
@@ -855,6 +862,8 @@ def cmd_fleet(config: Config, args, raw_argv: list[str]) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if controller is not None:
+            controller.close()  # no new rollout/scale decisions mid-teardown
         if front is not None:
             front.close()  # stop taking traffic before killing backends
         sup.stop()

@@ -245,6 +245,11 @@ class ArtifactRelay:
         # adopted instead of redundantly re-decoded. Configured from
         # oryx.fleet.distribution.shared (configure_artifact_relay).
         self.shared_distribution = True
+        # cache dirs the LRU must never evict (ref -> pin count): the
+        # model gate pins its adoption history so a rollback target is
+        # still a local pointer swap however many generations replay
+        # through the cache in between
+        self._pinned: dict[str, int] = {}  # guarded-by: _lock
 
     def _root(self) -> Path:
         if self._cache_root is None:
@@ -468,9 +473,11 @@ class ArtifactRelay:
             except OSError:  # concurrently evicted by a sibling
                 return 0.0
 
+        with self._lock:
+            pinned = {self._dest(r).name for r in self._pinned}
         dirs.sort(key=mtime)
         for d in dirs[: len(dirs) - self.MAX_CACHED]:
-            if d != keep:
+            if d != keep and d.name not in pinned:
                 shutil.rmtree(d, ignore_errors=True)
 
     def _evict_locked(self, keep: str) -> None:
@@ -531,6 +538,31 @@ class ArtifactRelay:
                 logging.getLogger(__name__).exception(
                     "parked MODEL-REF re-dispatch failed for %s", ref
                 )
+
+    def pin(self, ref: str) -> None:
+        """Exempt a ref's cache dir from LRU eviction (refcounted — the
+        model gate pins every adoption-history entry and a generation can
+        re-enter history). Pinning is advisory: it protects the CACHE
+        copy only, and a ref resolving through its original path needs no
+        protection at all."""
+        import os
+
+        with self._lock:
+            self._pinned[ref] = self._pinned.get(ref, 0) + 1
+        try:
+            os.utime(self._dest(ref))
+        except OSError:
+            pass  # not materialized here (original path, inline MODEL)
+
+    def unpin(self, ref: str) -> None:
+        """Drop one pin on a ref; at zero the dir rejoins the normal LRU
+        (not deleted eagerly — it may be the freshest entry)."""
+        with self._lock:
+            n = self._pinned.get(ref, 0) - 1
+            if n <= 0:
+                self._pinned.pop(ref, None)
+            else:
+                self._pinned[ref] = n
 
     def resolve(self, ref: str) -> str:
         """A readable local path for a MODEL-REF: the path itself when it

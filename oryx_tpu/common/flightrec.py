@@ -84,6 +84,33 @@ EVENT_KINDS: dict[str, str] = {
         "a latency fast-burn triggered an automatic bounded profile "
         "window (perfstats summary + phase budget) into the ring"
     ),
+    "canary-start": (
+        "the fleet controller split a traffic cohort to the canary "
+        "replica for a newly published generation"
+    ),
+    "canary-hold": (
+        "a canary rollout is waiting for enough shadow-rescored samples "
+        "to judge the new generation (episode-limited heartbeat)"
+    ),
+    "canary-promote": (
+        "the canary generation passed its quality/latency/recall gate "
+        "and was approved fleet-wide (hold replicas adopted it)"
+    ),
+    "canary-rollback": (
+        "the canary generation was rolled back to its predecessor — a "
+        "pointer swap from the pinned artifact cache — with the burn/"
+        "recall evidence that forced it"
+    ),
+    "autoscale": (
+        "the fleet controller changed capacity: up spawned and joined a "
+        "replica, down drained one, stopped it, and removed its ring "
+        "keys"
+    ),
+    "crash-loop": (
+        "the fleet supervisor gave up restarting crash-looping replicas "
+        "(max fast fails reached); the affected replicas surface as "
+        "state=gave_up on /fleet/status"
+    ),
 }
 
 _SEGMENT_PREFIX = "events-"

@@ -323,6 +323,20 @@ class PerfStats:
             total = self._win_sum.get(kind, 0.0)
         return total / self.window_s if total else 0.0
 
+    def window_occupancy(self, kind: str) -> tuple[float | None, int]:
+        """(mean dispatch batch occupancy over the rolling window, number
+        of dispatches it averages) — (None, 0) when the window is idle.
+        The fleet autoscaler's scale-DOWN signal: sustained low occupancy
+        means the padding headroom is mostly waste and the fleet has more
+        replicas than the offered load fills."""
+        recs = [
+            r for r in self.records_since(time.monotonic() - self.window_s)
+            if r.kind == kind
+        ]
+        if not recs:
+            return None, 0
+        return sum(r.occupancy for r in recs) / len(recs), len(recs)
+
     def mfu(self, kind: str) -> float:
         """Rolling-window MFU in [0,1]; 0.0 during the kind's fallback
         window; NaN when no peak (chip or assumed) is known."""

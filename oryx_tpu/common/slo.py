@@ -212,6 +212,24 @@ def current_burn(slo: str, fast: bool = True) -> float | None:
     return t.burn_rate(t.fast_s if fast else t.slow_s)
 
 
+def burn_snapshot() -> dict[str, dict[str, float]]:
+    """slo -> {"fast": burn, "slow": burn} for every tracker registered
+    in this process — the /healthz ``slo_burn`` section the fleet front's
+    prober copies into /fleet/status, and the evidence block the canary
+    gate's promote/rollback flight events carry. Same sample-gated math
+    as the oryx_slo_burn_rate gauges, so a scrape and a probe in the
+    same instant read one sample."""
+    with _trackers_lock:
+        items = list(_trackers.items())
+    return {
+        name: {
+            "fast": round(t.burn_rate(t.fast_s), 4),
+            "slow": round(t.burn_rate(t.slow_s), 4),
+        }
+        for name, t in items
+    }
+
+
 def _ensure(
     slo: str,
     objective: float,

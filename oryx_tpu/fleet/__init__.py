@@ -14,6 +14,9 @@ independent serving processes behind a thin L7 front:
   replicas' ``GET /healthz`` degraded states, and Retry-After-aware
   retry of shed requests on a different replica.
 - :mod:`oryx_tpu.fleet.ring` is the hash ring behind the hash policy.
+- :mod:`oryx_tpu.fleet.control` closes the loop: canary rollout with
+  quality-gated promotion and pointer-swap rollback, plus SLO-burn
+  autoscaling with connection draining on scale-down.
 
 Model distribution is amortized across co-hosted replicas by the shared
 artifact relay cache (``common/artifact.py``): MODEL-CHUNK reassembly
@@ -22,10 +25,12 @@ happens once per host, measured by
 """
 
 from oryx_tpu.fleet.ring import HashRing
+from oryx_tpu.fleet.control import FleetController
 from oryx_tpu.fleet.front import FleetFront, ReplicaInfo
 from oryx_tpu.fleet.supervisor import FleetSupervisor, replica_overlays
 
 __all__ = [
+    "FleetController",
     "FleetFront",
     "FleetSupervisor",
     "HashRing",

@@ -110,7 +110,7 @@ _DROP_REQUEST_HEADERS_B = frozenset(
     h.encode("ascii") for h in _DROP_REQUEST_HEADERS
 )
 
-_STATES = ("up", "degraded", "down")
+_STATES = ("up", "degraded", "down", "draining", "gave_up")
 
 
 class ReplicaInfo:
@@ -144,6 +144,20 @@ class ReplicaInfo:
         # perfattr.py) — federated verbatim into /fleet/status so "where
         # does the millisecond go" is answerable fleet-wide
         self.latency_budget: dict | None = None
+        # the replica's staged-adoption state (common/modelgate.py
+        # healthz_section) — the fleet controller reads canary/hold
+        # progress from here via /fleet/status
+        self.model_gate: dict | None = None
+        # the replica's own SLO burn snapshot (slo -> fast/slow burn) —
+        # the canary gate's promotion evidence
+        self.slo_burn: dict | None = None
+        # the replica's rolling dispatch-occupancy window — the
+        # autoscaler's scale-down signal
+        self.occupancy: dict | None = None
+        # proxied exchanges currently in flight to this replica
+        # (guarded-by: front._inflight_lock) — drain completion is
+        # "routable off AND inflight zero"
+        self.inflight = 0
         self.last_reasons: list[str] = []
 
     def snapshot(self) -> dict:
@@ -165,6 +179,10 @@ class ReplicaInfo:
             "quality": self.quality,
             "slo_errors": self.slo_errors,
             "latency_budget": self.latency_budget,
+            "model_gate": self.model_gate,
+            "slo_burn": self.slo_burn,
+            "occupancy": self.occupancy,
+            "inflight": self.inflight,
             "degraded": self.last_reasons,
         }
 
@@ -266,6 +284,14 @@ class FleetFront(AsyncHTTPServer):
         )
         self._rr_lock = threading.Lock()
         self._rr = 0  # guarded-by: _rr_lock
+        # canary traffic split (set_canary/clear_canary, driven by the
+        # fleet controller): while set, a stable hash cohort of request
+        # keys lands on the canary replica and everyone else stays on
+        # the incumbent fleet — same key, same cohort, every request
+        # (sessions stay sticky through the rollout)
+        self._canary_id: str | None = None
+        self._canary_fraction = 0.0
+        self._inflight_lock = threading.Lock()
         # keep-alive connection pool, keyed per (event loop, replica):
         # asyncio streams are loop-bound, so loops never share sockets
         self._pools: dict[tuple[int, str], list] = {}
@@ -328,6 +354,28 @@ class FleetFront(AsyncHTTPServer):
             "Device-view shard count each replica reports on /healthz "
             "(1 where unsharded); a replica disagreeing with the fleet's "
             "configured oryx.fleet.shards is treated as degraded",
+            labeled=True,
+        )
+        self._g_occ = reg.gauge(
+            "oryx_fleet_replica_occupancy",
+            "Mean serving dispatch batch occupancy each replica reports "
+            "on /healthz over its rolling perf window — the autoscaler's "
+            "scale-DOWN signal (sustained low occupancy across the fleet "
+            "means capacity is idle)",
+            labeled=True,
+        )
+        self._g_canary_fraction = reg.gauge(
+            "oryx_fleet_canary_traffic_fraction",
+            "Traffic fraction the front currently splits to the canary "
+            "replica (0 = no canary rollout in progress)",
+        )
+        self._m_canary_requests = reg.counter(
+            "oryx_fleet_canary_requests_total",
+            "Requests routed while a canary split was active, by cohort: "
+            "cohort=canary landed on the canary replica, cohort=fleet "
+            "stayed on the incumbent fleet (cohort membership is a "
+            "stable hash of the placement key, so one session never "
+            "flaps between generations mid-rollout)",
             labeled=True,
         )
         self._m_requests = reg.counter(
@@ -437,6 +485,12 @@ class FleetFront(AsyncHTTPServer):
             r.slo_errors = se if isinstance(se, dict) else None
             lb = body.get("latency_budget")
             r.latency_budget = lb if isinstance(lb, dict) else None
+            mg = body.get("model_gate")
+            r.model_gate = mg if isinstance(mg, dict) else None
+            sb = body.get("slo_burn")
+            r.slo_burn = sb if isinstance(sb, dict) else None
+            occ = body.get("occupancy")
+            r.occupancy = occ if isinstance(occ, dict) else None
             r.last_reasons = [str(x) for x in body.get("degraded") or []]
         if r.generation is not None:
             self._g_gen.set(float(r.generation), replica=r.id)
@@ -448,6 +502,18 @@ class FleetFront(AsyncHTTPServer):
             self._g_lag.set(float(r.update_lag), replica=r.id)
         if r.shards is not None:
             self._g_shards.set(float(r.shards), replica=r.id)
+        if isinstance(r.occupancy, dict) and isinstance(
+            r.occupancy.get("mean"), (int, float)
+        ):
+            self._g_occ.set(float(r.occupancy["mean"]), replica=r.id)
+
+        if r.state in ("draining", "gave_up"):
+            # a draining replica answers probes healthily ON PURPOSE (it
+            # is finishing in-flight work before a scale-down stop) and a
+            # gave-up one is dead on purpose (the supervisor stopped
+            # restarting it): neither re-enters routing through the
+            # readmit counter below
+            return
 
         expect = max(1, self.expect_shards)
         if status == 200 and (r.shards or 1) != expect:
@@ -507,7 +573,7 @@ class FleetFront(AsyncHTTPServer):
         gens = [
             r.generation
             for r in self.replicas
-            if r.state != "down" and r.generation
+            if r.state not in ("down", "gave_up") and r.generation
         ]
         self._g_skew.set(float(max(gens) - min(gens)) if len(gens) > 1 else 0.0)
 
@@ -519,12 +585,47 @@ class FleetFront(AsyncHTTPServer):
             return segs[self.hash_segment]
         return path
 
+    def _in_canary_cohort(self, path: str) -> bool:
+        """Stable cohort membership for the canary split: the SAME hash
+        key the placement policy uses, so a user either rides the canary
+        for the whole rollout or never sees it — a session comparing its
+        own recommendations across requests must not flap between
+        generations."""
+        import zlib
+
+        key = self._hash_key(path)
+        return (zlib.crc32(key.encode("utf-8", "replace")) % 10000) < int(
+            self._canary_fraction * 10000
+        )
+
     def _pick(self, path: str, tried: set[str]) -> ReplicaInfo | None:
         candidates = [
             r for r in self.replicas if r.routable and r.id not in tried
         ]
         if not candidates:
             return None
+        canary_id = self._canary_id
+        if canary_id is not None:
+            if self._in_canary_cohort(path):
+                if not tried:
+                    self._m_canary_requests.inc(cohort="canary")
+                canary = next(
+                    (r for r in candidates if r.id == canary_id), None
+                )
+                if canary is not None:
+                    return canary
+                # canary ejected or already tried: the cohort's requests
+                # spill to the incumbent fleet (availability over split
+                # purity — the controller sees the ejection and rolls
+                # back)
+            else:
+                if not tried:
+                    self._m_canary_requests.inc(cohort="fleet")
+                rest = [r for r in candidates if r.id != canary_id]
+                if rest:
+                    candidates = rest
+                # else the canary is the ONLY routable replica: serving
+                # the incumbent cohort from it beats a 503
         if self.policy == "hash":
             usable = {r.id for r in candidates}
             for node in self._ring.lookup_seq(self._hash_key(path)):
@@ -535,6 +636,92 @@ class FleetFront(AsyncHTTPServer):
             i = self._rr
             self._rr += 1
         return candidates[i % len(candidates)]
+
+    # -- control plane (fleet/control.py drives these) ----------------------
+
+    def set_canary(self, replica_id: str, fraction: float) -> None:
+        """Split a stable cohort of `fraction` of the placement keys to
+        one replica — the canary leg of a staged rollout."""
+        if replica_id not in self._by_id:
+            raise ValueError(f"unknown replica {replica_id!r}")
+        self._canary_fraction = min(1.0, max(0.0, float(fraction)))
+        self._canary_id = replica_id
+        self._g_canary_fraction.set(self._canary_fraction)
+
+    def clear_canary(self) -> None:
+        self._canary_id = None
+        self._canary_fraction = 0.0
+        self._g_canary_fraction.set(0.0)
+
+    def canary(self) -> tuple[str, float] | None:
+        cid = self._canary_id
+        return (cid, self._canary_fraction) if cid is not None else None
+
+    def add_replica(self, replica_id: str, host: str, port: int) -> ReplicaInfo:
+        """Scale-up entry point: join one replica to the routing table
+        and the hash ring (a ring add remaps ~1/N of the keyspace — the
+        minimal-reshuffle property tests/test_fleet.py asserts). The new
+        replica starts UNROUTABLE: its process is still binding, and the
+        prober readmits it after readmit-after healthy probes like any
+        recovered replica."""
+        if replica_id in self._by_id:
+            raise ValueError(f"replica {replica_id!r} already present")
+        r = ReplicaInfo(replica_id, host, port)
+        r.routable = False
+        r.state = "down"
+        self._by_id[replica_id] = r
+        # request/prober threads iterate self.replicas lock-free: publish
+        # a NEW list object, never mutate the one they may be walking
+        self.replicas = self.replicas + [r]
+        self._ring.add(replica_id)
+        return r
+
+    def remove_replica(self, replica_id: str) -> None:
+        """Drop a (drained) replica from routing and the ring; only the
+        removed replica's keys remap."""
+        r = self._by_id.pop(replica_id, None)
+        if r is None:
+            return
+        self.replicas = [x for x in self.replicas if x.id != replica_id]
+        self._ring.remove(replica_id)
+        if self._canary_id == replica_id:
+            self.clear_canary()
+        # pooled sockets to the removed replica: its process is being
+        # stopped, so close our ends instead of waiting for them to
+        # error out of the pool one checkout at a time
+        for key in [k for k in self._pools if k[1] == replica_id]:
+            for _, w in self._pools.pop(key, []):
+                try:
+                    w.close()
+                except Exception:  # pragma: no cover - loop-owned socket
+                    pass
+
+    def begin_drain(self, replica_id: str) -> bool:
+        """Stop routing NEW requests to a replica while its in-flight
+        ones finish (scale-down's graceful half: the caller polls
+        inflight() to zero before stopping the process)."""
+        r = self._by_id.get(replica_id)
+        if r is None:
+            return False
+        r.routable = False
+        r.state = "draining"
+        return True
+
+    def inflight(self, replica_id: str) -> int:
+        r = self._by_id.get(replica_id)
+        if r is None:
+            return 0
+        with self._inflight_lock:
+            return r.inflight
+
+    def mark_gave_up(self, replica_id: str) -> None:
+        """Reflect the supervisor's crash-loop give-up in the routing
+        table: the replica is out on purpose, not probe-recoverable."""
+        r = self._by_id.get(replica_id)
+        if r is None:
+            return
+        r.routable = False
+        r.state = "gave_up"
 
     # -- h1 fast-path proxying ---------------------------------------------
     #
@@ -857,6 +1044,17 @@ class FleetFront(AsyncHTTPServer):
         reusable — pool misses then show up per request in the stitched
         trace instead of hiding inside proxy time."""
         loop = asyncio.get_running_loop()
+        with self._inflight_lock:
+            r.inflight += 1
+        try:
+            return await self._fast_exchange_counted(r, method, target, fwd_block, body, loop, span)
+        finally:
+            with self._inflight_lock:
+                r.inflight -= 1
+
+    async def _fast_exchange_counted(
+        self, r, method, target, fwd_block, body, loop, span
+    ) -> tuple[int, bytes, bytes, bool]:
         key = (id(loop), r.id)
         pool = self._pools.get(key)
         conn = None
@@ -982,6 +1180,22 @@ class FleetFront(AsyncHTTPServer):
         """One forwarded exchange on a pooled connection. Raises OSError /
         asyncio errors on transport failure (the caller decides whether a
         retry is safe)."""
+        with self._inflight_lock:
+            r.inflight += 1
+        try:
+            return await self._proxy_once_counted(r, method, target, headers, body)
+        finally:
+            with self._inflight_lock:
+                r.inflight -= 1
+
+    async def _proxy_once_counted(
+        self,
+        r: ReplicaInfo,
+        method: str,
+        target: str,
+        headers: dict[str, str],
+        body: bytes,
+    ) -> tuple[int, bytes, str, tuple[tuple[str, str], ...]]:
         conn = await self._checkout(r)
         reader, writer = conn
         reusable = False
@@ -1257,6 +1471,16 @@ class FleetFront(AsyncHTTPServer):
                 {
                     "policy": self.policy,
                     "shards": self.expect_shards,
+                    # active canary split (null outside a rollout): the
+                    # controller's view of who serves the new generation
+                    "canary": (
+                        {
+                            "replica": self._canary_id,
+                            "fraction": self._canary_fraction,
+                        }
+                        if self._canary_id is not None
+                        else None
+                    ),
                     # SLO source reads that raised (slo -> last error):
                     # broken burn-rate math must be visible, not a
                     # silently flat gauge (oryx_slo_sample_errors_total)

@@ -75,6 +75,17 @@ def replica_overlays(
         config.get_string("oryx.fleet.data-dir", "file:/tmp/oryx_tpu/fleet")
     )
     base_id = config.get_string("oryx.id", None) or "fleet"
+    # staged rollout (fleet/control.py): with the canary plane enabled,
+    # ONE replica runs its model gate in canary mode (adopts every
+    # generation immediately, keeps rollback history) and the rest run
+    # in hold mode (park new generations until the controller promotes)
+    # — the per-replica half of "a new generation lands on the canary
+    # first" despite the update topic broadcasting to everyone
+    canary_rid = (
+        config.get_string("oryx.fleet.canary.replica", "r0")
+        if config.get_bool("oryx.fleet.canary.enabled", False)
+        else None
+    )
     overlays: list[dict[str, object]] = []
     for i in range(n):
         rid = f"r{i}"
@@ -105,6 +116,10 @@ def replica_overlays(
                 ),
             }
         )
+        if canary_rid is not None:
+            overlays[-1]["oryx.serving.model-gate.mode"] = (
+                "canary" if rid == canary_rid else "hold"
+            )
         if shards > 1:
             # the sharded-view knob rides the overlay so every replica of
             # this fleet serves the same (replicas x shards) topology
@@ -136,6 +151,10 @@ class FleetSupervisor:
     ):
         self.config = config
         self.overlays = replica_overlays(config, n, base_port, shards)
+        # the raw topology args, kept so scale_up() can extend the
+        # overlay table with the same resolution rules as construction
+        self._base_port_arg = base_port
+        self._shards_arg = shards
         # per-replica command prefixes (e.g. ["taskset", "-c", "0"]):
         # affinity set at exec time is inherited by every thread the
         # replica spawns, unlike a post-hoc sched_setaffinity(pid) which
@@ -170,6 +189,15 @@ class FleetSupervisor:
         self._backoff = 1.0  # guarded-by: _op_lock
         self._next_restart = 0.0  # guarded-by: _op_lock
         self.crash_looping = False
+        # replica ids the supervisor stopped restarting (crash-loop
+        # give-up) — the controller mirrors these into the front's
+        # routing table as state=gave_up, so /fleet/status tells an
+        # operator WHY a replica is out instead of showing a silent hole
+        self.gave_up: list[str] = []  # guarded-by: _op_lock
+        # slots stop_replica() emptied on purpose (scale-down): poll()
+        # never restarts them, scale_up() refills the lowest one first
+        # so ports stay dense
+        self._scaled_down: set[int] = set()  # guarded-by: _op_lock
         self._stopping = threading.Event()
         # flight artifacts harvested from dead replicas (newest last) —
         # the crash-loop-last-words paths an operator or chaos assertion
@@ -276,6 +304,7 @@ class FleetSupervisor:
                 # fast-fail accounting stays gated exactly as before:
                 # with restarts off (or already crash-looping) a death is
                 # an operator decision, not a loop to detect
+                rid = str(self.overlays[i]["oryx.fleet.replica.id"])
                 if self.restart and not self.crash_looping:
                     fast = now - self._spawned_at[i] < _FAST_FAIL_S
                     if fast:
@@ -287,11 +316,32 @@ class FleetSupervisor:
                                 p.returncode,
                             )
                             self.crash_looping = True
+                            self.gave_up.append(rid)
+                            # the give-up is a lifecycle decision with
+                            # evidence, not just a log line: cli flight
+                            # replays it next to the deaths that caused it
+                            try:
+                                from oryx_tpu.common.flightrec import (
+                                    get_flightrec,
+                                )
+
+                                get_flightrec().record(
+                                    kind="crash-loop", replica=rid,
+                                    returncode=p.returncode,
+                                    fast_fails=self._fast_fails,
+                                    max_fast_fails=self.max_fast_fails,
+                                    harvests=len(self.harvested),
+                                )
+                            except Exception:  # noqa: BLE001
+                                log.exception("crash-loop flight event failed")
                             return
                         self._backoff = min(self._backoff * 2, 30.0)
                     else:
                         self._fast_fails = 0
                         self._backoff = 1.0
+                elif self.crash_looping and rid not in self.gave_up:
+                    # deaths after the give-up are equally permanent
+                    self.gave_up.append(rid)
             if not self.restart or self.crash_looping:
                 continue
             if now < self._next_restart:
@@ -345,6 +395,72 @@ class FleetSupervisor:
                 return 1
             self._stopping.wait(1.0)
         return 0
+
+    # -- elastic capacity (fleet/control.py autoscaler) ----------------------
+
+    def scale_up(self) -> tuple[str, int]:
+        """Add one replica: refill the lowest scaled-down slot if one
+        exists (ports stay dense), else grow the overlay table by one.
+        Returns (replica id, port) for the front's add_replica."""
+        with self._op_lock:
+            if self._stopping.is_set():
+                raise RuntimeError("fleet supervisor is stopping")
+            if self._scaled_down:
+                idx = min(self._scaled_down)
+                self._scaled_down.discard(idx)
+            else:
+                idx = len(self.overlays)
+                self.overlays.append(
+                    replica_overlays(
+                        self.config, n=idx + 1,
+                        base_port=self._base_port_arg,
+                        shards=self._shards_arg,
+                    )[-1]
+                )
+                self.procs.append(None)
+                self._spawned_at.append(0.0)
+                self._death_counted.append(False)
+                if self.exec_prefixes is not None:
+                    # no affinity plan exists for an elastic replica;
+                    # run it unpinned rather than doubling up on a core
+                    self.exec_prefixes.append([])
+            self._death_counted[idx] = False
+            self.procs[idx] = self._spawn(idx)
+            o = self.overlays[idx]
+            return (
+                str(o["oryx.fleet.replica.id"]),
+                int(o["oryx.serving.api.port"]),
+            )
+
+    def stop_replica(self, replica_id: str, timeout: float = 15.0) -> bool:
+        """Gracefully stop ONE replica on purpose (scale-down, after the
+        front drained it): poll() never restarts the emptied slot, and
+        scale_up() refills it first."""
+        with self._op_lock:
+            idx = next(
+                (
+                    j for j, o in enumerate(self.overlays)
+                    if str(o["oryx.fleet.replica.id"]) == replica_id
+                ),
+                None,
+            )
+            if idx is None:
+                return False
+            p = self.procs[idx]
+            self.procs[idx] = None
+            self._death_counted[idx] = False
+            self._scaled_down.add(idx)
+        if p is not None and p.poll() is None:
+            p.terminate()
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    pass
+        return True
 
     # -- chaos / teardown --------------------------------------------------
 

@@ -317,6 +317,12 @@ class ServingApp:
         from oryx_tpu.common.qualitystats import configure_qualitystats
 
         configure_qualitystats(config)
+        # staged model adoption (common/modelgate.py): canary/hold/off
+        # per oryx.serving.model-gate.mode — the per-replica half of the
+        # fleet controller's canary rollout
+        from oryx_tpu.common.modelgate import configure_model_gate
+
+        configure_model_gate(config)
         # healthz up->degraded edge detection (note_health_state): the
         # transition automatically triggers a flight snapshot off-thread
         self._last_health_degraded = False

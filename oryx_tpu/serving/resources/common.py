@@ -142,6 +142,14 @@ def register(app: ServingApp) -> None:
             mfu = get_perfstats().mfu("serving")
             if not math.isnan(mfu):
                 body["mfu"] = round(mfu, 6)
+            # rolling-window dispatch occupancy: the fleet autoscaler's
+            # scale-down evidence (sustained low occupancy = padding
+            # headroom mostly waste), probed per replica off /healthz
+            occ, n_disp = get_perfstats().window_occupancy("serving")
+            if occ is not None:
+                body["occupancy"] = {
+                    "mean": round(occ, 4), "dispatches": n_disp,
+                }
         except Exception:  # noqa: BLE001 - perf accounting is optional
             pass
         try:
@@ -163,7 +171,26 @@ def register(app: ServingApp) -> None:
             errs = slo.sample_errors()
             if errs:
                 body["slo_errors"] = errs
+            # per-SLO fast/slow burn rates: the canary gate's promotion
+            # evidence, read per replica by the fleet controller so a
+            # canary's burn is judged against ITS traffic, not the
+            # fleet-merged /metrics view
+            burn = slo.burn_snapshot()
+            if burn:
+                body["slo_burn"] = burn
         except Exception:  # noqa: BLE001 - a probe never 500s on slo state
+            pass
+        try:
+            from oryx_tpu.common.modelgate import get_model_gate
+
+            # staged-adoption state (mode, watermark, held generation,
+            # adoption history): how the controller sees whether a
+            # canary adopted the new generation and a hold replica is
+            # still pinning the incumbent
+            gate = get_model_gate()
+            if gate.active:
+                body["model_gate"] = gate.healthz_section()
+        except Exception:  # noqa: BLE001 - a probe never 500s on gate state
             pass
         try:
             from oryx_tpu.common.perfattr import get_perfattr
@@ -188,6 +215,48 @@ def register(app: ServingApp) -> None:
     def ingest(a: ServingApp, req: Request):
         n = send_input_lines(a, _ingest_text(req), "ingest body")
         return 200, {"ingested": n}
+
+    # model-gate control plane (fleet/control.py drives these; an
+    # operator can too — docs/operations.md "Canary rollout & rollback").
+    # Deliberately exempt from the app's read-only mode: they mutate
+    # which already-published model serves, never application data.
+    @app.route("POST", "/control/model/approve")
+    def model_approve(a: ServingApp, req: Request):
+        """Raise the gate's approved watermark to the given generation; a
+        held generation at/under it is adopted before the response
+        returns. 409 while the gate is off."""
+        from oryx_tpu.common.modelgate import ModelGateError, get_model_gate
+
+        try:
+            doc = json.loads(req.body_text() or "{}")
+            generation = int(doc["generation"])
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+            raise OryxServingException(
+                400, 'body must be JSON {"generation": <int>}'
+            )
+        try:
+            return 200, get_model_gate().approve(generation)
+        except ModelGateError as e:
+            raise OryxServingException(409, str(e))
+
+    @app.route("POST", "/control/model/rollback")
+    def model_rollback(a: ServingApp, req: Request):
+        """Re-apply the previously adopted generation (pointer swap from
+        the pinned relay cache) and veto the current one. 409 while the
+        gate is off or holds no previous generation."""
+        from oryx_tpu.common.modelgate import ModelGateError, get_model_gate
+
+        try:
+            doc = json.loads(req.body_text() or "{}")
+        except json.JSONDecodeError:
+            doc = {}
+        reason = doc.get("reason") if isinstance(doc, dict) else None
+        try:
+            return 200, get_model_gate().rollback(
+                reason=str(reason) if reason else None
+            )
+        except ModelGateError as e:
+            raise OryxServingException(409, str(e))
 
     # NOT nonblocking: serializing a full ring (thousands of spans) on an
     # event loop would stall that loop's other connections

@@ -131,6 +131,16 @@ class ServingLayer:
             ensure(self.input_uri, self.input_topic, "input")
             input_producer = TopicProducer(get_broker(self.input_uri), self.input_topic)
 
+        # The app MUST exist before the model listener replays a single
+        # message: its constructor configures the config-level planes the
+        # listener's dispatch path consults — the model gate above all (a
+        # canary replica whose incumbent replays while the gate is still
+        # "off" adopts it OUTSIDE the gate's history, and the eventual
+        # rollback finds nothing to swap back to), plus the artifact
+        # relay's distribution mode, flight recorder, SLOs, and quality
+        # sampler.
+        self.app = ServingApp(self.config, self.model_manager, input_producer)
+
         # model listener: replay update topic from earliest forever
         # (ModelManagerListener.java:118-149)
         self._update_consumer = ConsumeDataIterator(
@@ -147,8 +157,6 @@ class ServingLayer:
             target=listen, name="oryx-serving-model-listener", daemon=True
         )
         self._listener.start()
-
-        self.app = ServingApp(self.config, self.model_manager, input_producer)
         # /healthz reports this consumer's update-topic backlog so a
         # fleet front can see a replica falling behind model distribution.
         # Sampled on a dedicated thread, never on the probe: lag() does

@@ -373,3 +373,47 @@ def test_cache_eviction_is_cross_process_lru_by_mtime(tmp_path, monkeypatch):
     alive = [p.exists() for p in paths]
     # 5 dirs, cap 3: the two oldest stamps go
     assert alive == [False, False, True, True, True], alive
+
+
+def test_pinned_refs_survive_eviction_until_unpinned(tmp_path, monkeypatch):
+    """A pinned ref (a model-gate rollback target) is exempt from LRU
+    eviction no matter how old its mtime; unpinning makes it ordinary
+    again. Pins are refcounted so two holders must both release."""
+    import os
+
+    monkeypatch.setattr(ArtifactRelay, "MAX_CACHED", 2)
+    relay = ArtifactRelay()
+    relay._cache_root = tmp_path / "isolated-root"
+    relay._cache_root.mkdir()
+    pinned_ref = str(tmp_path / "gen-0")
+    relay._materialize(pinned_ref, ModelArtifact("kmeans", {"i": "0"}, {}, {}))
+    os.utime(relay._dest(pinned_ref), (1000, 1000))  # oldest = first victim
+    relay.pin(pinned_ref)
+    relay.pin(pinned_ref)  # a second holder (parked + history)
+    newer = []
+    for i in range(1, 5):
+        ref = str(tmp_path / f"gen-{i}")
+        relay._materialize(ref, ModelArtifact("kmeans", {"i": str(i)}, {}, {}))
+        os.utime(relay._dest(ref), (1000 + i, 1000 + i))
+        newer.append(ref)
+    relay._evict_cache_dirs(keep=relay._dest(newer[-1]))
+    # over cap and oldest by mtime — but pinned, so it resolves
+    assert Path(relay.resolve(pinned_ref)).exists()
+
+    def _pressure(start: int) -> None:
+        # re-age gen-0 to the LRU victim slot FIRST (resolve() bumped
+        # its mtime as a shared LRU touch), then refill above cap —
+        # each materialize runs the evictor inline
+        os.utime(relay._dest(pinned_ref), (1000, 1000))
+        for i in range(start, start + 3):
+            r = str(tmp_path / f"gen-{i}")
+            relay._materialize(r, ModelArtifact("kmeans", {"i": str(i)}, {}, {}))
+            newer.append(r)
+
+    relay.unpin(pinned_ref)
+    _pressure(5)
+    assert Path(relay.resolve(pinned_ref)).exists()  # one holder remains
+    relay.unpin(pinned_ref)
+    _pressure(8)
+    with pytest.raises(OSError):
+        relay.resolve(pinned_ref)

@@ -542,3 +542,364 @@ def test_supervisor_counts_deaths_not_poll_ticks():
     assert sup._fast_fails == 2
     assert len(spawns) == 1
     assert not sup.crash_looping
+
+
+# ---- control plane: drain / elastic ring / autoscaler / give-up -----------
+# (ISSUE 20: the in-process halves; the full canary rollout+rollback story
+# runs as tools/chaos.py `fleet-canary` via test_fleet_chaos.py)
+
+
+def test_front_drain_finishes_inflight_and_blocks_new_traffic():
+    """begin_drain is scale-down's graceful half: the draining replica
+    takes no NEW requests (its keys re-place on siblings) while the
+    request already inside it still gets its answer, and healthy probes
+    never readmit it — draining is an operator state, not a health
+    state."""
+    gate = threading.Event()
+
+    def slow(m, p):
+        gate.wait(15)
+        return (200, [], b'"r0"')
+
+    s0 = _StubReplica(slow)
+    s1 = _StubReplica(lambda m, p: (200, [], b'"r1"'))
+    front = _front_for([s0, s1], policy="hash", readmit_after=1)
+    try:
+        r0 = front._by_id["r0"]
+        deadline = time.time() + 10
+        while not all(r.routable for r in front.replicas):
+            assert time.time() < deadline
+            time.sleep(0.05)
+        # a user the ring places on r0, so the drain actually re-places it
+        u = next(k for k in KEYS if front._ring.lookup(k) == "r0")
+        got: list = []
+        t = threading.Thread(
+            target=lambda: got.append(_get(front.port, f"/recommend/{u}"))
+        )
+        t.start()
+        deadline = time.time() + 10
+        while front.inflight("r0") != 1:
+            assert time.time() < deadline, "request never reached r0"
+            time.sleep(0.02)
+
+        assert front.begin_drain("r0") is True
+        assert front.begin_drain("nope") is False
+        assert r0.state == "draining" and not r0.routable
+        # the SAME user's new requests re-place onto the sibling now
+        status, _, body = _get(front.port, f"/recommend/{u}")
+        assert (status, body) == (200, b'"r1"')
+        # ...while the in-flight request is still being answered
+        assert front.inflight("r0") == 1
+        gate.set()
+        t.join(timeout=10)
+        assert got and got[0][0] == 200 and got[0][2] == b'"r0"'
+        deadline = time.time() + 10
+        while front.inflight("r0") != 0:
+            assert time.time() < deadline
+            time.sleep(0.02)
+        # sticky: several healthy probe cycles later it is still draining
+        time.sleep(0.7)
+        assert r0.state == "draining" and not r0.routable
+    finally:
+        gate.set()
+        front.close()
+        s0.close()
+        s1.close()
+
+
+def test_front_add_remove_replica_minimal_reshuffle():
+    """The autoscaler's ring surface: add_replica joins unroutable (the
+    prober readmits it like any recovered replica) and remaps only the
+    ~1/N slice the new node takes over; remove_replica restores the
+    previous placement exactly and drops the canary pointer if the
+    victim held it."""
+    stubs = [
+        _StubReplica(lambda m, p: (200, [], b'{"ok":true}')) for _ in range(3)
+    ]
+    extra = _StubReplica(lambda m, p: (200, [], b'{"ok":true}'))
+    front = _front_for(stubs, policy="hash", readmit_after=1)
+    try:
+        before = {k: front._ring.lookup(k) for k in KEYS}
+        r3 = front.add_replica("r3", "127.0.0.1", extra.port)
+        assert r3.state == "down" and not r3.routable  # prober's call
+        assert [r.id for r in front.replicas] == ["r0", "r1", "r2", "r3"]
+        with pytest.raises(ValueError):
+            front.add_replica("r3", "127.0.0.1", extra.port)
+        moved = {k for k in KEYS if front._ring.lookup(k) != before[k]}
+        assert moved, "a grown ring must take over some keys"
+        assert all(front._ring.lookup(k) == "r3" for k in moved)
+        assert len(moved) <= 3.0 * len(KEYS) / 4
+        deadline = time.time() + 10
+        while not r3.routable:
+            assert time.time() < deadline, "healthy new replica never readmitted"
+            time.sleep(0.05)
+        front.set_canary("r3", 0.25)
+        assert front.canary() == ("r3", 0.25)
+        front.remove_replica("r3")
+        assert front.canary() is None
+        assert [r.id for r in front.replicas] == ["r0", "r1", "r2"]
+        assert {k: front._ring.lookup(k) for k in KEYS} == before
+        front.remove_replica("r3")  # removing twice is a no-op
+    finally:
+        front.close()
+        for s in stubs:
+            s.close()
+        extra.close()
+
+
+def test_controller_scale_down_drains_then_stops(tmp_path):
+    """Sustained low occupancy scales the fleet down through the graceful
+    sequence: pick the highest-index non-canary victim, drain it, THEN
+    stop the process and drop it from the ring — with the decision
+    evidence (drain + stopped phases) in the flight ring."""
+    from oryx_tpu.common.flightrec import configure_flightrec, read_events
+    from oryx_tpu.fleet.control import FleetController
+
+    idle = json.dumps(
+        {
+            "status": "up",
+            "degraded": [],
+            "occupancy": {"mean": 0.01, "dispatches": 100},
+        }
+    ).encode()
+    stubs = [
+        _StubReplica(lambda m, p: (200, [], b'{"ok":true}'), healthz=idle)
+        for _ in range(3)
+    ]
+    front = _front_for(stubs, readmit_after=1)
+
+    class _Sup:
+        gave_up: list = []
+
+        def __init__(self):
+            self.stopped: list[str] = []
+
+        def stop_replica(self, rid, timeout=15.0):
+            self.stopped.append(rid)
+            return True
+
+    cfg = load_config(
+        overlay={
+            "oryx.fleet.autoscale.enabled": True,
+            "oryx.fleet.autoscale.min-replicas": 2,
+            "oryx.fleet.autoscale.max-replicas": 3,
+            "oryx.fleet.autoscale.scale-down-occupancy": 0.15,
+            "oryx.fleet.autoscale.scale-down-after-sec": 0.0,
+            "oryx.fleet.autoscale.cooldown-sec": 0.0,
+            "oryx.fleet.autoscale.drain-timeout-sec": 5.0,
+            "oryx.monitoring.flight.dir": str(tmp_path / "flight"),
+        }
+    )
+    configure_flightrec(cfg)
+    sup = _Sup()
+    ctl = FleetController(cfg, sup, front)  # never started: manual ticks
+    try:
+        deadline = time.time() + 10
+        while not all(
+            r.routable and isinstance(r.occupancy, dict) for r in front.replicas
+        ):
+            assert time.time() < deadline, [r.snapshot() for r in front.replicas]
+            time.sleep(0.05)
+        down0 = ctl._m_autoscale.value(direction="down")
+        ctl.tick()  # arms the low-occupancy clock
+        assert ctl._draining is None
+        ctl.tick()  # sustained low occupancy: begins the drain
+        assert ctl._draining is not None and ctl._draining[0] == "r2"
+        assert front._by_id["r2"].state == "draining"
+        assert sup.stopped == []  # process still running: drain first
+        ctl.tick()  # nothing in flight: stop + remove
+        assert sup.stopped == ["r2"]
+        assert [r.id for r in front.replicas] == ["r0", "r1"]
+        assert ctl._m_autoscale.value(direction="down") - down0 == 1
+        phases = [
+            (e.get("phase"), e.get("replica"))
+            for e in read_events(str(tmp_path / "flight"))
+            if e["kind"] == "autoscale"
+        ]
+        assert ("drain", "r2") in phases and ("stopped", "r2") in phases
+        # min-replicas floor: the fleet never drains below it
+        for _ in range(6):
+            ctl.tick()
+        assert len(front.replicas) == 2 and ctl._draining is None
+        assert sup.stopped == ["r2"]
+    finally:
+        front.close()
+        for s in stubs:
+            s.close()
+
+
+def test_controller_failed_rollback_quarantines_canary(tmp_path):
+    """A rollback verdict whose pointer swap FAILS (409: the canary's
+    gate has no prior adoption in history, e.g. the incumbent loaded
+    before the gate armed) must NOT hand the canary's keys back to the
+    hash ring — the replica is still serving the vetoed generation. The
+    controller pins the split at fraction 0.0 instead (quarantine: no
+    cohort routes there, everyone else avoids it), and the next
+    rollout's set_canary replaces the quarantine."""
+    from oryx_tpu.common.flightrec import configure_flightrec, read_events
+    from oryx_tpu.fleet.control import FleetController
+
+    def _canary_healthz(gens, samples):
+        return json.dumps(
+            {
+                "status": "up",
+                "degraded": [],
+                "model_generation": gens[-1],
+                "model_gate": {"mode": "canary", "generations": gens},
+                "quality": {"samples": samples, "live_recall_at_10": 0.0},
+                "slo_burn": {"quality": {"fast": 20.0}},
+            }
+        ).encode()
+
+    hold_h = json.dumps(
+        {
+            "status": "up",
+            "degraded": [],
+            "model_generation": 1,
+            "model_gate": {"mode": "hold", "watermark": 1},
+            "quality": {"samples": 50, "live_recall_at_10": 1.0},
+        }
+    ).encode()
+    posts: list[str] = []
+
+    def refuse(method, path):
+        posts.append(f"{method} {path}")
+        return (409, [], b'{"status": 409, "error": "no history"}')
+
+    s0 = _StubReplica(refuse, healthz=_canary_healthz([2], 50))
+    s1 = _StubReplica(lambda m, p: (200, [], b'{"ok":true}'), healthz=hold_h)
+    front = _front_for([s0, s1], policy="hash", readmit_after=1)
+
+    class _Sup:
+        gave_up: list = []
+
+    cfg = load_config(
+        overlay={
+            "oryx.fleet.canary.enabled": True,
+            "oryx.fleet.canary.traffic-fraction": 0.25,
+            "oryx.fleet.canary.min-samples": 1,
+            "oryx.monitoring.flight.dir": str(tmp_path / "flight"),
+        }
+    )
+    configure_flightrec(cfg)
+    ctl = FleetController(cfg, _Sup(), front)  # never started: manual ticks
+    try:
+        deadline = time.time() + 10
+        while not all(
+            r.routable and isinstance(r.model_gate, dict) for r in front.replicas
+        ):
+            assert time.time() < deadline, [r.snapshot() for r in front.replicas]
+            time.sleep(0.05)
+        ctl.tick()  # generation 2 on the canary: the split opens
+        assert front.canary() == ("r0", 0.25)
+        # the canary accumulates quality evidence that breaches the gate
+        s0.healthz = _canary_healthz([2], 58)
+        deadline = time.time() + 10
+        while (front._by_id["r0"].quality or {}).get("samples") != 58:
+            assert time.time() < deadline
+            time.sleep(0.05)
+        ctl.tick()  # verdict: rollback — but the pointer swap 409s
+        assert any(p == "POST /control/model/rollback" for p in posts)
+        assert front.canary() == ("r0", 0.0)  # quarantined, NOT cleared
+        assert ctl._rollout is None and 2 in ctl._vetoed
+        ev = [
+            e
+            for e in read_events(str(tmp_path / "flight"))
+            if e["kind"] == "canary-rollback"
+        ]
+        assert ev and ev[-1]["quarantined"] is True
+        assert ev[-1]["rolled_back_to"] is None
+        # zero traffic reaches the quarantined replica; its keys re-place
+        for k in KEYS:
+            picked = front._pick(f"/recommend/{k}", set())
+            assert picked is not None and picked.id == "r1"
+        # the vetoed generation cannot restart a rollout...
+        ctl.tick()
+        assert front.canary() == ("r0", 0.0)
+        # ...but the NEXT generation's rollout replaces the quarantine
+        s0.healthz = _canary_healthz([2, 3], 58)
+        deadline = time.time() + 10
+        while (front._by_id["r0"].model_gate or {}).get("generations") != [2, 3]:
+            assert time.time() < deadline
+            time.sleep(0.05)
+        ctl.tick()
+        assert front.canary() == ("r0", 0.25)
+    finally:
+        front.close()
+        s0.close()
+        s1.close()
+
+
+def test_supervisor_crash_loop_gives_up_with_flight_event_and_front_state(
+    tmp_path,
+):
+    """max-fast-fails deaths within the fast-fail window stop the restart
+    churn: the supervisor records a crash-loop flight event with the
+    evidence an operator needs, and the controller mirrors the give-up
+    into the front as a sticky state=gave_up (healthy probes must NOT
+    readmit a replica the supervisor abandoned on purpose)."""
+    from oryx_tpu.common.flightrec import configure_flightrec, read_events
+    from oryx_tpu.fleet.control import FleetController
+    from oryx_tpu.fleet.supervisor import FleetSupervisor
+
+    cfg = load_config(
+        overlay={
+            "oryx.fleet.replicas": 1,
+            "oryx.fleet.base-port": 9400,
+            "oryx.fleet.supervisor.max-fast-fails": 2,
+            "oryx.monitoring.flight.dir": str(tmp_path / "flight"),
+        }
+    )
+    configure_flightrec(cfg)
+    sup = FleetSupervisor(cfg)
+
+    class _Dead:
+        returncode = 9
+
+        def poll(self):
+            return 9
+
+    spawns: list[int] = []
+    sup._spawn = lambda i: spawns.append(i) or _Dead()  # type: ignore[assignment]
+    sup.procs[0] = _Dead()
+    sup._spawned_at[0] = time.monotonic()  # dies instantly = fast fail
+    sup._backoff = 0.01  # the restart gate opens almost immediately
+
+    deadline = time.time() + 10
+    while not sup.crash_looping:
+        assert time.time() < deadline, (sup._fast_fails, spawns)
+        sup.poll()
+        time.sleep(0.02)
+    assert sup.gave_up == ["r0"]
+    assert len(spawns) == 1  # one restart attempt, then the give-up
+    ev = [
+        e
+        for e in read_events(str(tmp_path / "flight"))
+        if e["kind"] == "crash-loop"
+    ]
+    assert len(ev) == 1
+    assert ev[0]["replica"] == "r0"
+    assert ev[0]["fast_fails"] == 2 and ev[0]["max_fast_fails"] == 2
+    assert ev[0]["returncode"] == 9
+
+    stub = _StubReplica(lambda m, p: (200, [], b'{"ok":true}'))
+    front = _front_for([stub], readmit_after=1)
+    try:
+        r0 = front.replicas[0]
+        deadline = time.time() + 10
+        while not r0.routable:
+            assert time.time() < deadline
+            time.sleep(0.05)
+        ctl = FleetController(load_config(), sup, front)
+        ctl.tick()
+        assert r0.state == "gave_up" and not r0.routable
+        # sticky across healthy probe cycles
+        time.sleep(0.7)
+        assert r0.state == "gave_up" and not r0.routable
+        status, _, body = _get(front.port, "/fleet/status")
+        assert status == 200
+        doc = json.loads(body)
+        assert {r["id"]: r["state"] for r in doc["replicas"]}["r0"] == "gave_up"
+    finally:
+        front.close()
+        stub.close()

@@ -40,3 +40,16 @@ def test_flight_on_kill_harvests_corpse_last_words(tmp_path):
     doc, fn = chaos.SCENARIOS["flight-on-kill"]
     problems = fn(str(tmp_path))
     assert problems == []
+
+
+def test_fleet_canary_gates_bad_generation_and_rolls_back_pointer(tmp_path):
+    """ISSUE 20 acceptance (degraded-model chaos, fleet edition): a
+    corrupted generation reaches ONLY the canary replica, the quality
+    gate refuses promotion, the rollback is a pure pointer swap (zero
+    new distribution bytes), no client saw a non-shed 5xx, and the
+    flight rings tell the story in causal order (canary-start ->
+    quality-alarm -> canary-rollback)."""
+    chaos = _chaos_module()
+    doc, fn = chaos.SCENARIOS["fleet-canary"]
+    problems = fn(str(tmp_path))
+    assert problems == []

@@ -1,0 +1,284 @@
+"""Model-gate units (ISSUE 20): staged adoption of published generations
+on one replica — hold/park/approve watermark semantics, canary adoption
+history, pointer-swap rollback with veto, bootstrap safety of an unarmed
+gate, and the artifact-relay pinning that keeps rollback targets
+LRU-proof. The fleet-scale composition (controller + front + real
+replica processes) lives in tools/chaos.py `fleet-canary` via
+tests/test_fleet_chaos.py."""
+
+from __future__ import annotations
+
+import pytest
+
+from oryx_tpu.bus.api import KeyMessage
+from oryx_tpu.common.config import load_config
+from oryx_tpu.common.freshness import publish_stamp
+from oryx_tpu.common.modelgate import ModelGate, ModelGateError
+
+
+class _Handler:
+    """Records every (key, message) the gate delivers through the normal
+    dispatch machinery."""
+
+    def __init__(self):
+        self.loads: list[tuple[str, str]] = []
+
+    def __call__(self, key, message):
+        self.loads.append((key, message))
+
+
+def _gate(mode: str, history: int = 4) -> ModelGate:
+    g = ModelGate()
+    g.configure(
+        load_config(
+            overlay={
+                "oryx.serving.model-gate.mode": mode,
+                "oryx.serving.model-gate.history": history,
+            }
+        )
+    )
+    return g
+
+
+def _offer_generation(gate, handler, gen: int, message: str | None = None):
+    """Publish order on the update topic: MODEL, then its TRACE stamp."""
+    msg = message if message is not None else f"model-gen-{gen}"
+    assert gate.offer(handler, KeyMessage("MODEL", msg))
+    return gate.offer(
+        handler, KeyMessage("TRACE", publish_stamp(generation=gen))
+    )
+
+
+def test_off_gate_is_never_consulted():
+    g = _gate("off")
+    assert not g.active
+
+
+def test_configure_rejects_unknown_mode():
+    with pytest.raises(ValueError):
+        _gate("blue-green")
+
+
+def test_unarmed_hold_gate_adopts_bootstrap_replay():
+    """A restarting hold replica replays the topic from earliest with no
+    watermark yet: it must adopt (not hold hostage) its bootstrap model."""
+    g = _gate("hold")
+    h = _Handler()
+    assert _offer_generation(g, h, 1)
+    assert h.loads == [("MODEL", "model-gen-1")]
+    assert g.healthz_section()["generations"] == [1]
+    assert g.watermark is None  # adoption does not arm the gate
+
+
+def test_armed_hold_gate_parks_newer_generation_until_approved():
+    g = _gate("hold")
+    h = _Handler()
+    _offer_generation(g, h, 1)
+    g.approve(1)  # the controller arms the gate at the incumbent
+    assert _offer_generation(g, h, 2)
+    # generation 2 is parked: buffered, nothing loaded
+    assert h.loads == [("MODEL", "model-gen-1")]
+    hz = g.healthz_section()
+    assert hz["pending_generation"] == 2
+    assert hz["watermark"] == 1
+    # promotion raises the watermark and delivers the parked generation
+    res = g.approve(2)
+    assert res["adopted"] is True
+    assert h.loads[-1] == ("MODEL", "model-gen-2")
+    assert g.healthz_section()["generations"] == [1, 2]
+
+
+def test_held_generation_latest_wins():
+    """Two generations park while unapproved: only the NEWEST adopts on
+    promotion — the same latest-wins contract live serving has."""
+    g = _gate("hold")
+    h = _Handler()
+    _offer_generation(g, h, 1)
+    g.approve(1)
+    _offer_generation(g, h, 2)
+    _offer_generation(g, h, 3)
+    assert g.healthz_section()["pending_generation"] == 3
+    g.approve(3)
+    assert [m for _, m in h.loads] == ["model-gen-1", "model-gen-3"]
+
+
+def test_canary_rollback_is_pointer_swap_and_vetoes():
+    g = _gate("canary")
+    h = _Handler()
+    _offer_generation(g, h, 1)
+    _offer_generation(g, h, 2)  # canary adopts immediately
+    assert [m for _, m in h.loads] == ["model-gen-1", "model-gen-2"]
+    res = g.rollback("quality gate refused promotion")
+    assert res["rolled_back_to"] == 1 and res["vetoed"] == 2
+    # the PREVIOUS adoption re-delivered through the same machinery
+    assert h.loads[-1] == ("MODEL", "model-gen-1")
+    hz = g.healthz_section()
+    assert hz["generations"] == [1]
+    assert hz["vetoed"] == [2]
+    # topic replay cannot re-adopt the vetoed generation
+    before = len(h.loads)
+    assert _offer_generation(g, h, 2)
+    assert len(h.loads) == before
+    # nothing left to roll back to: fail loudly, not silently
+    with pytest.raises(ModelGateError):
+        g.rollback("again")
+
+
+def test_rollback_lowers_watermark_below_vetoed_generation():
+    """A hold gate rolling back must drop its watermark with the pointer,
+    or the next replayed peer of the vetoed generation would adopt."""
+    g = _gate("hold")
+    h = _Handler()
+    _offer_generation(g, h, 1)
+    g.approve(1)
+    _offer_generation(g, h, 2)
+    g.approve(2)  # promoted... then found bad
+    g.rollback("bad promote")
+    assert g.watermark == 1
+
+
+def test_unparseable_stamp_adopts_like_ungated_path():
+    """A bad stamp has no generation to judge: the model adopts the way
+    the ungated path would, and offer() returns False so the normal
+    TRACE branch still logs the bad stamp."""
+    g = _gate("hold")
+    h = _Handler()
+    assert g.offer(h, KeyMessage("MODEL", "model-x"))
+    assert not g.offer(h, KeyMessage("TRACE", "not json"))
+    assert h.loads == [("MODEL", "model-x")]
+
+
+def test_gate_ignores_non_model_keys():
+    g = _gate("hold")
+    h = _Handler()
+    assert not g.offer(h, KeyMessage("UP", "some update"))
+    assert h.loads == []
+
+
+def test_serving_layer_configures_gate_before_replay(monkeypatch):
+    """Startup-race regression: the serving layer's update listener
+    replays the topic from earliest at boot. If it can start before
+    ServingApp's constructor configures the gate, a canary replica
+    adopts its incumbent while the gate is still "off" — outside the
+    gate's history — and the eventual rollback finds nothing to swap
+    back to (the 409 the fleet controller then has to quarantine).
+    A deliberately slowed app constructor makes the wrong ordering
+    lose the race deterministically."""
+    import json
+    import threading
+    import time
+
+    import oryx_tpu.common.modelgate as modelgate
+    import oryx_tpu.common.qualitystats as qualitystats
+    from oryx_tpu.api import ServingModelManager, _dispatch_update
+    from oryx_tpu.bus.broker import get_broker
+    from oryx_tpu.common.modelgate import get_model_gate
+    from oryx_tpu.serving.server import ServingLayer
+
+    monkeypatch.setattr(modelgate, "_instance", None)  # fresh gate
+
+    bus = "mem://gate-order"
+    broker = get_broker(bus)
+    for t in ("OryxInput", "OryxUpdate"):
+        if not broker.topic_exists(t):
+            broker.create_topic(t, 1)
+    broker.send("OryxUpdate", "MODEL", json.dumps({"gen": 1}))
+    broker.send("OryxUpdate", "TRACE", publish_stamp(generation=1))
+
+    # qualitystats configures immediately before the gate in
+    # ServingApp.__init__: stretching it guarantees a listener thread
+    # started ahead of app construction replays the incumbent first
+    real_configure = qualitystats.configure_qualitystats
+
+    def slow_configure(config):
+        time.sleep(0.3)
+        return real_configure(config)
+
+    monkeypatch.setattr(qualitystats, "configure_qualitystats", slow_configure)
+
+    class _Mgr(ServingModelManager):
+        def __init__(self, config):
+            super().__init__(config)
+            self.mode_at_replay: str | None = None
+            self.saw_model = threading.Event()
+
+        def consume(self, updates):
+            self.mode_at_replay = get_model_gate().mode
+            for km in updates:
+                _dispatch_update(self._on, km)
+
+        def _on(self, key, message):
+            if key == "MODEL":
+                self.saw_model.set()
+
+        def get_model(self):
+            return None
+
+    cfg = load_config(
+        overlay={
+            "oryx.input-topic.broker": bus,
+            "oryx.input-topic.message.topic": "OryxInput",
+            "oryx.update-topic.broker": bus,
+            "oryx.update-topic.message.topic": "OryxUpdate",
+            "oryx.serving.api.port": 0,
+            "oryx.serving.api.read-only": True,
+            "oryx.serving.model-gate.mode": "canary",
+            "oryx.serving.application-resources": [
+                "oryx_tpu.serving.resources.common",
+            ],
+        }
+    )
+    mgr = _Mgr(cfg)
+    with ServingLayer(cfg, model_manager=mgr):
+        assert mgr.saw_model.wait(10.0), "incumbent never replayed"
+        # the listener observed a CONFIGURED gate...
+        assert mgr.mode_at_replay == "canary"
+        # ...so the incumbent adopted THROUGH it: rollback has history
+        assert get_model_gate().healthz_section()["generations"] == [1]
+
+
+def test_model_ref_adoptions_pin_and_rollback_unpins(monkeypatch):
+    """MODEL-REF history entries pin their relay cache dirs (a rollback
+    target must never be LRU-evicted); rolling a generation out unpins
+    it, and history overflow unpins the evicted oldest entry."""
+    import oryx_tpu.common.artifact as artifact
+
+    class _Relay:
+        def __init__(self):
+            self.pins: list[str] = []
+            self.unpins: list[str] = []
+
+        def pin(self, ref):
+            self.pins.append(ref)
+
+        def unpin(self, ref):
+            self.unpins.append(ref)
+
+    relay = _Relay()
+    monkeypatch.setattr(artifact, "artifact_relay", lambda: relay)
+    # MODEL-REF delivery resolves through the relay; stub the dispatch so
+    # the unit test needs no real artifact on disk
+    import oryx_tpu.api as api
+
+    monkeypatch.setattr(
+        api, "_dispatch_model", lambda handler, km: handler(km.key, km.message)
+    )
+
+    g = _gate("canary", history=2)
+    h = _Handler()
+    for gen in (1, 2):
+        assert g.offer(h, KeyMessage("MODEL-REF", f"/models/gen-{gen}"))
+        assert g.offer(
+            h, KeyMessage("TRACE", publish_stamp(generation=gen))
+        )
+    assert relay.pins == ["/models/gen-1", "/models/gen-2"]
+    g.rollback("bad")
+    assert relay.unpins == ["/models/gen-2"]
+    # history depth 2: adopting two more evicts gen-1 from history and
+    # unpins it once its artifact is no longer referenced
+    for gen in (3, 4):
+        assert g.offer(h, KeyMessage("MODEL-REF", f"/models/gen-{gen}"))
+        assert g.offer(
+            h, KeyMessage("TRACE", publish_stamp(generation=gen))
+        )
+    assert "/models/gen-1" in relay.unpins

@@ -3,15 +3,31 @@
 
 `cli loadtest` measures one serving process; this harness measures the
 FLEET — it generates the recommend traffic itself (many distinct users,
-so consistent-hash placement actually spreads), drives the front with
-closed-loop workers, and then reads the front's own books: per-replica
-request distribution, retries (shed / connect), ejections, generation
-skew, and each replica's probe snapshot from ``/fleet/status``. A
-deliberate shed (503 + Retry-After surfacing after every replica shed)
-is counted separately from real errors, per the PR 5 contract.
+so consistent-hash placement actually spreads), drives the front, and
+then reads the front's own books: per-replica request distribution,
+retries (shed / connect), ejections, generation skew, and each
+replica's probe snapshot from ``/fleet/status``. A deliberate shed
+(503 + Retry-After surfacing after every replica shed) is counted
+separately from real errors, per the PR 5 contract.
+
+Two drive modes:
+
+- **closed-loop** (default): N workers, each fires its next request the
+  moment the previous answer lands — throughput self-throttles to the
+  fleet's capacity, the classic saturation probe.
+- **open-loop** (``--arrival-rate``): arrivals are scheduled in advance
+  from a Poisson process at the offered rate and fired ON TIME whether
+  or not earlier requests finished — the shape real traffic has, and
+  the only shape that exercises the autoscaler honestly (a closed loop
+  slows down exactly when the fleet does, hiding the backlog the
+  scaler exists to absorb). ``--pattern`` shapes the offered rate
+  (``uniform`` | ``diurnal`` sinusoid | ``bursty`` on/off square wave)
+  and ``--user-dist zipf`` skews the user ids so a hot-key cohort
+  hammers one hash-placement replica.
 
     python -m oryx_tpu.cli fleet --conf oryx.conf --replicas 2 &
-    python tools/fleetload.py --url http://localhost:8090 --duration 20
+    python tools/fleetload.py --url http://localhost:8090 --duration 20 \\
+        --arrival-rate 200 --pattern bursty --user-dist zipf
 
 Prints ONE JSON report line. Exit status 1 when any non-shed error was
 observed (the fleet contract: a healthy fleet behind the front serves
@@ -21,9 +37,12 @@ every request or sheds it honestly).
 from __future__ import annotations
 
 import argparse
+import bisect
 import http.client
 import json
+import math
 import os
+import random
 import re
 import sys
 import threading
@@ -95,6 +114,56 @@ def _front_books(host: str, port: int) -> dict:
     return out
 
 
+def _rate_at(base: float, pattern: str, period: float, t: float) -> float:
+    """Instantaneous offered rate (req/s) at offset ``t`` into the run."""
+    if pattern == "diurnal":
+        # one sinusoidal "day" per period: trough at 20% of base, peak
+        # at 180% — the autoscaler should ride it down and back up
+        return max(0.2 * base, base * (1.0 + 0.8 * math.sin(2.0 * math.pi * t / period)))
+    if pattern == "bursty":
+        # on/off square wave: 20% of each period at 4x, the rest at a
+        # quarter rate — mean stays ~base, peaks probe shed + scale-up
+        return 4.0 * base if (t % period) < 0.2 * period else 0.25 * base
+    return base
+
+
+def _zipf_picker(n: int, s: float, rng: random.Random):
+    """Bounded Zipf(s) sampler over ranks [0, n): precompute the harmonic
+    CDF once, then bisect per draw. Low ranks are the hot keys — with
+    hash placement they concentrate on few replicas, the worst case for
+    the canary cohort split and for scale-down victim choice."""
+    cdf: list[float] = []
+    total = 0.0
+    for k in range(1, n + 1):
+        total += 1.0 / k**s
+        cdf.append(total)
+
+    def pick() -> int:
+        return bisect.bisect_left(cdf, rng.random() * total)
+
+    return pick
+
+
+def _build_arrivals(args, rng: random.Random) -> list[tuple[float, str]]:
+    """Pre-draw the whole open-loop schedule: (offset_s, path) pairs from
+    a non-homogeneous Poisson process. Pre-drawing keeps the hot path a
+    sleep + one request — no clock math races the fleet under test."""
+    if args.user_dist == "zipf":
+        pick_user = _zipf_picker(args.users, args.zipf_s, rng)
+    else:
+        pick_user = lambda: rng.randrange(args.users)
+    period = args.pattern_period or args.duration
+    arrivals: list[tuple[float, str]] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(_rate_at(args.arrival_rate, args.pattern, period, t))
+        if t >= args.duration:
+            return arrivals
+        arrivals.append(
+            (t, f"/recommend/u{pick_user()}?howMany={args.how_many}")
+        )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -105,7 +174,8 @@ def main() -> int:
     ap.add_argument("--duration", type=float, default=20.0)
     ap.add_argument(
         "--workers", type=int, default=16,
-        help="concurrent closed-loop client connections",
+        help="concurrent client connections (closed-loop: one stream "
+        "each; open-loop: the pool that fires scheduled arrivals)",
     )
     ap.add_argument(
         "--users", type=int, default=10_000,
@@ -113,6 +183,36 @@ def main() -> int:
         "(hash placement needs many to spread)",
     )
     ap.add_argument("--how-many", type=int, default=10)
+    ap.add_argument(
+        "--arrival-rate", type=float, default=None,
+        help="switch to open-loop mode: offered request rate in req/s; "
+        "arrivals are scheduled from a Poisson process and fired on "
+        "time regardless of response latency",
+    )
+    ap.add_argument(
+        "--pattern", choices=("uniform", "diurnal", "bursty"),
+        default="uniform",
+        help="open-loop rate shape: uniform, diurnal (sinusoid over "
+        "--pattern-period), or bursty (on/off square wave)",
+    )
+    ap.add_argument(
+        "--pattern-period", type=float, default=None,
+        help="seconds per diurnal/bursty cycle (default: the whole run "
+        "is one cycle)",
+    )
+    ap.add_argument(
+        "--user-dist", choices=("uniform", "zipf"), default="uniform",
+        help="open-loop user-id distribution; zipf concentrates traffic "
+        "on hot keys so hash placement loads few replicas",
+    )
+    ap.add_argument(
+        "--zipf-s", type=float, default=1.1,
+        help="Zipf exponent for --user-dist zipf (higher = hotter head)",
+    )
+    ap.add_argument(
+        "--seed", type=int, default=0,
+        help="RNG seed for the open-loop schedule (reproducible runs)",
+    )
     args = ap.parse_args()
 
     split = urlsplit(args.url if "//" in args.url else f"http://{args.url}")
@@ -122,45 +222,86 @@ def main() -> int:
     ok = [0] * n_workers
     shed = [0] * n_workers
     errors = [0] * n_workers
+    late = [0] * n_workers
     lat_ms: list[list[float]] = [[] for _ in range(n_workers)]
     t_end = time.perf_counter() + args.duration
+
+    def _fire(
+        conn: http.client.HTTPConnection | None, w: int, path: str,
+        honor_retry_after: bool,
+    ) -> http.client.HTTPConnection | None:
+        """One request on a kept-alive connection; returns the connection
+        to reuse (None after a transport error)."""
+        if conn is None:
+            conn = http.client.HTTPConnection(host, port, timeout=60)
+        t0 = time.perf_counter()
+        try:
+            conn.request("GET", path)
+            r = conn.getresponse()
+            retry_after = r.getheader("Retry-After")
+            r.read()
+            if r.status == 200:
+                ok[w] += 1
+                lat_ms[w].append((time.perf_counter() - t0) * 1000)
+            elif r.status == 503 and retry_after:
+                # the whole fleet shed: honest backpressure
+                shed[w] += 1
+                if honor_retry_after:
+                    time.sleep(min(2.0, float(retry_after)))
+            else:
+                errors[w] += 1
+        except Exception:
+            errors[w] += 1
+            try:
+                conn.close()
+            except Exception:
+                pass
+            conn = None
+        return conn
 
     def worker(w: int) -> None:
         conn: http.client.HTTPConnection | None = None
         j = w
         while time.perf_counter() < t_end:
-            if conn is None:
-                conn = http.client.HTTPConnection(host, port, timeout=60)
             path = f"/recommend/u{j % args.users}?howMany={args.how_many}"
             j += n_workers
-            t0 = time.perf_counter()
-            try:
-                conn.request("GET", path)
-                r = conn.getresponse()
-                retry_after = r.getheader("Retry-After")
-                r.read()
-                if r.status == 200:
-                    ok[w] += 1
-                    lat_ms[w].append((time.perf_counter() - t0) * 1000)
-                elif r.status == 503 and retry_after:
-                    # the whole fleet shed: honest backpressure, honor it
-                    shed[w] += 1
-                    time.sleep(min(2.0, float(retry_after)))
-                else:
-                    errors[w] += 1
-            except Exception:
-                errors[w] += 1
-                try:
-                    conn.close()
-                except Exception:
-                    pass
-                conn = None
+            conn = _fire(conn, w, path, honor_retry_after=True)
         if conn is not None:
             conn.close()
 
+    open_loop = args.arrival_rate is not None
+    if open_loop:
+        arrivals = _build_arrivals(args, random.Random(args.seed))
+        next_i = [0]
+        i_lock = threading.Lock()
+        t_base = time.perf_counter() + 0.05  # let all workers spin up
+
+        def open_worker(w: int) -> None:
+            # open loop: a worker does NOT honor Retry-After or wait for
+            # the fleet to recover — it fires the next scheduled arrival
+            # on time. Lateness means the client pool itself saturated
+            # (add --workers), not that the fleet slowed us down.
+            conn: http.client.HTTPConnection | None = None
+            while True:
+                with i_lock:
+                    i = next_i[0]
+                    next_i[0] += 1
+                if i >= len(arrivals):
+                    break
+                offset, path = arrivals[i]
+                delay = t_base + offset - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                elif delay < -0.05:
+                    late[w] += 1
+                conn = _fire(conn, w, path, honor_retry_after=False)
+            if conn is not None:
+                conn.close()
+
     t0 = time.perf_counter()
+    target = open_worker if open_loop else worker
     threads = [
-        threading.Thread(target=worker, args=(w,)) for w in range(n_workers)
+        threading.Thread(target=target, args=(w,)) for w in range(n_workers)
     ]
     for t in threads:
         t.start()
@@ -176,6 +317,7 @@ def main() -> int:
         else None
     )
     report = {
+        "mode": "open" if open_loop else "closed",
         "requests": n_ok,
         "shed_503": n_shed,
         "errors": n_err,
@@ -186,6 +328,14 @@ def main() -> int:
         "users": args.users,
         "front": _front_books(host, port),
     }
+    if open_loop:
+        report["offered"] = {
+            "rate": args.arrival_rate,
+            "pattern": args.pattern,
+            "user_dist": args.user_dist,
+            "scheduled": len(arrivals),
+            "late": sum(late),
+        }
     print(json.dumps(report))
     # contract: behind a healthy front every request is answered or
     # honestly shed — any residual error is a finding
